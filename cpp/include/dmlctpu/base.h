@@ -1,0 +1,41 @@
+// dmlctpu/base.h — feature macros and tiny helpers.
+// Parity target: reference include/dmlc/base.h (macros at 34,73,78,261-284).
+// Modern C++17/20 baseline removes most of the reference's portability shims;
+// what remains is the small set downstream layers actually use.
+#ifndef DMLCTPU_BASE_H_
+#define DMLCTPU_BASE_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+/*! \brief whether serialized byte streams are little-endian (stable on-wire format) */
+#ifndef DMLCTPU_IO_LITTLE_ENDIAN
+#define DMLCTPU_IO_LITTLE_ENDIAN 1
+#endif
+
+#if defined(__GNUC__) || defined(__clang__)
+#define DMLCTPU_ALWAYS_INLINE inline __attribute__((always_inline))
+#define DMLCTPU_LIKELY(x) __builtin_expect(!!(x), 1)
+#define DMLCTPU_UNLIKELY(x) __builtin_expect(!!(x), 0)
+#else
+#define DMLCTPU_ALWAYS_INLINE inline
+#define DMLCTPU_LIKELY(x) (x)
+#define DMLCTPU_UNLIKELY(x) (x)
+#endif
+
+namespace dmlctpu {
+
+/*! \brief pointer to the first element of a vector, nullptr when empty
+ *  (parity: dmlc::BeginPtr, base.h:261-284). */
+template <typename T>
+inline T* BeginPtr(std::vector<T>& v) {  // NOLINT(runtime/references)
+  return v.empty() ? nullptr : &v[0];
+}
+template <typename T>
+inline const T* BeginPtr(const std::vector<T>& v) {
+  return v.empty() ? nullptr : &v[0];
+}
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_BASE_H_

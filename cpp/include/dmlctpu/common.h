@@ -1,0 +1,78 @@
+// dmlctpu/common.h — small shared utilities: Split, HashCombine, and the
+// worker-thread exception relay.  Parity: reference include/dmlc/common.h
+// (Split:23, HashCombine:37, OMPException:53).  The relay here is built on
+// std::exception_ptr and serves plain std::thread pools (no OpenMP in this
+// build — parallel parse uses std::thread, the TPU-side compute uses XLA).
+#ifndef DMLCTPU_COMMON_H_
+#define DMLCTPU_COMMON_H_
+
+#include <exception>
+#include <functional>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace dmlctpu {
+
+/*! \brief split a string by a single-char delimiter (no empty-token pruning) */
+inline std::vector<std::string> Split(const std::string& s, char delim) {
+  std::vector<std::string> out;
+  std::string tok;
+  std::istringstream is(s);
+  while (std::getline(is, tok, delim)) out.push_back(tok);
+  return out;
+}
+
+/*! \brief boost-style hash combiner */
+template <typename T>
+inline void HashCombine(size_t* seed, const T& v) {
+  *seed ^= std::hash<T>()(v) + 0x9e3779b9 + (*seed << 6) + (*seed >> 2);
+}
+
+/*!
+ * \brief captures the first exception thrown inside worker threads and
+ *        rethrows it on the coordinating thread after join.
+ *
+ * Usage:  ExceptionRelay relay;
+ *         threads run  relay.Run([&]{ ... });
+ *         after join:  relay.Rethrow();
+ */
+class ExceptionRelay {
+ public:
+  template <typename Fn>
+  void Run(Fn&& fn) noexcept {
+    try {
+      std::forward<Fn>(fn)();
+    } catch (...) {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!eptr_) eptr_ = std::current_exception();
+    }
+  }
+  /*! \brief record the current in-flight exception */
+  void Capture() noexcept {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!eptr_) eptr_ = std::current_exception();
+  }
+  bool HasException() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<bool>(eptr_);
+  }
+  /*! \brief rethrow the captured exception, if any, on the calling thread */
+  void Rethrow() {
+    std::exception_ptr e;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      std::swap(e, eptr_);
+    }
+    if (e) std::rethrow_exception(e);
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::exception_ptr eptr_;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_COMMON_H_

@@ -1,0 +1,107 @@
+// dmlctpu/concurrency.h — blocking queue + spinlock.
+// Parity: reference include/dmlc/concurrency.h (ConcurrentBlockingQueue:73,
+// Spinlock:25).  FIFO and priority policies, SignalForKill unblocks all
+// waiters permanently (used for pipeline teardown).
+#ifndef DMLCTPU_CONCURRENCY_H_
+#define DMLCTPU_CONCURRENCY_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <utility>
+#include <vector>
+
+namespace dmlctpu {
+
+class Spinlock {
+ public:
+  void lock() noexcept {
+    while (flag_.test_and_set(std::memory_order_acquire)) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+    }
+  }
+  void unlock() noexcept { flag_.clear(std::memory_order_release); }
+
+ private:
+  std::atomic_flag flag_ = ATOMIC_FLAG_INIT;
+};
+
+enum class QueueType { kFIFO, kPriority };
+
+/*!
+ * \brief thread-safe blocking queue; Pop blocks until an item arrives or
+ *        SignalForKill is called (then returns false forever).
+ */
+template <typename T, QueueType policy = QueueType::kFIFO>
+class ConcurrentBlockingQueue {
+ public:
+  void Push(T item, int priority = 0) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if constexpr (policy == QueueType::kFIFO) {
+        fifo_.push_back(std::move(item));
+      } else {
+        heap_.emplace(priority, std::move(item));
+      }
+      ++size_;
+    }
+    cv_.notify_one();
+  }
+  /*! \brief FIFO only: push to the front of the queue */
+  void PushFront(T item) {
+    static_assert(policy == QueueType::kFIFO, "PushFront requires FIFO policy");
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      fifo_.push_front(std::move(item));
+      ++size_;
+    }
+    cv_.notify_one();
+  }
+  /*! \brief blocking pop; false when the queue was killed */
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return size_ != 0 || killed_; });
+    if (size_ == 0) return false;
+    if constexpr (policy == QueueType::kFIFO) {
+      *out = std::move(fifo_.front());
+      fifo_.pop_front();
+    } else {
+      *out = std::move(const_cast<Entry&>(heap_.top()).second);
+      heap_.pop();
+    }
+    --size_;
+    return true;
+  }
+  /*! \brief permanently unblock all current and future Pop calls */
+  void SignalForKill() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      killed_ = true;
+    }
+    cv_.notify_all();
+  }
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return size_;
+  }
+
+ private:
+  using Entry = std::pair<int, T>;
+  struct PriorityLess {
+    bool operator()(const Entry& a, const Entry& b) const { return a.first < b.first; }
+  };
+
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<T> fifo_;
+  std::priority_queue<Entry, std::vector<Entry>, PriorityLess> heap_;
+  size_t size_ = 0;
+  bool killed_ = false;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_CONCURRENCY_H_

@@ -1,0 +1,22 @@
+// dmlctpu/data_iter.h — the minimal pull-iterator interface.
+// Parity: reference include/dmlc/data.h DataIter (:56).
+#ifndef DMLCTPU_DATA_ITER_H_
+#define DMLCTPU_DATA_ITER_H_
+
+namespace dmlctpu {
+
+/*! \brief pull-style iterator: BeforeFirst / Next / Value */
+template <typename DType>
+class DataIter {
+ public:
+  virtual ~DataIter() = default;
+  /*! \brief reset to before the first element */
+  virtual void BeforeFirst() = 0;
+  /*! \brief advance; false at end */
+  virtual bool Next() = 0;
+  /*! \brief current element (valid after a true Next) */
+  virtual const DType& Value() const = 0;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_DATA_ITER_H_

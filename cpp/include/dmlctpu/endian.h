@@ -1,0 +1,65 @@
+// dmlctpu/endian.h — byte-order detection and swapping for the stable
+// little-endian serialization format.  Parity: reference include/dmlc/endian.h
+// (ByteSwap:51), redesigned on std::endian + __builtin_bswap.
+#ifndef DMLCTPU_ENDIAN_H_
+#define DMLCTPU_ENDIAN_H_
+
+#include <bit>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include "./base.h"
+
+namespace dmlctpu {
+
+constexpr bool kLittleEndianHost = (std::endian::native == std::endian::little);
+
+/*! \brief whether serialized IO needs a swap on this host */
+constexpr bool kIONeedsByteSwap = (DMLCTPU_IO_LITTLE_ENDIAN != 0) != kLittleEndianHost;
+
+/*! \brief reverse byte order of n elements of elem_bytes each, in place. */
+inline void ByteSwap(void* data, size_t elem_bytes, size_t num_elems) {
+  auto* p = static_cast<unsigned char*>(data);
+  switch (elem_bytes) {
+    case 1:
+      return;
+    case 2:
+      for (size_t i = 0; i < num_elems; ++i) {
+        uint16_t v;
+        std::memcpy(&v, p + i * 2, 2);
+        v = __builtin_bswap16(v);
+        std::memcpy(p + i * 2, &v, 2);
+      }
+      return;
+    case 4:
+      for (size_t i = 0; i < num_elems; ++i) {
+        uint32_t v;
+        std::memcpy(&v, p + i * 4, 4);
+        v = __builtin_bswap32(v);
+        std::memcpy(p + i * 4, &v, 4);
+      }
+      return;
+    case 8:
+      for (size_t i = 0; i < num_elems; ++i) {
+        uint64_t v;
+        std::memcpy(&v, p + i * 8, 8);
+        v = __builtin_bswap64(v);
+        std::memcpy(p + i * 8, &v, 8);
+      }
+      return;
+    default:
+      // generic element-wise reversal
+      for (size_t i = 0; i < num_elems; ++i) {
+        unsigned char* e = p + i * elem_bytes;
+        for (size_t lo = 0, hi = elem_bytes - 1; lo < hi; ++lo, --hi) {
+          unsigned char t = e[lo];
+          e[lo] = e[hi];
+          e[hi] = t;
+        }
+      }
+  }
+}
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_ENDIAN_H_

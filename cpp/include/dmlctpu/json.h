@@ -1,0 +1,490 @@
+// dmlctpu/json.h — schema-driven JSON reader/writer for STL composites plus a
+// field-helper for struct (de)serialization.
+// Parity: reference include/dmlc/json.h (JSONReader:44, JSONWriter:190,
+// JSONObjectReadHelper:312).  Fresh design: operates on std::istream /
+// std::ostream, type dispatch via if-constexpr traits, helper stores
+// std::function setters.
+#ifndef DMLCTPU_JSON_H_
+#define DMLCTPU_JSON_H_
+
+#include <cctype>
+#include <charconv>
+#include <cmath>
+#include <cstdio>
+#include <functional>
+#include <istream>
+#include <limits>
+#include <map>
+#include <ostream>
+#include <sstream>
+#include <string>
+#include <type_traits>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "./logging.h"
+
+namespace dmlctpu {
+
+class JSONReader;
+class JSONWriter;
+
+namespace json {
+// trait: does T have Save(JSONWriter*)/Load(JSONReader*)?
+template <typename T, typename = void>
+struct HasJSONSaveLoad : std::false_type {};
+template <typename T>
+struct HasJSONSaveLoad<
+    T, std::void_t<decltype(std::declval<const T&>().Save(static_cast<JSONWriter*>(nullptr))),
+                   decltype(std::declval<T&>().Load(static_cast<JSONReader*>(nullptr)))>>
+    : std::true_type {};
+}  // namespace json
+
+/*! \brief pull-style JSON reader with line tracking for error messages */
+class JSONReader {
+ public:
+  explicit JSONReader(std::istream* is) : is_(is) {}
+
+  void ReadString(std::string* out) {
+    int ch = NextNonSpace();
+    Expect(ch == '"', "expected '\"' to begin string");
+    out->clear();
+    while (true) {
+      ch = NextChar();
+      Expect(ch != EOF, "unterminated string");
+      if (ch == '\\') {
+        int e = NextChar();
+        switch (e) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'u': {
+            // minimal \uXXXX: decode latin-1 subset, else '?'
+            int code = 0;
+            for (int i = 0; i < 4; ++i) {
+              int h = NextChar();
+              Expect(std::isxdigit(h), "bad \\u escape");
+              code = code * 16 + (std::isdigit(h) ? h - '0' : (std::tolower(h) - 'a' + 10));
+            }
+            out->push_back(code < 256 ? static_cast<char>(code) : '?');
+            break;
+          }
+          default:
+            Fail("unknown escape sequence");
+        }
+      } else if (ch == '"') {
+        return;
+      } else {
+        out->push_back(static_cast<char>(ch));
+      }
+    }
+  }
+
+  template <typename T>
+  void ReadNumber(T* out) {
+    static_assert(std::is_arithmetic_v<T>, "ReadNumber takes arithmetic types");
+    SkipSpace();
+    if constexpr (std::is_same_v<T, bool>) {
+      int ch = is_->peek();
+      if (ch == 't' || ch == 'f') {
+        std::string word = ReadBareWord();
+        Expect(word == "true" || word == "false", "expected a boolean");
+        *out = (word == "true");
+        return;
+      }
+      double v;
+      (*is_) >> v;
+      Expect(!is_->fail(), "expected a boolean");
+      *out = (v != 0);
+    } else if constexpr (std::is_integral_v<T>) {
+      // parse integers exactly (doubles lose precision above 2^53); fall back
+      // to double for scientific/decimal forms that still target an int field
+      std::string tok = ReadNumericToken();
+      T v{};
+      auto r = std::from_chars(tok.data(), tok.data() + tok.size(), v);
+      if (r.ec == std::errc() && r.ptr == tok.data() + tok.size()) {
+        *out = v;
+        return;
+      }
+      std::istringstream is(tok);
+      double d;
+      is >> d;
+      Expect(!is.fail(), "expected a number");
+      *out = static_cast<T>(d);
+    } else {
+      double v;
+      (*is_) >> v;
+      Expect(!is_->fail(), "expected a number");
+      *out = static_cast<T>(v);
+    }
+  }
+
+  void BeginObject() {
+    int ch = NextNonSpace();
+    Expect(ch == '{', "expected '{'");
+    scope_counts_.push_back(0);
+  }
+  void BeginArray() {
+    int ch = NextNonSpace();
+    Expect(ch == '[', "expected '['");
+    scope_counts_.push_back(0);
+  }
+  /*! \brief move to next "key": value member; false at end of object */
+  bool NextObjectItem(std::string* key) {
+    if (!NextScopeItem('}')) return false;
+    ReadString(key);
+    int ch = NextNonSpace();
+    Expect(ch == ':', "expected ':'");
+    return true;
+  }
+  /*! \brief move to next array element; false at end of array */
+  bool NextArrayItem() { return NextScopeItem(']'); }
+
+  template <typename T>
+  void Read(T* out);
+
+  int line() const { return line_; }
+
+ private:
+  bool NextScopeItem(char close) {
+    TCHECK(!scope_counts_.empty()) << "JSONReader: no open scope";
+    int ch = NextNonSpace();
+    if (scope_counts_.back() != 0) {
+      if (ch == ',') {
+        ch = NextNonSpace();
+      } else {
+        Expect(ch == close, "expected ',' or close bracket");
+        scope_counts_.pop_back();
+        return false;
+      }
+    } else if (ch == close) {
+      scope_counts_.pop_back();
+      return false;
+    }
+    is_->unget();
+    ++scope_counts_.back();
+    return true;
+  }
+  std::string ReadBareWord() {
+    std::string w;
+    int ch;
+    while ((ch = is_->peek()) != EOF && std::isalpha(ch)) w.push_back(static_cast<char>(NextChar()));
+    return w;
+  }
+  std::string ReadNumericToken() {
+    std::string t;
+    int ch;
+    while ((ch = is_->peek()) != EOF &&
+           (std::isdigit(ch) || ch == '-' || ch == '+' || ch == '.' || ch == 'e' || ch == 'E')) {
+      t.push_back(static_cast<char>(NextChar()));
+    }
+    Expect(!t.empty(), "expected a number");
+    return t;
+  }
+  int NextChar() {
+    int ch = is_->get();
+    if (ch == '\n') ++line_;
+    return ch;
+  }
+  int NextNonSpace() {
+    int ch;
+    do {
+      ch = NextChar();
+    } while (ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r');
+    return ch;
+  }
+  void SkipSpace() {
+    int ch;
+    while ((ch = is_->peek()) != EOF &&
+           (ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r')) {
+      NextChar();
+    }
+  }
+  void Expect(bool ok, const char* what) {
+    if (!ok) Fail(what);
+  }
+  [[noreturn]] void Fail(const char* what) {
+    TLOG(Fatal) << "JSON parse error at line " << line_ << ": " << what;
+    throw Error(what);  // unreachable; TLOG(Fatal) throws
+  }
+
+  std::istream* is_;
+  std::vector<size_t> scope_counts_;
+  int line_ = 1;
+};
+
+/*! \brief push-style JSON writer with 2-space pretty printing */
+class JSONWriter {
+ public:
+  explicit JSONWriter(std::ostream* os) : os_(os) {}
+
+  void WriteString(const std::string& s) {
+    std::ostream& os = *os_;
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\t': os << "\\t"; break;
+        case '\r': os << "\\r"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char esc[8];
+            std::snprintf(esc, sizeof(esc), "\\u%04x", c);
+            os << esc;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+  template <typename T>
+  void WriteNumber(const T& v) {
+    static_assert(std::is_arithmetic_v<T>, "WriteNumber takes arithmetic types");
+    if constexpr (std::is_floating_point_v<T>) {
+      std::ostringstream tmp;
+      tmp.precision(std::numeric_limits<T>::max_digits10);
+      tmp << v;
+      (*os_) << tmp.str();
+    } else if constexpr (std::is_same_v<T, bool>) {
+      (*os_) << (v ? "true" : "false");
+    } else {
+      (*os_) << +v;  // promote char-like ints
+    }
+  }
+  void BeginObject(bool multi_line = true) {
+    (*os_) << '{';
+    scope_multi_line_.push_back(multi_line);
+    scope_counts_.push_back(0);
+  }
+  void EndObject() {
+    TCHECK(!scope_counts_.empty());
+    bool newline = scope_multi_line_.back() && scope_counts_.back() != 0;
+    scope_counts_.pop_back();
+    scope_multi_line_.pop_back();
+    if (newline) WriteSeperator(true);
+    (*os_) << '}';
+  }
+  void BeginArray(bool multi_line = true) {
+    (*os_) << '[';
+    scope_multi_line_.push_back(multi_line);
+    scope_counts_.push_back(0);
+  }
+  void EndArray() {
+    TCHECK(!scope_counts_.empty());
+    bool newline = scope_multi_line_.back() && scope_counts_.back() != 0;
+    scope_counts_.pop_back();
+    scope_multi_line_.pop_back();
+    if (newline) WriteSeperator(true);
+    (*os_) << ']';
+  }
+  void WriteObjectKeyValue(const std::string& key, const std::function<void()>& write_value) {
+    ItemSeparator();
+    WriteString(key);
+    (*os_) << ": ";
+    write_value();
+  }
+  template <typename T, typename = std::enable_if_t<!std::is_invocable_v<T>>>
+  void WriteObjectKeyValue(const std::string& key, const T& value) {
+    ItemSeparator();
+    WriteString(key);
+    (*os_) << ": ";
+    Write(value);
+  }
+  void BeginArrayItem() { ItemSeparator(); }
+
+  template <typename T>
+  void Write(const T& value);
+
+ private:
+  void ItemSeparator() {
+    if (scope_counts_.back() != 0) (*os_) << ',';
+    ++scope_counts_.back();
+    if (scope_multi_line_.back()) WriteSeperator(false);
+  }
+  void WriteSeperator(bool closing) {
+    (*os_) << '\n';
+    // when closing, the scope was already popped, so size() is the right
+    // depth in both cases (items indent one deeper than the closing bracket)
+    (void)closing;
+    for (size_t i = 0; i < scope_counts_.size(); ++i) (*os_) << "  ";
+  }
+
+  std::ostream* os_;
+  std::vector<size_t> scope_counts_;
+  std::vector<bool> scope_multi_line_;
+};
+
+// ---- generic typed Read/Write ---------------------------------------------
+namespace json {
+
+template <typename T>
+inline void WriteValue(JSONWriter* w, const T& v) {
+  if constexpr (std::is_same_v<T, std::string>) {
+    w->WriteString(v);
+  } else if constexpr (std::is_arithmetic_v<T>) {
+    w->WriteNumber(v);
+  } else if constexpr (HasJSONSaveLoad<T>::value) {
+    v.Save(w);
+  } else {
+    static_assert(sizeof(T) == 0, "type not JSON-writable");
+  }
+}
+inline void WriteValue(JSONWriter* w, const char* v) { w->WriteString(v); }
+
+template <typename T>
+inline void ReadValue(JSONReader* r, T* v) {
+  if constexpr (std::is_same_v<T, std::string>) {
+    r->ReadString(v);
+  } else if constexpr (std::is_arithmetic_v<T>) {
+    r->ReadNumber(v);
+  } else if constexpr (HasJSONSaveLoad<T>::value) {
+    v->Load(r);
+  } else {
+    static_assert(sizeof(T) == 0, "type not JSON-readable");
+  }
+}
+
+template <typename T, typename A>
+inline void WriteValue(JSONWriter* w, const std::vector<T, A>& v) {
+  w->BeginArray(false);
+  for (const auto& item : v) {
+    w->BeginArrayItem();
+    WriteValue(w, item);
+  }
+  w->EndArray();
+}
+template <typename T, typename A>
+inline void ReadValue(JSONReader* r, std::vector<T, A>* v) {
+  r->BeginArray();
+  v->clear();
+  while (r->NextArrayItem()) {
+    v->emplace_back();
+    ReadValue(r, &v->back());
+  }
+}
+template <typename A, typename B>
+inline void WriteValue(JSONWriter* w, const std::pair<A, B>& v) {
+  w->BeginArray(false);
+  w->BeginArrayItem();
+  WriteValue(w, v.first);
+  w->BeginArrayItem();
+  WriteValue(w, v.second);
+  w->EndArray();
+}
+template <typename A, typename B>
+inline void ReadValue(JSONReader* r, std::pair<A, B>* v) {
+  r->BeginArray();
+  TCHECK(r->NextArrayItem()) << "pair expects 2 elements";
+  ReadValue(r, &v->first);
+  TCHECK(r->NextArrayItem()) << "pair expects 2 elements";
+  ReadValue(r, &v->second);
+  TCHECK(!r->NextArrayItem()) << "pair expects exactly 2 elements";
+}
+template <typename V, typename C, typename A>
+inline void WriteValue(JSONWriter* w, const std::map<std::string, V, C, A>& m) {
+  w->BeginObject();
+  for (const auto& kv : m) {
+    w->WriteObjectKeyValue(kv.first, [&] { WriteValue(w, kv.second); });
+  }
+  w->EndObject();
+}
+template <typename V, typename C, typename A>
+inline void ReadValue(JSONReader* r, std::map<std::string, V, C, A>* m) {
+  r->BeginObject();
+  m->clear();
+  std::string key;
+  while (r->NextObjectItem(&key)) {
+    V v{};
+    ReadValue(r, &v);
+    m->emplace(key, std::move(v));
+  }
+}
+template <typename V, typename H, typename E, typename A>
+inline void WriteValue(JSONWriter* w, const std::unordered_map<std::string, V, H, E, A>& m) {
+  w->BeginObject();
+  for (const auto& kv : m) {
+    w->WriteObjectKeyValue(kv.first, [&] { WriteValue(w, kv.second); });
+  }
+  w->EndObject();
+}
+template <typename V, typename H, typename E, typename A>
+inline void ReadValue(JSONReader* r, std::unordered_map<std::string, V, H, E, A>* m) {
+  r->BeginObject();
+  m->clear();
+  std::string key;
+  while (r->NextObjectItem(&key)) {
+    V v{};
+    ReadValue(r, &v);
+    m->emplace(key, std::move(v));
+  }
+}
+
+}  // namespace json
+
+template <typename T>
+inline void JSONReader::Read(T* out) {
+  json::ReadValue(this, out);
+}
+template <typename T>
+inline void JSONWriter::Write(const T& value) {
+  json::WriteValue(this, value);
+}
+
+/*!
+ * \brief declarative reader for JSON objects whose members map to struct
+ *        fields; unknown keys can be fatal or ignored.
+ */
+class JSONObjectReadHelper {
+ public:
+  template <typename T>
+  void DeclareField(const std::string& key, T* addr) {
+    DeclareFieldInternal(key, addr, false);
+  }
+  template <typename T>
+  void DeclareOptionalField(const std::string& key, T* addr) {
+    DeclareFieldInternal(key, addr, true);
+  }
+  void ReadAllFields(JSONReader* reader) {
+    reader->BeginObject();
+    std::map<std::string, bool> visited;
+    std::string key;
+    while (reader->NextObjectItem(&key)) {
+      auto it = entries_.find(key);
+      TCHECK(it != entries_.end()) << "JSONObjectReadHelper: unknown field '" << key << "'";
+      it->second.read(reader);
+      visited[key] = true;
+    }
+    for (const auto& kv : entries_) {
+      TCHECK(kv.second.optional || visited.count(kv.first) != 0)
+          << "JSONObjectReadHelper: missing required field '" << kv.first << "'";
+    }
+  }
+
+ private:
+  template <typename T>
+  void DeclareFieldInternal(const std::string& key, T* addr, bool optional) {
+    Entry e;
+    e.optional = optional;
+    e.read = [addr](JSONReader* r) { json::ReadValue(r, addr); };
+    entries_[key] = std::move(e);
+  }
+  struct Entry {
+    bool optional = false;
+    std::function<void(JSONReader*)> read;
+  };
+  std::map<std::string, Entry> entries_;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_JSON_H_

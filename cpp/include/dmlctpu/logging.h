@@ -1,0 +1,133 @@
+// dmlctpu/logging.h — structured logging + CHECK assertions for the TPU-native
+// dmlc substrate.  Capability parity with reference include/dmlc/logging.h
+// (Error:29, CHECK:205-248, LOG:251-285) but a fresh design: severity is an
+// enum class, sinks are pluggable via a std::function, FATAL always raises
+// dmlctpu::Error (exceptions are the native error channel here — the Python
+// binding layer translates them), and verbosity is runtime-controlled through
+// DMLCTPU_LOG_LEVEL / DMLC_LOG_DEBUG env vars.
+#ifndef DMLCTPU_LOGGING_H_
+#define DMLCTPU_LOGGING_H_
+
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <functional>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+
+namespace dmlctpu {
+
+/*! \brief the exception class thrown by CHECK failures and LOG(FATAL) */
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+enum class LogSeverity : int { kDebug = 0, kInfo = 1, kWarning = 2, kError = 3, kFatal = 4 };
+
+namespace log {
+
+/*! \brief pluggable sink: receives (severity, "file:line", message). */
+using Sink = std::function<void(LogSeverity, const char*, const std::string&)>;
+
+inline Sink& CustomSink() {
+  static Sink sink;  // empty => default stderr sink
+  return sink;
+}
+
+/*! \brief minimum severity that gets emitted (default INFO; DEBUG if DMLC_LOG_DEBUG=1). */
+inline int& MinLevel() {
+  static int level = [] {
+    const char* dbg = std::getenv("DMLC_LOG_DEBUG");
+    const char* lvl = std::getenv("DMLCTPU_LOG_LEVEL");
+    if (lvl != nullptr) return std::atoi(lvl);
+    if (dbg != nullptr && std::atoi(dbg) != 0) return 0;
+    return 1;
+  }();
+  return level;
+}
+
+inline const char* SeverityName(LogSeverity s) {
+  switch (s) {
+    case LogSeverity::kDebug: return "DEBUG";
+    case LogSeverity::kInfo: return "INFO";
+    case LogSeverity::kWarning: return "WARNING";
+    case LogSeverity::kError: return "ERROR";
+    default: return "FATAL";
+  }
+}
+
+void Emit(LogSeverity severity, const char* file, int line, const std::string& msg);
+
+/*! \brief stream-building message; emits on destruction. */
+class Message {
+ public:
+  Message(LogSeverity severity, const char* file, int line)
+      : severity_(severity), file_(file), line_(line) {}
+  ~Message() noexcept(false) {
+    if (severity_ == LogSeverity::kFatal) {
+      std::string m = stream_.str();
+      Emit(severity_, file_, line_, m);
+      std::ostringstream full;
+      full << "[" << file_ << ":" << line_ << "] " << m;
+      throw Error(full.str());
+    }
+    if (static_cast<int>(severity_) >= MinLevel()) {
+      Emit(severity_, file_, line_, stream_.str());
+    }
+  }
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  LogSeverity severity_;
+  const char* file_;
+  int line_;
+  std::ostringstream stream_;
+};
+
+/*! \brief swallow a stream expression when a log statement is compiled out. */
+struct Voidify {
+  void operator&(std::ostream&) {}
+};
+
+}  // namespace log
+
+#define DMLCTPU_LOG_AT(sev) \
+  ::dmlctpu::log::Message(::dmlctpu::LogSeverity::sev, __FILE__, __LINE__).stream()
+#define TLOG(severity) DMLCTPU_LOG_AT(k##severity)
+#define TLOG_IF(severity, cond) \
+  !(cond) ? (void)0 : ::dmlctpu::log::Voidify() & TLOG(severity)
+
+// CHECK family: failure throws dmlctpu::Error with the rendered condition.
+#define TCHECK(cond)                                           \
+  if (!(cond))                                                 \
+  TLOG(Fatal) << "Check failed: " #cond " "
+#define DMLCTPU_CHECK_OP(op, a, b)                             \
+  if (!((a)op(b)))                                             \
+  TLOG(Fatal) << "Check failed: " #a " " #op " " #b " (" << (a) << " vs " << (b) << ") "
+#define TCHECK_EQ(a, b) DMLCTPU_CHECK_OP(==, a, b)
+#define TCHECK_NE(a, b) DMLCTPU_CHECK_OP(!=, a, b)
+#define TCHECK_LT(a, b) DMLCTPU_CHECK_OP(<, a, b)
+#define TCHECK_LE(a, b) DMLCTPU_CHECK_OP(<=, a, b)
+#define TCHECK_GT(a, b) DMLCTPU_CHECK_OP(>, a, b)
+#define TCHECK_GE(a, b) DMLCTPU_CHECK_OP(>=, a, b)
+#define TCHECK_NOTNULL(p) \
+  ((p) == nullptr ? (TLOG(Fatal) << "Check notnull: " #p " ", (p)) : (p))
+
+#ifdef NDEBUG
+#define TDCHECK(cond) \
+  while (false) TCHECK(cond)
+#define TDCHECK_EQ(a, b) \
+  while (false) TCHECK_EQ(a, b)
+#define TDCHECK_LT(a, b) \
+  while (false) TCHECK_LT(a, b)
+#else
+#define TDCHECK(cond) TCHECK(cond)
+#define TDCHECK_EQ(a, b) TCHECK_EQ(a, b)
+#define TDCHECK_LT(a, b) TCHECK_LT(a, b)
+#endif
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_LOGGING_H_

@@ -1,0 +1,71 @@
+// dmlctpu/memory_io.h — seekable streams over caller-owned memory.
+// Parity: reference include/dmlc/memory_io.h (MemoryFixedSizeStream:21,
+// MemoryStringStream:66).
+#ifndef DMLCTPU_MEMORY_IO_H_
+#define DMLCTPU_MEMORY_IO_H_
+
+#include <algorithm>
+#include <cstring>
+#include <string>
+
+#include "./stream.h"
+
+namespace dmlctpu {
+
+/*! \brief stream over a fixed-size caller buffer; Write past the end is fatal */
+class MemoryFixedSizeStream : public SeekStream {
+ public:
+  MemoryFixedSizeStream(void* buffer, size_t size)
+      : buf_(static_cast<char*>(buffer)), cap_(size) {}
+  size_t Read(void* ptr, size_t size) override {
+    size_t n = std::min(size, cap_ - pos_);
+    if (n != 0) std::memcpy(ptr, buf_ + pos_, n);
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    TCHECK_LE(pos_ + size, cap_) << "MemoryFixedSizeStream overflow";
+    if (size != 0) std::memcpy(buf_ + pos_, ptr, size);
+    pos_ += size;
+    return size;
+  }
+  void Seek(size_t pos) override {
+    TCHECK_LE(pos, cap_);
+    pos_ = pos;
+  }
+  size_t Tell() override { return pos_; }
+  bool AtEnd() override { return pos_ == cap_; }
+
+ private:
+  char* buf_;
+  size_t cap_;
+  size_t pos_ = 0;
+};
+
+/*! \brief stream over a std::string that grows on write */
+class MemoryStringStream : public SeekStream {
+ public:
+  explicit MemoryStringStream(std::string* str) : str_(str) {}
+  size_t Read(void* ptr, size_t size) override {
+    size_t n = std::min(size, str_->size() - std::min(pos_, str_->size()));
+    if (n != 0) std::memcpy(ptr, str_->data() + pos_, n);
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    if (pos_ + size > str_->size()) str_->resize(pos_ + size);
+    if (size != 0) std::memcpy(&(*str_)[pos_], ptr, size);
+    pos_ += size;
+    return size;
+  }
+  void Seek(size_t pos) override { pos_ = pos; }
+  size_t Tell() override { return pos_; }
+  bool AtEnd() override { return pos_ >= str_->size(); }
+
+ private:
+  std::string* str_;
+  size_t pos_ = 0;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_MEMORY_IO_H_

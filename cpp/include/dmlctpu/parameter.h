@@ -1,0 +1,514 @@
+// dmlctpu/parameter.h — declarative typed parameter structs.
+// Parity: reference include/dmlc/parameter.h (Parameter<PType> Init:141,
+// InitAllowUnknown:158, UpdateAllowUnknown:179, __DICT__:202, Save/Load
+// JSON:211-223, __FIELDS__/__DOC__:228-239; ParamManager:423-541;
+// FieldEntry specializations:775-1106; GetEnv/SetEnv:1122-1147).
+//
+// Fresh design notes: fields register into a per-struct singleton manager via
+// a CRTP __DECLARE__ pass over a throwaway instance (offsets are recorded, so
+// access on live instances is a pointer add); value conversion runs through
+// std::from_chars-based strtonum; enums/ranges/aliases/docs are fluent
+// modifiers on FieldEntry<T>; errors carry did-you-mean suggestions.
+#ifndef DMLCTPU_PARAMETER_H_
+#define DMLCTPU_PARAMETER_H_
+
+#include <algorithm>
+#include <cstdlib>
+#include <limits>
+#include <map>
+#include <memory>
+#include <optional>
+#include <sstream>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "./json.h"
+#include "./logging.h"
+#include "./registry.h"
+#include "./strtonum.h"
+
+namespace dmlctpu {
+namespace param {
+
+/*! \brief string → T conversion used by all field entries */
+template <typename T>
+inline bool ValueFromString(const std::string& s, T* out) {
+  if constexpr (std::is_same_v<T, std::string>) {
+    *out = s;
+    return true;
+  } else if constexpr (std::is_same_v<T, bool>) {
+    std::string low(s);
+    std::transform(low.begin(), low.end(), low.begin(), ::tolower);
+    if (low == "true" || low == "1") { *out = true; return true; }
+    if (low == "false" || low == "0") { *out = false; return true; }
+    return false;
+  } else if constexpr (std::is_arithmetic_v<T>) {
+    const char* p = s.c_str();
+    const char* end = p + s.size();
+    T v{};
+    if (!TryParseNum(&p, end, &v)) return false;
+    while (p != end && IsSpaceChar(*p)) ++p;
+    if (p != end) return false;  // trailing garbage
+    *out = v;
+    return true;
+  } else {
+    std::istringstream is(s);
+    is >> *out;
+    return !is.fail();
+  }
+}
+
+template <typename T>
+inline bool ValueFromString(const std::string& s, std::optional<T>* out) {
+  if (s == "None" || s == "none" || s == "null") {
+    out->reset();
+    return true;
+  }
+  T v{};
+  if (!ValueFromString(s, &v)) return false;
+  *out = v;
+  return true;
+}
+
+template <typename T>
+inline std::string ValueToString(const T& v) {
+  if constexpr (std::is_same_v<T, std::string>) {
+    return v;
+  } else if constexpr (std::is_same_v<T, bool>) {
+    return v ? "1" : "0";
+  } else {
+    std::ostringstream os;
+    if constexpr (std::is_floating_point_v<T>) {
+      os.precision(std::numeric_limits<T>::max_digits10);
+    }
+    os << +v;
+    return os.str();
+  }
+}
+template <typename T>
+inline std::string ValueToString(const std::optional<T>& v) {
+  return v.has_value() ? ValueToString(*v) : std::string("None");
+}
+inline std::string ValueToString(const std::string& v) { return v; }
+
+template <typename T>
+inline std::string TypeName() {
+  if constexpr (std::is_same_v<T, std::string>) return "string";
+  else if constexpr (std::is_same_v<T, bool>) return "boolean";
+  else if constexpr (std::is_same_v<T, int>) return "int";
+  else if constexpr (std::is_same_v<T, unsigned>) return "unsigned int";
+  else if constexpr (std::is_same_v<T, int64_t>) return "long";
+  else if constexpr (std::is_same_v<T, uint64_t>) return "unsigned long";
+  else if constexpr (std::is_same_v<T, float>) return "float";
+  else if constexpr (std::is_same_v<T, double>) return "double";
+  else return "value";
+}
+template <typename T>
+inline std::string TypeName(const std::optional<T>*) {
+  return "optional<" + TypeName<T>() + ">";
+}
+
+/*! \brief levenshtein distance for did-you-mean suggestions */
+inline size_t EditDistance(const std::string& a, const std::string& b) {
+  std::vector<size_t> prev(b.size() + 1), cur(b.size() + 1);
+  for (size_t j = 0; j <= b.size(); ++j) prev[j] = j;
+  for (size_t i = 1; i <= a.size(); ++i) {
+    cur[0] = i;
+    for (size_t j = 1; j <= b.size(); ++j) {
+      size_t cost = (a[i - 1] == b[j - 1]) ? 0 : 1;
+      cur[j] = std::min({prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost});
+    }
+    std::swap(prev, cur);
+  }
+  return prev[b.size()];
+}
+
+/*! \brief type-erased accessor for one declared field */
+class FieldEntryBase {
+ public:
+  virtual ~FieldEntryBase() = default;
+  virtual void SetFromString(void* head, const std::string& value) const = 0;
+  virtual std::string GetAsString(const void* head) const = 0;
+  virtual void SetDefault(void* head) const = 0;
+  virtual bool HasDefault() const { return has_default_; }
+  virtual ParamFieldInfo Info() const = 0;
+
+  std::string name;
+  std::string description;
+
+ protected:
+  bool has_default_ = false;
+};
+
+/*! \brief typed field accessor with fluent constraint modifiers */
+template <typename T>
+class FieldEntry : public FieldEntryBase {
+ public:
+  FieldEntry(const std::string& field_name, size_t offset) {
+    name = field_name;
+    offset_ = offset;
+  }
+
+  // ---- fluent modifiers (mirror reference FieldEntry API) ----
+  FieldEntry& set_default(const T& v) {
+    default_ = v;
+    has_default_ = true;
+    return *this;
+  }
+  FieldEntry& set_range(T lo, T hi) {
+    lo_ = lo;
+    hi_ = hi;
+    has_range_ = true;
+    return *this;
+  }
+  FieldEntry& set_lower_bound(T lo) {
+    lo_ = lo;
+    has_lower_ = true;
+    return *this;
+  }
+  FieldEntry& set_upper_bound(T hi) {
+    hi_ = hi;
+    has_upper_ = true;
+    return *this;
+  }
+  FieldEntry& add_enum(const std::string& key, const T& value) {
+    enum_map_[key] = value;
+    return *this;
+  }
+  FieldEntry& describe(const std::string& d) {
+    description = d;
+    return *this;
+  }
+
+  // ---- FieldEntryBase ----
+  void SetFromString(void* head, const std::string& value) const override {
+    T* addr = Addr(head);
+    if (!enum_map_.empty()) {
+      auto it = enum_map_.find(value);
+      if (it != enum_map_.end()) {
+        *addr = it->second;
+        return;
+      }
+      // fall through: allow raw values too, but only if they parse & are valid enum values
+      T raw{};
+      if (ValueFromString(value, &raw)) {
+        for (const auto& kv : enum_map_) {
+          if (kv.second == raw) {
+            *addr = raw;
+            return;
+          }
+        }
+      }
+      std::ostringstream os;
+      os << "invalid value '" << value << "' for parameter '" << name << "'; expected one of {";
+      bool first = true;
+      for (const auto& kv : enum_map_) {
+        if (!first) os << ", ";
+        os << "'" << kv.first << "'";
+        first = false;
+      }
+      os << "}";
+      throw Error(os.str());
+    }
+    T v{};
+    if (!ValueFromString(value, &v)) {
+      throw Error("cannot parse '" + value + "' as " + TypeInfo() + " for parameter '" +
+                  name + "'");
+    }
+    Check(v);
+    *addr = v;
+  }
+  std::string GetAsString(const void* head) const override {
+    const T& v = *Addr(const_cast<void*>(head));
+    if (!enum_map_.empty()) {
+      for (const auto& kv : enum_map_) {
+        if (kv.second == v) return kv.first;
+      }
+    }
+    return ValueToString(v);
+  }
+  void SetDefault(void* head) const override {
+    TCHECK(has_default_) << "required parameter '" << name << "' is missing";
+    *Addr(head) = *default_;
+  }
+  ParamFieldInfo Info() const override {
+    ParamFieldInfo info;
+    info.name = name;
+    info.type = TypeInfo();
+    std::ostringstream os;
+    os << info.type;
+    if (!enum_map_.empty()) {
+      os << ", {";
+      bool first = true;
+      for (const auto& kv : enum_map_) {
+        if (!first) os << ", ";
+        os << "'" << kv.first << "'";
+        first = false;
+      }
+      os << "}";
+    }
+    if (has_range_ || has_lower_ || has_upper_) {
+      os << ", range [" << (has_range_ || has_lower_ ? ValueToString(lo_) : std::string("-inf"))
+         << ", " << (has_range_ || has_upper_ ? ValueToString(hi_) : std::string("inf")) << "]";
+    }
+    if (has_default_) {
+      os << ", default=" << ValueToString(*default_);
+    } else {
+      os << ", required";
+    }
+    info.type_info_str = os.str();
+    info.description = description;
+    return info;
+  }
+
+ private:
+  std::string TypeInfo() const {
+    if constexpr (is_optional_) {
+      return TypeName(static_cast<const T*>(nullptr));
+    } else {
+      return TypeName<T>();
+    }
+  }
+  void Check(const T& v) const {
+    if constexpr (!is_optional_ && !std::is_same_v<T, std::string> && !std::is_same_v<T, bool>) {
+      if (has_range_ && !(lo_ <= v && v < hi_)) {
+        throw Error("value " + ValueToString(v) + " for parameter '" + name +
+                    "' is out of range [" + ValueToString(lo_) + ", " + ValueToString(hi_) + ")");
+      }
+      if (has_lower_ && !(v >= lo_)) {
+        throw Error("value " + ValueToString(v) + " for parameter '" + name +
+                    "' must be >= " + ValueToString(lo_));
+      }
+      if (has_upper_ && !(v <= hi_)) {
+        throw Error("value " + ValueToString(v) + " for parameter '" + name +
+                    "' must be <= " + ValueToString(hi_));
+      }
+    }
+  }
+  T* Addr(void* head) const {
+    return reinterpret_cast<T*>(static_cast<char*>(head) + offset_);
+  }
+
+  template <typename U>
+  struct IsOptional : std::false_type {};
+  template <typename U>
+  struct IsOptional<std::optional<U>> : std::true_type {};
+  static constexpr bool is_optional_ = IsOptional<T>::value;
+
+  size_t offset_ = 0;
+  std::optional<T> default_;
+  bool has_range_ = false, has_lower_ = false, has_upper_ = false;
+  T lo_{}, hi_{};
+  std::map<std::string, T> enum_map_;
+};
+
+/*! \brief per-struct manager holding field entries and alias table */
+class ParamManager {
+ public:
+  template <typename T>
+  FieldEntry<T>& AddField(const std::string& key, size_t offset) {
+    auto entry = std::make_unique<FieldEntry<T>>(key, offset);
+    FieldEntry<T>* ptr = entry.get();
+    entries_.push_back(std::move(entry));
+    lookup_[key] = ptr;
+    return *ptr;
+  }
+  void AddAlias(const std::string& field, const std::string& alias) {
+    auto it = lookup_.find(field);
+    TCHECK(it != lookup_.end()) << "alias target '" << field << "' not declared";
+    lookup_[alias] = it->second;
+  }
+  const FieldEntryBase* Find(const std::string& key) const {
+    auto it = lookup_.find(key);
+    return it == lookup_.end() ? nullptr : it->second;
+  }
+  /*!
+   * \brief run initialization over kwargs.
+   * \param unknown_out when non-null, unknown keys are collected there instead
+   *        of raising; when null, unknown keys raise with suggestions.
+   * \param update_only when true, fields absent from kwargs keep their current
+   *        value instead of being reset to defaults.
+   */
+  template <typename Container>
+  void RunInit(void* head, const Container& kwargs,
+               std::vector<std::pair<std::string, std::string>>* unknown_out,
+               bool update_only) const {
+    std::vector<const FieldEntryBase*> set_fields;
+    for (const auto& kv : kwargs) {
+      const FieldEntryBase* e = Find(kv.first);
+      if (e == nullptr) {
+        if (unknown_out != nullptr) {
+          unknown_out->emplace_back(kv.first, kv.second);
+          continue;
+        }
+        throw Error("unknown parameter '" + kv.first + "'" + Suggest(kv.first));
+      }
+      e->SetFromString(head, kv.second);
+      set_fields.push_back(e);
+    }
+    if (!update_only) {
+      for (const auto& e : entries_) {
+        if (std::find(set_fields.begin(), set_fields.end(), e.get()) == set_fields.end()) {
+          e->SetDefault(head);  // raises if required
+        }
+      }
+    }
+  }
+  std::map<std::string, std::string> GetDict(const void* head) const {
+    std::map<std::string, std::string> out;
+    for (const auto& e : entries_) out[e->name] = e->GetAsString(head);
+    return out;
+  }
+  std::vector<ParamFieldInfo> Fields() const {
+    std::vector<ParamFieldInfo> out;
+    out.reserve(entries_.size());
+    for (const auto& e : entries_) out.push_back(e->Info());
+    return out;
+  }
+  std::string DocString() const {
+    std::ostringstream os;
+    for (const auto& e : entries_) {
+      ParamFieldInfo info = e->Info();
+      os << info.name << " : " << info.type_info_str << "\n";
+      if (!info.description.empty()) os << "    " << info.description << "\n";
+    }
+    return os.str();
+  }
+
+ private:
+  std::string Suggest(const std::string& key) const {
+    std::string best;
+    size_t best_dist = std::max<size_t>(key.size() / 2, 2);
+    for (const auto& kv : lookup_) {
+      size_t d = EditDistance(key, kv.first);
+      if (d < best_dist) {
+        best_dist = d;
+        best = kv.first;
+      }
+    }
+    if (best.empty()) return "";
+    return " (did you mean '" + best + "'?)";
+  }
+
+  std::vector<std::unique_ptr<FieldEntryBase>> entries_;
+  std::map<std::string, const FieldEntryBase*> lookup_;
+};
+
+/*! \brief declaration context passed into PType::__DECLARE__ */
+template <typename PType>
+class DeclareHelper {
+ public:
+  DeclareHelper(ParamManager* mgr, PType* dummy) : mgr_(mgr), dummy_(dummy) {}
+  template <typename T>
+  FieldEntry<T>& Declare(const std::string& key, T* addr) {
+    size_t offset = reinterpret_cast<char*>(addr) - reinterpret_cast<char*>(dummy_);
+    return mgr_->AddField<T>(key, offset);
+  }
+  void Alias(const std::string& field, const std::string& alias) {
+    mgr_->AddAlias(field, alias);
+  }
+
+ private:
+  ParamManager* mgr_;
+  PType* dummy_;
+};
+
+}  // namespace param
+
+/*!
+ * \brief CRTP base giving a struct the declarative parameter interface.
+ *
+ * struct MyParam : public Parameter<MyParam> {
+ *   float lr; int hidden; std::string act;
+ *   DMLCTPU_DECLARE_PARAMETER(MyParam) {
+ *     DMLCTPU_DECLARE_FIELD(lr).set_default(0.01f).set_range(0.f, 1.f)
+ *         .describe("learning rate");
+ *     ...
+ *   }
+ * };
+ */
+template <typename PType>
+struct Parameter {
+ public:
+  /*! \brief strict init: unknown keys raise */
+  template <typename Container>
+  void Init(const Container& kwargs) {
+    Manager().RunInit(Head(), kwargs, nullptr, false);
+  }
+  /*! \brief lenient init: returns the unrecognized (key, value) pairs */
+  template <typename Container>
+  std::vector<std::pair<std::string, std::string>> InitAllowUnknown(const Container& kwargs) {
+    std::vector<std::pair<std::string, std::string>> unknown;
+    Manager().RunInit(Head(), kwargs, &unknown, false);
+    return unknown;
+  }
+  /*! \brief update only the provided keys, leave the rest untouched */
+  template <typename Container>
+  std::vector<std::pair<std::string, std::string>> UpdateAllowUnknown(const Container& kwargs) {
+    std::vector<std::pair<std::string, std::string>> unknown;
+    Manager().RunInit(Head(), kwargs, &unknown, true);
+    return unknown;
+  }
+  /*! \brief current values as a string dict */
+  std::map<std::string, std::string> __DICT__() const {
+    return Manager().GetDict(static_cast<const void*>(static_cast<const PType*>(this)));
+  }
+  static std::vector<ParamFieldInfo> __FIELDS__() { return Manager().Fields(); }
+  static std::string __DOC__() { return Manager().DocString(); }
+
+  void Save(JSONWriter* writer) const {
+    auto dict = __DICT__();
+    writer->BeginObject();
+    for (const auto& kv : dict) writer->WriteObjectKeyValue(kv.first, kv.second);
+    writer->EndObject();
+  }
+  void Load(JSONReader* reader) {
+    std::map<std::string, std::string> dict;
+    reader->Read(&dict);
+    Init(dict);
+  }
+
+ protected:
+  static param::ParamManager& Manager() {
+    static param::ParamManager mgr = [] {
+      param::ParamManager m;
+      PType dummy;
+      param::DeclareHelper<PType> helper(&m, &dummy);
+      dummy.__DECLARE__(&helper);
+      return m;
+    }();
+    return mgr;
+  }
+
+ private:
+  void* Head() { return static_cast<void*>(static_cast<PType*>(this)); }
+};
+
+#define DMLCTPU_DECLARE_PARAMETER(PType) \
+  void __DECLARE__(::dmlctpu::param::DeclareHelper<PType>* __helper__)
+#define DMLCTPU_DECLARE_FIELD(FieldName) __helper__->Declare(#FieldName, &this->FieldName)
+#define DMLCTPU_DECLARE_ALIAS(FieldName, AliasName) \
+  __helper__->Alias(#FieldName, #AliasName)
+
+// ---- environment variables (parity: dmlc::GetEnv/SetEnv) -------------------
+template <typename T>
+inline T GetEnv(const char* key, T default_value) {
+  const char* v = std::getenv(key);
+  if (v == nullptr) return default_value;
+  T out{};
+  if (!param::ValueFromString(std::string(v), &out)) return default_value;
+  return out;
+}
+inline std::string GetEnv(const char* key, const char* default_value) {
+  const char* v = std::getenv(key);
+  return v == nullptr ? std::string(default_value) : std::string(v);
+}
+template <typename T>
+inline void SetEnv(const char* key, const T& value) {
+  ::setenv(key, param::ValueToString(value).c_str(), 1);
+}
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_PARAMETER_H_

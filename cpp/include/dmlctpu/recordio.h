@@ -1,0 +1,81 @@
+// dmlctpu/recordio.h — the splittable binary record container.
+// Parity: reference include/dmlc/recordio.h + src/recordio.cc.
+// Wire format (identical so existing .rec files interop):
+//   [kMagic u32][lrec u32][payload][zero-pad to 4B]
+//   lrec = (cflag << 29) | length,  length <= 2^29-1
+//   cflag: 0 whole record; 1/2/3 = first/middle/last piece of a record that
+//   was split wherever the payload contains an aligned magic word.
+// Reader/Writer operate over any Stream; ChunkReader iterates records
+// zero-copy inside an in-memory chunk and subdivides for worker threads.
+#ifndef DMLCTPU_RECORDIO_H_
+#define DMLCTPU_RECORDIO_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "./stream.h"
+
+namespace dmlctpu {
+
+class RecordIOWriter {
+ public:
+  static constexpr uint32_t kMagic = 0xced7230a;
+
+  explicit RecordIOWriter(Stream* stream) : stream_(stream) {}
+
+  /*! \brief write one record (any bytes; in-payload magics are escaped) */
+  void WriteRecord(const void* buf, size_t size);
+  void WriteRecord(const std::string& data) { WriteRecord(data.data(), data.size()); }
+
+  /*! \brief number of payload magic collisions escaped so far (test hook) */
+  uint64_t except_counter() const { return except_counter_; }
+
+  static uint32_t EncodeHeader(uint32_t cflag, uint32_t len) {
+    return (cflag << 29u) | len;
+  }
+  static uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29u) & 7u; }
+  static uint32_t DecodeLength(uint32_t rec) { return rec & ((1u << 29u) - 1u); }
+
+ private:
+  Stream* stream_;
+  uint64_t except_counter_ = 0;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(Stream* stream) : stream_(stream) {}
+  /*! \brief read next logical record; false at end of stream */
+  bool NextRecord(std::string* out);
+
+ private:
+  Stream* stream_;
+  bool eos_ = false;
+};
+
+/*!
+ * \brief zero-copy record iterator over one in-memory chunk; constructor takes
+ *        (part_index, num_parts) so N threads can split a chunk by byte range
+ *        aligned to record headers.
+ */
+class RecordIOChunkReader {
+ public:
+  struct Blob {
+    char* dptr = nullptr;
+    size_t size = 0;
+  };
+  explicit RecordIOChunkReader(Blob chunk, unsigned part_index = 0, unsigned num_parts = 1);
+  /*!
+   * \brief get next record; out points into the chunk when the record is
+   *        contiguous, else into an internal reassembly buffer.
+   */
+  bool NextRecord(Blob* out);
+
+ private:
+  char* pbegin_;
+  char* pend_;
+  std::string temp_;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_RECORDIO_H_

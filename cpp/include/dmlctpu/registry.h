@@ -1,0 +1,148 @@
+// dmlctpu/registry.h — global name→factory registries with aliases.
+// Parity: reference include/dmlc/registry.h (Registry:26-126, entry base
+// :150-226, macros :234-308).  Fresh design: the registry owns entries via
+// unique_ptr, is mutex-guarded (the reference is not thread-safe on
+// registration), and keeps insertion order for List().
+#ifndef DMLCTPU_REGISTRY_H_
+#define DMLCTPU_REGISTRY_H_
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "./logging.h"
+
+namespace dmlctpu {
+
+/*! \brief info about one declared parameter field, used by --help style docs */
+struct ParamFieldInfo {
+  std::string name;
+  std::string type;
+  std::string type_info_str;
+  std::string description;
+};
+
+/*!
+ * \brief base for registry entries: name + docs + declared arguments.
+ *        EntryType must CRTP-derive and may add a factory functor.
+ */
+template <typename EntryType>
+class FunctionRegEntryBase {
+ public:
+  std::string name;
+  std::string description;
+  std::vector<ParamFieldInfo> arguments;
+  std::string return_type;
+
+  EntryType& describe(const std::string& d) {
+    description = d;
+    return self();
+  }
+  EntryType& add_argument(const std::string& n, const std::string& type,
+                          const std::string& desc) {
+    arguments.push_back({n, type, type, desc});
+    return self();
+  }
+  EntryType& set_return_type(const std::string& t) {
+    return_type = t;
+    return self();
+  }
+
+ protected:
+  EntryType& self() { return *static_cast<EntryType*>(this); }
+};
+
+/*! \brief singleton registry of EntryType keyed by name, with alias support */
+template <typename EntryType>
+class Registry {
+ public:
+  static Registry* Get();
+
+  /*! \brief register (or fetch existing) entry under name */
+  EntryType& __REGISTER__(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    TCHECK_EQ(by_name_.count(name), 0u) << "entry '" << name << "' registered twice";
+    return RegisterLocked(name);
+  }
+  /*! \brief idempotent variant used by static initializers in headers */
+  EntryType& __REGISTER_OR_GET__(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_name_.find(name);
+    if (it != by_name_.end()) return *it->second;
+    return RegisterLocked(name);
+  }
+  void AddAlias(const std::string& key, const std::string& alias) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_name_.find(key);
+    TCHECK(it != by_name_.end()) << "cannot alias unknown entry '" << key << "'";
+    TCHECK_EQ(by_name_.count(alias), 0u) << "alias '" << alias << "' already taken";
+    by_name_[alias] = it->second;
+  }
+  /*! \brief find entry by name or alias; nullptr if absent */
+  const EntryType* Find(const std::string& name) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_name_.find(name);
+    return it == by_name_.end() ? nullptr : it->second;
+  }
+  /*! \brief all primary names in registration order */
+  std::vector<std::string> ListAllNames() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::string> out;
+    out.reserve(order_.size());
+    for (const auto& e : order_) out.push_back(e->name);
+    return out;
+  }
+  std::vector<const EntryType*> List() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<const EntryType*> out;
+    out.reserve(order_.size());
+    for (const auto& e : order_) out.push_back(e.get());
+    return out;
+  }
+
+ private:
+  EntryType& RegisterLocked(const std::string& name) {
+    auto e = std::make_unique<EntryType>();
+    e->name = name;
+    EntryType* ptr = e.get();
+    by_name_[name] = ptr;
+    order_.push_back(std::move(e));
+    return *ptr;
+  }
+
+  mutable std::mutex mu_;
+  std::map<std::string, EntryType*> by_name_;
+  std::vector<std::unique_ptr<EntryType>> order_;
+};
+
+/*!
+ * \brief put in exactly one .cc per EntryType to instantiate the singleton.
+ */
+#define DMLCTPU_REGISTRY_ENABLE(EntryType)              \
+  template <>                                           \
+  ::dmlctpu::Registry<EntryType>* ::dmlctpu::Registry<EntryType>::Get() { \
+    static ::dmlctpu::Registry<EntryType> inst;         \
+    return &inst;                                       \
+  }
+
+/*! \brief register an entry at static-init time */
+#define DMLCTPU_REGISTRY_REGISTER(EntryType, EntryTypeName, Name)    \
+  static EntryType& __make_##EntryTypeName##_##Name##__ =            \
+      ::dmlctpu::Registry<EntryType>::Get()->__REGISTER__(#Name)
+
+// Link-survival tags (parity: DMLC_REGISTRY_FILE_TAG / LINK_TAG): a static
+// library drops unreferenced objects, which silently loses registrations;
+// these macros create a symbol the consumer references to pin the object file.
+#define DMLCTPU_REGISTRY_FILE_TAG(UniqueTag) \
+  int __dmlctpu_registry_file_tag_##UniqueTag##__() { return 0; }
+#define DMLCTPU_REGISTRY_LINK_TAG(UniqueTag)                      \
+  int __dmlctpu_registry_file_tag_##UniqueTag##__();              \
+  static int __dmlctpu_registry_tag_value_##UniqueTag##__ =       \
+      __dmlctpu_registry_file_tag_##UniqueTag##__();
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_REGISTRY_H_

@@ -1,0 +1,311 @@
+// dmlctpu/serializer.h — typed, endian-stable serialization of arithmetic
+// types, PODs, strings and STL composites over a Stream.
+// Parity: reference include/dmlc/serializer.h (ArithmeticHandler byte-swap
+// :83-100, NativePODVectorHandler :127, SaveLoadClassHandler :102).
+// Fresh design using if-constexpr trait dispatch instead of the reference's
+// IfThenElse template metaprogram.
+#ifndef DMLCTPU_SERIALIZER_H_
+#define DMLCTPU_SERIALIZER_H_
+
+#include <cstdint>
+#include <list>
+#include <map>
+#include <set>
+#include <string>
+#include <type_traits>
+#include <unordered_map>
+#include <unordered_set>
+#include <utility>
+#include <vector>
+
+#include "./endian.h"
+
+namespace dmlctpu {
+class Stream;       // forward decl (stream.h includes us)
+class Serializable; // forward decl
+
+namespace serializer {
+
+// Primary template declared here; specializations/partials below.
+template <typename T, typename Enable = void>
+struct Handler;
+
+// ---- arithmetic scalars: endian-converted ---------------------------------
+template <typename T>
+struct ArithmeticHandler {
+  static void Write(Stream* s, const T& v);
+  static bool Read(Stream* s, T* v);
+};
+
+// ---- trivially-copyable non-arithmetic PODs: raw bytes (host endian) ------
+template <typename T>
+struct RawPODHandler {
+  static void Write(Stream* s, const T& v);
+  static bool Read(Stream* s, T* v);
+};
+
+// ---- classes with Save(Stream*)/Load(Stream*) -----------------------------
+template <typename T>
+struct SaveLoadHandler {
+  static void Write(Stream* s, const T& v) { v.Save(s); }
+  static bool Read(Stream* s, T* v) {
+    v->Load(s);
+    return true;
+  }
+};
+
+template <typename T, typename = void>
+struct HasSaveLoad : std::false_type {};
+template <typename T>
+struct HasSaveLoad<T, std::void_t<decltype(std::declval<const T&>().Save(
+                          static_cast<Stream*>(nullptr))),
+                      decltype(std::declval<T&>().Load(static_cast<Stream*>(nullptr)))>>
+    : std::true_type {};
+
+template <typename T, typename Enable>
+struct Handler {
+  static void Write(Stream* s, const T& v) {
+    if constexpr (std::is_arithmetic_v<T> || std::is_enum_v<T>) {
+      ArithmeticHandler<T>::Write(s, v);
+    } else if constexpr (HasSaveLoad<T>::value) {
+      SaveLoadHandler<T>::Write(s, v);
+    } else {
+      static_assert(std::is_trivially_copyable_v<T>,
+                    "type is not serializable: add Save/Load or make it trivially copyable");
+      RawPODHandler<T>::Write(s, v);
+    }
+  }
+  static bool Read(Stream* s, T* v) {
+    if constexpr (std::is_arithmetic_v<T> || std::is_enum_v<T>) {
+      return ArithmeticHandler<T>::Read(s, v);
+    } else if constexpr (HasSaveLoad<T>::value) {
+      return SaveLoadHandler<T>::Read(s, v);
+    } else {
+      static_assert(std::is_trivially_copyable_v<T>,
+                    "type is not serializable: add Save/Load or make it trivially copyable");
+      return RawPODHandler<T>::Read(s, v);
+    }
+  }
+};
+
+// ---- length-prefixed sequence helpers -------------------------------------
+template <typename Seq>
+struct SeqHandler {
+  static void Write(Stream* s, const Seq& seq) {
+    uint64_t n = seq.size();
+    Handler<uint64_t>::Write(s, n);
+    for (const auto& item : seq) Handler<typename Seq::value_type>::Write(s, item);
+  }
+};
+
+// vector<T>: contiguous fast path for arithmetic T
+template <typename T, typename A>
+struct Handler<std::vector<T, A>> {
+  static void Write(Stream* s, const std::vector<T, A>& v);
+  static bool Read(Stream* s, std::vector<T, A>* v);
+};
+
+template <typename C, typename Tr, typename A>
+struct Handler<std::basic_string<C, Tr, A>> {
+  static void Write(Stream* s, const std::basic_string<C, Tr, A>& v);
+  static bool Read(Stream* s, std::basic_string<C, Tr, A>* v);
+};
+
+template <typename A, typename B>
+struct Handler<std::pair<A, B>> {
+  static void Write(Stream* s, const std::pair<A, B>& v) {
+    Handler<A>::Write(s, v.first);
+    Handler<B>::Write(s, v.second);
+  }
+  static bool Read(Stream* s, std::pair<A, B>* v) {
+    return Handler<A>::Read(s, &v->first) && Handler<B>::Read(s, &v->second);
+  }
+};
+
+template <typename Container>
+struct AssocHandler {
+  static void Write(Stream* s, const Container& c) {
+    uint64_t n = c.size();
+    Handler<uint64_t>::Write(s, n);
+    for (const auto& item : c) {
+      // map iteration yields pair<const K, V>; strip the const for dispatch
+      if constexpr (requires { item.first; item.second; }) {
+        Handler<std::decay_t<decltype(item.first)>>::Write(s, item.first);
+        Handler<std::decay_t<decltype(item.second)>>::Write(s, item.second);
+      } else {
+        Handler<std::decay_t<decltype(item)>>::Write(s, item);
+      }
+    }
+  }
+};
+
+template <typename K, typename V, typename C, typename A>
+struct Handler<std::map<K, V, C, A>> {
+  static void Write(Stream* s, const std::map<K, V, C, A>& m) { AssocHandler<std::map<K, V, C, A>>::Write(s, m); }
+  static bool Read(Stream* s, std::map<K, V, C, A>* m) {
+    uint64_t n;
+    if (!Handler<uint64_t>::Read(s, &n)) return false;
+    m->clear();
+    for (uint64_t i = 0; i < n; ++i) {
+      std::pair<K, V> kv;
+      if (!Handler<std::pair<K, V>>::Read(s, &kv)) return false;
+      m->emplace(std::move(kv));
+    }
+    return true;
+  }
+};
+template <typename K, typename V, typename H, typename E, typename A>
+struct Handler<std::unordered_map<K, V, H, E, A>> {
+  static void Write(Stream* s, const std::unordered_map<K, V, H, E, A>& m) {
+    AssocHandler<std::unordered_map<K, V, H, E, A>>::Write(s, m);
+  }
+  static bool Read(Stream* s, std::unordered_map<K, V, H, E, A>* m) {
+    uint64_t n;
+    if (!Handler<uint64_t>::Read(s, &n)) return false;
+    m->clear();
+    for (uint64_t i = 0; i < n; ++i) {
+      std::pair<K, V> kv;
+      if (!Handler<std::pair<K, V>>::Read(s, &kv)) return false;
+      m->emplace(std::move(kv));
+    }
+    return true;
+  }
+};
+template <typename K, typename C, typename A>
+struct Handler<std::set<K, C, A>> {
+  static void Write(Stream* s, const std::set<K, C, A>& c) { AssocHandler<std::set<K, C, A>>::Write(s, c); }
+  static bool Read(Stream* s, std::set<K, C, A>* c) {
+    uint64_t n;
+    if (!Handler<uint64_t>::Read(s, &n)) return false;
+    c->clear();
+    for (uint64_t i = 0; i < n; ++i) {
+      K k;
+      if (!Handler<K>::Read(s, &k)) return false;
+      c->insert(std::move(k));
+    }
+    return true;
+  }
+};
+template <typename K, typename H, typename E, typename A>
+struct Handler<std::unordered_set<K, H, E, A>> {
+  static void Write(Stream* s, const std::unordered_set<K, H, E, A>& c) {
+    AssocHandler<std::unordered_set<K, H, E, A>>::Write(s, c);
+  }
+  static bool Read(Stream* s, std::unordered_set<K, H, E, A>* c) {
+    uint64_t n;
+    if (!Handler<uint64_t>::Read(s, &n)) return false;
+    c->clear();
+    for (uint64_t i = 0; i < n; ++i) {
+      K k;
+      if (!Handler<K>::Read(s, &k)) return false;
+      c->insert(std::move(k));
+    }
+    return true;
+  }
+};
+template <typename T, typename A>
+struct Handler<std::list<T, A>> {
+  static void Write(Stream* s, const std::list<T, A>& c) { SeqHandler<std::list<T, A>>::Write(s, c); }
+  static bool Read(Stream* s, std::list<T, A>* c) {
+    uint64_t n;
+    if (!Handler<uint64_t>::Read(s, &n)) return false;
+    c->clear();
+    for (uint64_t i = 0; i < n; ++i) {
+      T t;
+      if (!Handler<T>::Read(s, &t)) return false;
+      c->push_back(std::move(t));
+    }
+    return true;
+  }
+};
+
+}  // namespace serializer
+}  // namespace dmlctpu
+
+// Out-of-line definitions that need the full Stream type.
+#include "./stream.h"
+
+namespace dmlctpu {
+namespace serializer {
+
+template <typename T>
+inline void ArithmeticHandler<T>::Write(Stream* s, const T& v) {
+  if constexpr (sizeof(T) > 1) {
+    if (kIONeedsByteSwap) {
+      T tmp = v;
+      ByteSwap(&tmp, sizeof(T), 1);
+      s->Write(&tmp, sizeof(T));
+      return;
+    }
+  }
+  s->Write(&v, sizeof(T));
+}
+template <typename T>
+inline bool ArithmeticHandler<T>::Read(Stream* s, T* v) {
+  if (s->Read(v, sizeof(T)) != sizeof(T)) return false;
+  if constexpr (sizeof(T) > 1) {
+    if (kIONeedsByteSwap) ByteSwap(v, sizeof(T), 1);
+  }
+  return true;
+}
+
+template <typename T>
+inline void RawPODHandler<T>::Write(Stream* s, const T& v) {
+  s->Write(&v, sizeof(T));
+}
+template <typename T>
+inline bool RawPODHandler<T>::Read(Stream* s, T* v) {
+  return s->Read(v, sizeof(T)) == sizeof(T);
+}
+
+template <typename T, typename A>
+inline void Handler<std::vector<T, A>>::Write(Stream* s, const std::vector<T, A>& v) {
+  uint64_t n = v.size();
+  Handler<uint64_t>::Write(s, n);
+  if constexpr (std::is_arithmetic_v<T>) {
+    if (!kIONeedsByteSwap || sizeof(T) == 1) {
+      if (n != 0) s->Write(v.data(), n * sizeof(T));
+      return;
+    }
+  }
+  for (const auto& item : v) Handler<T>::Write(s, item);
+}
+template <typename T, typename A>
+inline bool Handler<std::vector<T, A>>::Read(Stream* s, std::vector<T, A>* v) {
+  uint64_t n;
+  if (!Handler<uint64_t>::Read(s, &n)) return false;
+  v->resize(n);
+  if constexpr (std::is_arithmetic_v<T>) {
+    if (n == 0) return true;
+    if (s->Read(v->data(), n * sizeof(T)) != n * sizeof(T)) return false;
+    if (kIONeedsByteSwap && sizeof(T) > 1) ByteSwap(v->data(), sizeof(T), n);
+    return true;
+  } else {
+    for (auto& item : *v) {
+      if (!Handler<T>::Read(s, &item)) return false;
+    }
+    return true;
+  }
+}
+
+template <typename C, typename Tr, typename A>
+inline void Handler<std::basic_string<C, Tr, A>>::Write(Stream* s,
+                                                        const std::basic_string<C, Tr, A>& v) {
+  static_assert(sizeof(C) == 1, "only byte strings are serializable");
+  uint64_t n = v.size();
+  Handler<uint64_t>::Write(s, n);
+  if (n != 0) s->Write(v.data(), n);
+}
+template <typename C, typename Tr, typename A>
+inline bool Handler<std::basic_string<C, Tr, A>>::Read(Stream* s,
+                                                       std::basic_string<C, Tr, A>* v) {
+  uint64_t n;
+  if (!Handler<uint64_t>::Read(s, &n)) return false;
+  v->resize(n);
+  if (n == 0) return true;
+  return s->Read(v->data(), n) == n;
+}
+
+}  // namespace serializer
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SERIALIZER_H_

@@ -1,0 +1,91 @@
+// dmlctpu/stream.h — the byte-stream abstraction everything above reads and
+// writes through.  Parity: reference include/dmlc/io.h Stream (:30),
+// SeekStream (:109), Serializable (:132), Stream::Create / factory (src/io.cc:132-144).
+// Typed Write<T>/Read<T> dispatch into serializer.h and are endian-stable.
+#ifndef DMLCTPU_STREAM_H_
+#define DMLCTPU_STREAM_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "./logging.h"
+
+namespace dmlctpu {
+
+/*! \brief abstract byte stream (sequential read/write) */
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  /*!
+   * \brief read up to size bytes into ptr
+   * \return bytes actually read; 0 at end-of-stream
+   */
+  virtual size_t Read(void* ptr, size_t size) = 0;
+  /*! \brief write size bytes from ptr (throws on failure) */
+  virtual size_t Write(const void* ptr, size_t size) = 0;
+
+  /*!
+   * \brief open a stream from a URI.
+   * \param uri  file path or protocol URI (file://, mem://ref not supported here)
+   * \param mode "r", "w", or "a"
+   * \param allow_null when true, return nullptr instead of throwing if the
+   *        target cannot be opened
+   */
+  static std::unique_ptr<Stream> Create(const char* uri, const char* mode,
+                                        bool allow_null = false);
+
+  /*! \brief typed serialization — endian-stable, STL-composite aware */
+  template <typename T>
+  void WriteObj(const T& obj);
+  template <typename T>
+  bool ReadObj(T* obj);
+
+  /*! \brief read exactly size bytes or fatally error */
+  void ReadAll(void* ptr, size_t size) {
+    size_t got = 0;
+    while (got < size) {
+      size_t n = Read(static_cast<char*>(ptr) + got, size - got);
+      TCHECK_GT(n, 0u) << "unexpected end of stream (wanted " << size << " got " << got << ")";
+      got += n;
+    }
+  }
+};
+
+/*! \brief stream with random access on the read side */
+class SeekStream : public Stream {
+ public:
+  virtual void Seek(size_t pos) = 0;
+  virtual size_t Tell() = 0;
+  /*! \brief whether read cursor is at end of stream */
+  virtual bool AtEnd() {
+    // default: probe via tell/seek is not generally possible; subclasses override
+    return false;
+  }
+  static std::unique_ptr<SeekStream> CreateForRead(const char* uri, bool allow_null = false);
+};
+
+/*! \brief interface of objects that persist through a Stream */
+class Serializable {
+ public:
+  virtual ~Serializable() = default;
+  virtual void Save(Stream* fo) const = 0;
+  virtual void Load(Stream* fi) = 0;
+};
+
+}  // namespace dmlctpu
+
+#include "./serializer.h"
+
+namespace dmlctpu {
+template <typename T>
+inline void Stream::WriteObj(const T& obj) {
+  serializer::Handler<T>::Write(this, obj);
+}
+template <typename T>
+inline bool Stream::ReadObj(T* obj) {
+  return serializer::Handler<T>::Read(this, obj);
+}
+}  // namespace dmlctpu
+#endif  // DMLCTPU_STREAM_H_

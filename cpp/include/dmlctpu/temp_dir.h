@@ -1,0 +1,41 @@
+// dmlctpu/temp_dir.h — scoped temporary directory (heavily used by tests).
+// Parity: reference include/dmlc/filesystem.h TemporaryDirectory (:54) +
+// RecursiveDelete (src/io/filesys.cc:29), on std::filesystem.
+#ifndef DMLCTPU_TEMP_DIR_H_
+#define DMLCTPU_TEMP_DIR_H_
+
+#include <cstdlib>
+#include <filesystem>
+#include <string>
+
+#include "./logging.h"
+
+namespace dmlctpu {
+
+/*! \brief mkdtemp-style directory removed (recursively) on destruction */
+class TemporaryDirectory {
+ public:
+  explicit TemporaryDirectory(bool verbose = false) : verbose_(verbose) {
+    namespace fs = std::filesystem;
+    std::string tmpl = (fs::temp_directory_path() / "dmlctpu.XXXXXX").string();
+    char* buf = tmpl.data();
+    TCHECK(::mkdtemp(buf) != nullptr) << "failed to create temporary directory";
+    path = std::string(buf);
+    if (verbose_) TLOG(Info) << "created temporary directory " << path;
+  }
+  ~TemporaryDirectory() {
+    std::error_code ec;
+    std::filesystem::remove_all(path, ec);
+    if (verbose_ && !ec) TLOG(Info) << "deleted temporary directory " << path;
+  }
+  TemporaryDirectory(const TemporaryDirectory&) = delete;
+  TemporaryDirectory& operator=(const TemporaryDirectory&) = delete;
+
+  std::string path;
+
+ private:
+  bool verbose_;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_TEMP_DIR_H_

@@ -1,0 +1,261 @@
+// dmlctpu/threaded_iter.h — the bounded-buffer prefetch iterator that the
+// whole data pipeline is built on.
+// Parity: reference include/dmlc/threadediter.h (Init:328, Next:441,
+// Recycle:474, BeforeFirst:207, Destroy:282, ThrowExceptionIfSet:488,
+// set_max_capacity:134) — same contract, fresh state machine:
+//   * one producer thread calls next(&cell); cells are recycled through a
+//     free list so steady-state runs allocation-free;
+//   * BeforeFirst drains the queue, asks the producer to reset the source,
+//     and blocks until the reset is acknowledged;
+//   * any exception thrown by the producer is captured and rethrown on the
+//     consumer thread at the next Next()/BeforeFirst();
+//   * Destroy()/dtor joins the producer deterministically.
+#ifndef DMLCTPU_THREADED_ITER_H_
+#define DMLCTPU_THREADED_ITER_H_
+
+#include <condition_variable>
+#include <deque>
+#include <exception>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "./data_iter.h"
+#include "./logging.h"
+
+namespace dmlctpu {
+
+/*! \brief RAII thread that joins on destruction */
+class ScopedThread {
+ public:
+  ScopedThread() = default;
+  explicit ScopedThread(std::thread t) : t_(std::move(t)) {}
+  ScopedThread(ScopedThread&&) = default;
+  ScopedThread& operator=(ScopedThread&& o) {
+    Join();
+    t_ = std::move(o.t_);
+    return *this;
+  }
+  ~ScopedThread() { Join(); }
+  void Join() {
+    if (t_.joinable()) t_.join();
+  }
+
+ private:
+  std::thread t_;
+};
+
+template <typename DType>
+class ThreadedIter : public DataIter<DType> {
+ public:
+  /*!
+   * \brief producer callback: fill **cell (allocate if *cell == nullptr,
+   *        else overwrite the recycled object); return false at end of data.
+   */
+  using NextFn = std::function<bool(DType** cell)>;
+  using BeforeFirstFn = std::function<void()>;
+
+  explicit ThreadedIter(size_t max_capacity = 8) : capacity_(max_capacity) {}
+  ~ThreadedIter() override { Destroy(); }
+
+  void set_max_capacity(size_t n) { capacity_ = n; }
+
+  void Init(NextFn next, BeforeFirstFn before_first = BeforeFirstFn()) {
+    TCHECK(!producer_.joinable_marker) << "ThreadedIter::Init called twice";
+    next_fn_ = std::move(next);
+    before_first_fn_ = std::move(before_first);
+    producer_.joinable_marker = true;
+    producer_.thread = ScopedThread(std::thread([this] { ProducerLoop(); }));
+  }
+
+  /*!
+   * \brief get next item; *out_ptr points at a cell owned by the iterator.
+   *        Call Recycle(&ptr) when done to return the cell.
+   */
+  bool Next(DType** out_ptr) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_consumer_.wait(lk, [this] {
+      return !queue_.empty() || state_ == State::kEnd || destroyed_;
+    });
+    // deliver every successfully-produced item before surfacing an exception:
+    // keeps consumption deterministic when the producer dies mid-stream
+    if (queue_.empty()) {
+      ThrowIfSetLocked();
+      return false;
+    }
+    *out_ptr = queue_.front();
+    queue_.pop_front();
+    lk.unlock();
+    cv_producer_.notify_one();
+    return true;
+  }
+  /*! \brief return a cell obtained from Next back to the free pool */
+  void Recycle(DType** inout_ptr) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      free_cells_.push_back(*inout_ptr);
+    }
+    *inout_ptr = nullptr;
+    cv_producer_.notify_one();
+  }
+
+  /*! \brief reset the underlying source and restart production */
+  void BeforeFirst() override {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (destroyed_) return;
+    ThrowIfSetLocked();
+    // reclaim everything still queued, then request reset
+    for (DType* c : queue_) free_cells_.push_back(c);
+    queue_.clear();
+    reset_requested_ = true;
+    ++generation_;  // invalidates any item the producer is filling right now
+    state_ = State::kRunning;
+    cv_producer_.notify_one();
+    cv_consumer_.wait(lk, [this] { return !reset_requested_ || destroyed_; });
+    ThrowIfSetLocked();
+  }
+
+  // DataIter surface: Next() + Value() pull interface over the cell API.
+  bool Next() override {
+    if (out_ != nullptr) {
+      Recycle(&out_);
+    }
+    return Next(&out_);
+  }
+  const DType& Value() const override {
+    TCHECK_NOTNULL(out_);
+    return *out_;
+  }
+
+  /*! \brief rethrow a pending producer exception, if any */
+  void ThrowExceptionIfSet() {
+    std::lock_guard<std::mutex> lk(mu_);
+    ThrowIfSetLocked();
+  }
+
+  /*! \brief stop the producer and free every cell */
+  void Destroy() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (destroyed_) return;
+      destroyed_ = true;
+    }
+    cv_producer_.notify_all();
+    cv_consumer_.notify_all();
+    producer_.thread.Join();
+    // single-threaded from here on
+    if (out_ != nullptr) {
+      delete out_;
+      out_ = nullptr;
+    }
+    for (DType* c : queue_) delete c;
+    for (DType* c : free_cells_) delete c;
+    queue_.clear();
+    free_cells_.clear();
+  }
+
+ private:
+  enum class State { kRunning, kEnd };
+
+  void ProducerLoop() {
+    uint64_t gen = 0;
+    while (true) {
+      DType* cell = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_producer_.wait(lk, [this] {
+          return destroyed_ || reset_requested_ ||
+                 (state_ == State::kRunning && queue_.size() < capacity_);
+        });
+        if (destroyed_) return;
+        if (reset_requested_) {
+          lk.unlock();
+          bool ok = true;
+          try {
+            if (before_first_fn_) before_first_fn_();
+          } catch (...) {
+            ok = false;
+            std::lock_guard<std::mutex> lk2(mu_);
+            if (!eptr_) eptr_ = std::current_exception();
+            state_ = State::kEnd;
+          }
+          {
+            std::lock_guard<std::mutex> lk2(mu_);
+            reset_requested_ = false;
+            if (!ok) state_ = State::kEnd;
+          }
+          cv_consumer_.notify_all();
+          continue;
+        }
+        if (!free_cells_.empty()) {
+          cell = free_cells_.back();
+          free_cells_.pop_back();
+        }
+        gen = generation_;
+      }
+      bool has_next = false;
+      try {
+        has_next = next_fn_(&cell);
+      } catch (...) {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (cell != nullptr) free_cells_.push_back(cell);
+        if (generation_ == gen) {
+          if (!eptr_) eptr_ = std::current_exception();
+          state_ = State::kEnd;
+          cv_consumer_.notify_all();
+        }
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      if (generation_ != gen) {
+        // a BeforeFirst() raced with this production: the item belongs to the
+        // previous epoch — drop it and service the reset on the next spin
+        if (cell != nullptr) free_cells_.push_back(cell);
+        continue;
+      }
+      if (has_next) {
+        queue_.push_back(cell);
+        cv_consumer_.notify_one();
+      } else {
+        if (cell != nullptr) free_cells_.push_back(cell);
+        state_ = State::kEnd;
+        cv_consumer_.notify_all();
+      }
+    }
+  }
+
+  void ThrowIfSetLocked() {
+    if (eptr_) {
+      std::exception_ptr e;
+      std::swap(e, eptr_);
+      std::rethrow_exception(e);
+    }
+  }
+
+  struct Producer {
+    ScopedThread thread;
+    bool joinable_marker = false;
+  };
+
+  std::mutex mu_;
+  std::condition_variable cv_producer_;
+  std::condition_variable cv_consumer_;
+  std::deque<DType*> queue_;
+  std::vector<DType*> free_cells_;
+  std::exception_ptr eptr_;
+  State state_ = State::kRunning;
+  uint64_t generation_ = 0;
+  bool reset_requested_ = false;
+  bool destroyed_ = false;
+  size_t capacity_;
+  NextFn next_fn_;
+  BeforeFirstFn before_first_fn_;
+  Producer producer_;
+  DType* out_ = nullptr;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_THREADED_ITER_H_

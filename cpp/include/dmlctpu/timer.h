@@ -1,0 +1,28 @@
+// dmlctpu/timer.h — monotonic wall clock in seconds.
+// Parity: reference include/dmlc/timer.h:27 GetTime(), on std::chrono.
+#ifndef DMLCTPU_TIMER_H_
+#define DMLCTPU_TIMER_H_
+
+#include <chrono>
+
+namespace dmlctpu {
+
+/*! \brief monotonic time in seconds since an arbitrary epoch */
+inline double GetTime() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch()).count();
+}
+
+/*! \brief simple scoped stopwatch */
+class Stopwatch {
+ public:
+  Stopwatch() : start_(GetTime()) {}
+  double Elapsed() const { return GetTime() - start_; }
+  void Reset() { start_ = GetTime(); }
+
+ private:
+  double start_;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_TIMER_H_

@@ -1,0 +1,29 @@
+// Default log sink: "[HH:MM:SS] SEVERITY file:line: msg" to stderr, unless a
+// custom sink is installed (the Python binding installs one that forwards
+// into the `logging` module).
+#include "dmlctpu/logging.h"
+
+#include <cstdio>
+#include <ctime>
+
+namespace dmlctpu {
+namespace log {
+
+void Emit(LogSeverity severity, const char* file, int line, const std::string& msg) {
+  Sink& sink = CustomSink();
+  if (sink) {
+    std::string where = std::string(file) + ":" + std::to_string(line);
+    sink(severity, where.c_str(), msg);
+    return;
+  }
+  std::time_t now = std::time(nullptr);
+  std::tm tm_buf;
+  localtime_r(&now, &tm_buf);
+  char ts[16];
+  std::strftime(ts, sizeof(ts), "%H:%M:%S", &tm_buf);
+  std::fprintf(stderr, "[%s] %s %s:%d: %s\n", ts, SeverityName(severity), file, line,
+               msg.c_str());
+}
+
+}  // namespace log
+}  // namespace dmlctpu

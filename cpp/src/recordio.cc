@@ -1,0 +1,158 @@
+// RecordIO codec implementation — wire-compatible with the reference format
+// (see /root/reference/src/recordio.cc:11-156 for the format contract):
+// a record whose payload contains the magic word at a 4-byte-aligned offset is
+// split there into pieces flagged first(1)/middle(2)/last(3); the in-payload
+// magic itself is elided on disk and re-inserted on read.  A record with no
+// aligned magic occurrence is a single piece with cflag 0.
+#include "dmlctpu/recordio.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace {
+
+constexpr uint32_t kAlign = 4;
+
+inline uint32_t RoundUp4(uint32_t n) { return (n + 3u) & ~3u; }
+
+inline bool IsMagicAt(const char* p) {
+  uint32_t w;
+  std::memcpy(&w, p, 4);
+  return w == RecordIOWriter::kMagic;
+}
+
+void WritePiece(Stream* s, uint32_t cflag, const char* data, uint32_t len, bool pad) {
+  const uint32_t magic = RecordIOWriter::kMagic;
+  const uint32_t lrec = RecordIOWriter::EncodeHeader(cflag, len);
+  s->Write(&magic, 4);
+  s->Write(&lrec, 4);
+  if (len != 0) s->Write(data, len);
+  if (pad) {
+    const uint32_t zero = 0;
+    uint32_t padded = RoundUp4(len);
+    if (padded != len) s->Write(&zero, padded - len);
+  }
+}
+
+}  // namespace
+
+void RecordIOWriter::WriteRecord(const void* buf, size_t size) {
+  TCHECK_LT(size, (1u << 29u)) << "RecordIO records are limited to 2^29-1 bytes";
+  const char* head = static_cast<const char*>(buf);
+  const uint32_t len = static_cast<uint32_t>(size);
+  // scan 4-byte-aligned positions for in-payload magic words
+  uint32_t piece_start = 0;
+  const uint32_t scan_end = len & ~3u;
+  for (uint32_t i = 0; i < scan_end; i += kAlign) {
+    if (IsMagicAt(head + i)) {
+      // emit everything before the collision as a first/middle piece;
+      // the magic word itself is dropped (re-inserted by readers)
+      WritePiece(stream_, piece_start == 0 ? 1u : 2u, head + piece_start,
+                 i - piece_start, /*pad=*/false);  // piece lengths here are 4-aligned
+      piece_start = i + kAlign;
+      ++except_counter_;
+    }
+  }
+  const uint32_t final_flag = (piece_start != 0) ? 3u : 0u;
+  WritePiece(stream_, final_flag, head + piece_start, len - piece_start, /*pad=*/true);
+}
+
+bool RecordIOReader::NextRecord(std::string* out) {
+  if (eos_) return false;
+  out->clear();
+  size_t size = 0;
+  while (true) {
+    uint32_t header[2];
+    size_t n = stream_->Read(header, sizeof(header));
+    if (n == 0) {
+      eos_ = true;
+      // mid-record EOF means the file lost the tail pieces of a split record
+      TCHECK_EQ(size, 0u) << "truncated RecordIO file: split record missing tail pieces";
+      return false;
+    }
+    TCHECK_EQ(n, sizeof(header)) << "truncated RecordIO header";
+    TCHECK_EQ(header[0], RecordIOWriter::kMagic) << "bad RecordIO magic";
+    const uint32_t cflag = RecordIOWriter::DecodeFlag(header[1]);
+    const uint32_t len = RecordIOWriter::DecodeLength(header[1]);
+    const uint32_t padded = RoundUp4(len);
+    out->resize(size + padded);
+    if (padded != 0) {
+      stream_->ReadAll(&(*out)[size], padded);
+    }
+    size += len;
+    out->resize(size);
+    if (cflag == 0u || cflag == 3u) return true;
+    // between pieces, restore the elided magic word
+    out->resize(size + kAlign);
+    const uint32_t magic = RecordIOWriter::kMagic;
+    std::memcpy(&(*out)[size], &magic, kAlign);
+    size += kAlign;
+  }
+}
+
+namespace {
+// Scan [begin,end) (both 4-aligned) for the next record head: magic followed
+// by a header whose cflag is "record start" (0 or 1).
+char* ScanForRecordHead(char* begin, char* end) {
+  TCHECK_EQ(reinterpret_cast<uintptr_t>(begin) & 3u, 0u) << "chunk not 4-byte aligned";
+  for (char* p = begin; p + 8 <= end; p += kAlign) {
+    if (IsMagicAt(p)) {
+      uint32_t lrec;
+      std::memcpy(&lrec, p + 4, 4);
+      const uint32_t cflag = RecordIOWriter::DecodeFlag(lrec);
+      if (cflag == 0u || cflag == 1u) return p;
+    }
+  }
+  return end;
+}
+}  // namespace
+
+RecordIOChunkReader::RecordIOChunkReader(Blob chunk, unsigned part_index, unsigned num_parts) {
+  size_t step = ((chunk.size + num_parts - 1) / num_parts + 3) & ~static_cast<size_t>(3);
+  size_t begin = std::min(chunk.size, step * part_index);
+  size_t end = std::min(chunk.size, step * (part_index + 1));
+  char* base = chunk.dptr;
+  pbegin_ = ScanForRecordHead(base + begin, base + chunk.size);
+  pend_ = ScanForRecordHead(base + end, base + chunk.size);
+}
+
+bool RecordIOChunkReader::NextRecord(Blob* out) {
+  if (pbegin_ >= pend_) return false;
+  uint32_t hdr[2];
+  std::memcpy(hdr, pbegin_, 8);
+  TCHECK_EQ(hdr[0], RecordIOWriter::kMagic) << "corrupt chunk: bad magic";
+  uint32_t cflag = RecordIOWriter::DecodeFlag(hdr[1]);
+  uint32_t len = RecordIOWriter::DecodeLength(hdr[1]);
+  if (cflag == 0u) {
+    // fast path: contiguous record, zero-copy view into the chunk
+    out->dptr = pbegin_ + 8;
+    out->size = len;
+    pbegin_ += 8 + RoundUp4(len);
+    TCHECK_LE(static_cast<const void*>(pbegin_), static_cast<const void*>(pend_))
+        << "corrupt RecordIO chunk";
+    return true;
+  }
+  TCHECK_EQ(cflag, 1u) << "corrupt chunk: expected record start";
+  // reassembly path: concatenate pieces with magic words restored between them
+  temp_.clear();
+  while (true) {
+    TCHECK_LE(static_cast<const void*>(pbegin_ + 8), static_cast<const void*>(pend_));
+    std::memcpy(hdr, pbegin_, 8);
+    TCHECK_EQ(hdr[0], RecordIOWriter::kMagic);
+    cflag = RecordIOWriter::DecodeFlag(hdr[1]);
+    len = RecordIOWriter::DecodeLength(hdr[1]);
+    temp_.append(pbegin_ + 8, len);
+    pbegin_ += 8 + RoundUp4(len);
+    if (cflag == 3u) break;
+    const uint32_t magic = RecordIOWriter::kMagic;
+    temp_.append(reinterpret_cast<const char*>(&magic), 4);
+  }
+  out->dptr = temp_.empty() ? nullptr : &temp_[0];
+  out->size = temp_.size();
+  return true;
+}
+
+}  // namespace dmlctpu

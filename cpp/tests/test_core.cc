@@ -1,0 +1,393 @@
+// Unit tests for L0-L3: strtonum, serializer, memory_io, json, parameter,
+// registry, recordio codec, ThreadedIter, blocking queue, temp dir.
+// Mirrors the reference's unittest_{serializer,json,param,threaditer,
+// recordio...}.cc coverage (test strategy: SURVEY.md §4.1).
+#include <atomic>
+#include <map>
+#include <optional>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dmlctpu/concurrency.h"
+#include "dmlctpu/io/filesystem.h"
+#include "dmlctpu/json.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/memory_io.h"
+#include "dmlctpu/parameter.h"
+#include "dmlctpu/recordio.h"
+#include "dmlctpu/registry.h"
+#include "dmlctpu/strtonum.h"
+#include "dmlctpu/temp_dir.h"
+#include "dmlctpu/threaded_iter.h"
+#include "testing.h"
+
+using namespace dmlctpu;  // NOLINT
+
+TESTCASE(strtonum_basic) {
+  std::string s = "  3.14 -2e3 42:0.5 1:2:3 nope";
+  const char* p = s.c_str();
+  const char* end = p + s.size();
+  EXPECT_TRUE(std::abs(ParseNum<double>(&p, end) - 3.14) < 1e-12);
+  EXPECT_EQV(ParseNum<float>(&p, end), -2000.0f);
+  uint32_t idx;
+  float val;
+  EXPECT_TRUE((ParsePair<uint32_t, float>(&p, end, ':', &idx, &val)));
+  EXPECT_EQV(idx, 42u);
+  EXPECT_EQV(val, 0.5f);
+  uint32_t a, b;
+  float c;
+  EXPECT_TRUE((ParseTriple<uint32_t, uint32_t, float>(&p, end, ':', &a, &b, &c)));
+  EXPECT_EQV(a, 1u);
+  EXPECT_EQV(b, 2u);
+  EXPECT_EQV(c, 3.0f);
+  int bad;
+  EXPECT_TRUE(!TryParseNum(&p, end, &bad));
+}
+
+TESTCASE(serializer_roundtrip) {
+  std::string buf;
+  MemoryStringStream ms(&buf);
+  std::vector<int> vi{1, 2, 3, -7};
+  std::map<std::string, std::vector<double>> m{{"a", {1.5, 2.5}}, {"b", {}}};
+  std::set<uint64_t> st{9, 8, 7};
+  std::pair<std::string, float> pr{"hello", 0.25f};
+  ms.WriteObj(vi);
+  ms.WriteObj(m);
+  ms.WriteObj(st);
+  ms.WriteObj(pr);
+  ms.Seek(0);
+  std::vector<int> vi2;
+  std::map<std::string, std::vector<double>> m2;
+  std::set<uint64_t> st2;
+  std::pair<std::string, float> pr2;
+  EXPECT_TRUE(ms.ReadObj(&vi2));
+  EXPECT_TRUE(ms.ReadObj(&m2));
+  EXPECT_TRUE(ms.ReadObj(&st2));
+  EXPECT_TRUE(ms.ReadObj(&pr2));
+  EXPECT_TRUE(vi == vi2);
+  EXPECT_TRUE(m == m2);
+  EXPECT_TRUE(st == st2);
+  EXPECT_TRUE(pr == pr2);
+}
+
+TESTCASE(serializer_golden_little_endian) {
+  // the on-wire format is little-endian regardless of host
+  std::string buf;
+  MemoryStringStream ms(&buf);
+  uint32_t v = 0x01020304u;
+  ms.WriteObj(v);
+  EXPECT_EQV(buf.size(), 4u);
+  EXPECT_EQV(static_cast<unsigned char>(buf[0]), 0x04u);
+  EXPECT_EQV(static_cast<unsigned char>(buf[3]), 0x01u);
+}
+
+TESTCASE(json_roundtrip) {
+  std::ostringstream os;
+  JSONWriter w(&os);
+  std::map<std::string, std::vector<int>> m{{"xs", {1, 2, 3}}, {"ys", {}}};
+  w.Write(m);
+  std::istringstream is(os.str());
+  JSONReader r(&is);
+  std::map<std::string, std::vector<int>> m2;
+  r.Read(&m2);
+  EXPECT_TRUE(m == m2);
+}
+
+TESTCASE(json_bool_int64_controlchar_roundtrip) {
+  std::ostringstream os;
+  JSONWriter w(&os);
+  w.BeginObject();
+  w.WriteObjectKeyValue("flag", true);
+  w.WriteObjectKeyValue("big", int64_t{9007199254740993});  // 2^53 + 1
+  w.WriteObjectKeyValue("ctrl", std::string("a\x08\x1f") + "b");
+  w.EndObject();
+  std::string text = os.str();
+  EXPECT_TRUE(text.find("\\u001f") != std::string::npos);
+  std::istringstream is(text);
+  JSONReader r(&is);
+  bool flag = false;
+  int64_t big = 0;
+  std::string ctrl;
+  JSONObjectReadHelper helper;
+  helper.DeclareField("flag", &flag);
+  helper.DeclareField("big", &big);
+  helper.DeclareField("ctrl", &ctrl);
+  helper.ReadAllFields(&r);
+  EXPECT_EQV(flag, true);
+  EXPECT_EQV(big, int64_t{9007199254740993});
+  EXPECT_EQV(ctrl, std::string("a\x08\x1f") + "b");
+}
+
+TESTCASE(json_object_helper) {
+  std::istringstream is(R"({"name": "tpu", "count": 8, "scale": 1.5})");
+  JSONReader r(&is);
+  std::string name;
+  int count = 0;
+  double scale = 0, missing = 7;
+  JSONObjectReadHelper helper;
+  helper.DeclareField("name", &name);
+  helper.DeclareField("count", &count);
+  helper.DeclareField("scale", &scale);
+  helper.DeclareOptionalField("missing", &missing);
+  helper.ReadAllFields(&r);
+  EXPECT_EQV(name, "tpu");
+  EXPECT_EQV(count, 8);
+  EXPECT_EQV(scale, 1.5);
+  EXPECT_EQV(missing, 7.0);
+}
+
+// ---- parameter system -------------------------------------------------------
+struct TestParam : public Parameter<TestParam> {
+  float lr;
+  int num_hidden;
+  std::string act;
+  bool verbose;
+  std::optional<int> seed;
+  DMLCTPU_DECLARE_PARAMETER(TestParam) {
+    DMLCTPU_DECLARE_FIELD(lr).set_default(0.01f).set_range(0.0f, 1.0f).describe("learning rate");
+    DMLCTPU_DECLARE_FIELD(num_hidden).set_lower_bound(1).describe("hidden units");
+    DMLCTPU_DECLARE_FIELD(act).set_default("relu").describe("activation");
+    DMLCTPU_DECLARE_FIELD(verbose).set_default(false);
+    DMLCTPU_DECLARE_FIELD(seed).set_default(std::nullopt);
+    DMLCTPU_DECLARE_ALIAS(lr, learning_rate);
+  }
+};
+
+TESTCASE(param_init_defaults_and_alias) {
+  TestParam p;
+  std::map<std::string, std::string> kw{{"num_hidden", "100"}, {"learning_rate", "0.5"}};
+  p.Init(kw);
+  EXPECT_EQV(p.lr, 0.5f);
+  EXPECT_EQV(p.num_hidden, 100);
+  EXPECT_EQV(p.act, "relu");
+  EXPECT_TRUE(!p.seed.has_value());
+  auto d = p.__DICT__();
+  EXPECT_EQV(d["lr"], "0.5");
+  EXPECT_EQV(d["seed"], "None");
+}
+
+TESTCASE(param_errors) {
+  TestParam p;
+  // missing required
+  EXPECT_THROWS(p.Init(std::map<std::string, std::string>{}));
+  // out of range
+  EXPECT_THROWS(p.Init(std::map<std::string, std::string>{{"num_hidden", "10"}, {"lr", "2.0"}}));
+  // below lower bound
+  EXPECT_THROWS(p.Init(std::map<std::string, std::string>{{"num_hidden", "0"}}));
+  // unknown key with suggestion
+  try {
+    p.Init(std::map<std::string, std::string>{{"num_hiden", "10"}});
+    EXPECT_TRUE(false);
+  } catch (const Error& e) {
+    EXPECT_TRUE(std::string(e.what()).find("num_hidden") != std::string::npos);
+  }
+}
+
+TESTCASE(param_update_and_json) {
+  TestParam p;
+  p.Init(std::map<std::string, std::string>{{"num_hidden", "10"}});
+  p.UpdateAllowUnknown(std::map<std::string, std::string>{{"lr", "0.25"}, {"bogus", "1"}});
+  EXPECT_EQV(p.lr, 0.25f);
+  EXPECT_EQV(p.num_hidden, 10);
+  std::ostringstream os;
+  JSONWriter w(&os);
+  p.Save(&w);
+  TestParam q;
+  std::istringstream is(os.str());
+  JSONReader r(&is);
+  q.Load(&r);
+  EXPECT_EQV(q.lr, 0.25f);
+  EXPECT_EQV(q.num_hidden, 10);
+}
+
+TESTCASE(param_doc) {
+  std::string doc = TestParam::__DOC__();
+  EXPECT_TRUE(doc.find("learning rate") != std::string::npos);
+  EXPECT_TRUE(doc.find("required") != std::string::npos);
+}
+
+// ---- registry ---------------------------------------------------------------
+struct TreeFactory : public FunctionRegEntryBase<TreeFactory> {
+  std::function<int()> body;
+};
+DMLCTPU_REGISTRY_ENABLE(TreeFactory);
+
+TESTCASE(registry_register_find_alias) {
+  auto& e = Registry<TreeFactory>::Get()->__REGISTER_OR_GET__("gbtree").describe("boosted trees");
+  e.body = [] { return 7; };
+  Registry<TreeFactory>::Get()->AddAlias("gbtree", "tree");
+  const TreeFactory* f = Registry<TreeFactory>::Get()->Find("tree");
+  EXPECT_TRUE(f != nullptr);
+  EXPECT_EQV(f->body(), 7);
+  EXPECT_TRUE(Registry<TreeFactory>::Get()->Find("nope") == nullptr);
+}
+
+// ---- recordio ---------------------------------------------------------------
+TESTCASE(recordio_roundtrip_with_magic_collisions) {
+  // adversarial payloads salted with the magic word (reference recordio_test.cc)
+  std::vector<std::string> records;
+  const uint32_t magic = RecordIOWriter::kMagic;
+  for (int i = 0; i < 64; ++i) {
+    std::string rec;
+    for (int j = 0; j < i; ++j) {
+      if (j % 3 == 0) {
+        rec.append(reinterpret_cast<const char*>(&magic), 4);
+      } else {
+        rec.append("abcd", (j % 4) + 1);
+      }
+    }
+    records.push_back(rec);
+  }
+  std::string buf;
+  {
+    MemoryStringStream ms(&buf);
+    RecordIOWriter writer(&ms);
+    for (const auto& r : records) writer.WriteRecord(r);
+    EXPECT_TRUE(writer.except_counter() > 0);
+  }
+  // stream reader
+  {
+    MemoryStringStream ms(&buf);
+    RecordIOReader reader(&ms);
+    std::string rec;
+    for (const auto& expect : records) {
+      EXPECT_TRUE(reader.NextRecord(&rec));
+      EXPECT_TRUE(rec == expect);
+    }
+    EXPECT_TRUE(!reader.NextRecord(&rec));
+  }
+  // chunk reader over the whole buffer, multi-part subdivision
+  for (unsigned nparts : {1u, 3u}) {
+    size_t count = 0;
+    for (unsigned part = 0; part < nparts; ++part) {
+      RecordIOChunkReader::Blob chunk{buf.data(), buf.size()};
+      RecordIOChunkReader reader(chunk, part, nparts);
+      RecordIOChunkReader::Blob rec;
+      while (reader.NextRecord(&rec)) {
+        EXPECT_TRUE(std::string(rec.dptr, rec.size) == records[count]);
+        ++count;
+      }
+    }
+    EXPECT_EQV(count, records.size());
+  }
+}
+
+// ---- ThreadedIter -----------------------------------------------------------
+TESTCASE(threaded_iter_produce_consume_recycle) {
+  ThreadedIter<int> iter(4);
+  int src = 0;
+  iter.Init([&src](int** cell) {
+    if (src >= 100) return false;
+    if (*cell == nullptr) *cell = new int();
+    **cell = src++;
+    return true;
+  }, [&src] { src = 0; });
+  for (int epoch = 0; epoch < 3; ++epoch) {
+    int expect = 0;
+    int* v = nullptr;
+    while (iter.Next(&v)) {
+      EXPECT_EQV(*v, expect++);
+      iter.Recycle(&v);
+    }
+    EXPECT_EQV(expect, 100);
+    iter.BeforeFirst();
+  }
+}
+
+TESTCASE(threaded_iter_exception_relay) {
+  ThreadedIter<int> iter(2);
+  int n = 0;
+  iter.Init([&n](int** cell) -> bool {
+    if (*cell == nullptr) *cell = new int();
+    if (n >= 3) throw Error("producer boom");
+    **cell = n++;
+    return true;
+  });
+  int got = 0;
+  bool threw = false;
+  try {
+    int* v = nullptr;
+    while (iter.Next(&v)) {
+      ++got;
+      iter.Recycle(&v);
+    }
+  } catch (const Error& e) {
+    threw = true;
+    EXPECT_TRUE(std::string(e.what()).find("boom") != std::string::npos);
+  }
+  EXPECT_TRUE(threw);
+  EXPECT_EQV(got, 3);
+}
+
+TESTCASE(blocking_queue_kill) {
+  ConcurrentBlockingQueue<int> q;
+  std::atomic<int> sum{0};
+  std::thread consumer([&] {
+    int v;
+    while (q.Pop(&v)) sum += v;
+  });
+  for (int i = 1; i <= 10; ++i) q.Push(i);
+  while (q.Size() != 0) std::this_thread::yield();
+  q.SignalForKill();
+  consumer.join();
+  EXPECT_EQV(sum.load(), 55);
+}
+
+// ---- filesystem -------------------------------------------------------------
+TESTCASE(uri_and_urispec) {
+  io::URI u("s3://bucket/key/part-001");
+  EXPECT_EQV(u.protocol, "s3://");
+  EXPECT_EQV(u.host, "bucket");
+  EXPECT_EQV(u.name, "/key/part-001");
+  io::URI plain("/tmp/x.txt");
+  EXPECT_EQV(plain.protocol, "");
+  EXPECT_EQV(plain.name, "/tmp/x.txt");
+  io::URISpec spec("hdfs:///data/?format=libsvm&indexing_mode=1#cachef", 2, 4);
+  EXPECT_EQV(spec.uri, "hdfs:///data/");
+  EXPECT_EQV(spec.args.at("format"), "libsvm");
+  EXPECT_EQV(spec.args.at("indexing_mode"), "1");
+  EXPECT_EQV(spec.cache_file, "cachef.split4.part2");
+  io::URISpec spec1("x.csv#c", 0, 1);
+  EXPECT_EQV(spec1.cache_file, "c");
+}
+
+TESTCASE(local_fs_roundtrip_and_listing) {
+  TemporaryDirectory tmp;
+  std::string fname = tmp.path + "/hello.bin";
+  {
+    auto out = Stream::Create(fname.c_str(), "w");
+    std::vector<uint64_t> xs{1, 2, 3};
+    out->WriteObj(xs);
+  }
+  {
+    auto in = SeekStream::CreateForRead(fname.c_str());
+    std::vector<uint64_t> xs;
+    EXPECT_TRUE(in->ReadObj(&xs));
+    EXPECT_EQV(xs.size(), 3u);
+    EXPECT_EQV(xs[2], 3u);
+  }
+  auto* fs = io::LocalFileSystem::GetInstance();
+  auto info = fs->GetPathInfo(io::URI(fname));
+  EXPECT_TRUE(info.size > 0);
+  EXPECT_TRUE(info.type == io::FileType::kFile);
+  std::vector<io::FileInfo> listing;
+  fs->ListDirectory(io::URI(tmp.path), &listing);
+  EXPECT_EQV(listing.size(), 1u);
+  EXPECT_TRUE(Stream::Create((tmp.path + "/no/such").c_str(), "r", true) == nullptr);
+}
+
+TESTCASE(check_macros_throw) {
+  EXPECT_THROWS(TCHECK_EQ(1, 2) << "nope");
+  try {
+    TCHECK_LT(5, 3) << "custom detail";
+  } catch (const Error& e) {
+    std::string w = e.what();
+    EXPECT_TRUE(w.find("5 vs 3") != std::string::npos);
+    EXPECT_TRUE(w.find("custom detail") != std::string::npos);
+  }
+}
+
+TESTMAIN()

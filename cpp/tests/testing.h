@@ -1,0 +1,94 @@
+// Minimal native test harness (no gtest in the image): TESTCASE registers a
+// function; main runs all, reports failures, exits nonzero on any failure.
+#ifndef DMLCTPU_TESTS_TESTING_H_
+#define DMLCTPU_TESTS_TESTING_H_
+
+#include <cstdio>
+#include <exception>
+#include <functional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace testing_mini {
+
+struct Case {
+  const char* name;
+  std::function<void()> fn;
+};
+inline std::vector<Case>& Cases() {
+  static std::vector<Case> cases;
+  return cases;
+}
+struct Registrar {
+  Registrar(const char* name, std::function<void()> fn) { Cases().push_back({name, fn}); }
+};
+
+struct Failure : std::exception {
+  explicit Failure(std::string m) : msg(std::move(m)) {}
+  const char* what() const noexcept override { return msg.c_str(); }
+  std::string msg;
+};
+
+inline int RunAll() {
+  int failed = 0;
+  for (const auto& c : Cases()) {
+    try {
+      c.fn();
+      std::printf("[ PASS ] %s\n", c.name);
+    } catch (const std::exception& e) {
+      std::printf("[ FAIL ] %s: %s\n", c.name, e.what());
+      ++failed;
+    }
+  }
+  std::printf("%zu cases, %d failed\n", Cases().size(), failed);
+  return failed == 0 ? 0 : 1;
+}
+
+}  // namespace testing_mini
+
+#define TESTCASE(name)                                                        \
+  static void test_fn_##name();                                               \
+  static ::testing_mini::Registrar reg_##name(#name, test_fn_##name);         \
+  static void test_fn_##name()
+
+#define EXPECT_TRUE(cond)                                                     \
+  do {                                                                        \
+    if (!(cond)) {                                                            \
+      std::ostringstream os_;                                                 \
+      os_ << __FILE__ << ":" << __LINE__ << " expected: " #cond;              \
+      throw ::testing_mini::Failure(os_.str());                               \
+    }                                                                         \
+  } while (0)
+
+#define EXPECT_EQV(a, b)                                                      \
+  do {                                                                        \
+    auto va_ = (a);                                                           \
+    auto vb_ = (b);                                                           \
+    if (!(va_ == vb_)) {                                                      \
+      std::ostringstream os_;                                                 \
+      os_ << __FILE__ << ":" << __LINE__ << " expected " #a " == " #b " ("    \
+          << va_ << " vs " << vb_ << ")";                                     \
+      throw ::testing_mini::Failure(os_.str());                               \
+    }                                                                         \
+  } while (0)
+
+#define EXPECT_THROWS(expr)                                                   \
+  do {                                                                        \
+    bool threw_ = false;                                                      \
+    try {                                                                     \
+      expr;                                                                   \
+    } catch (...) {                                                           \
+      threw_ = true;                                                          \
+    }                                                                         \
+    if (!threw_) {                                                            \
+      std::ostringstream os_;                                                 \
+      os_ << __FILE__ << ":" << __LINE__ << " expected " #expr " to throw";   \
+      throw ::testing_mini::Failure(os_.str());                               \
+    }                                                                         \
+  } while (0)
+
+#define TESTMAIN() \
+  int main() { return ::testing_mini::RunAll(); }
+
+#endif  // DMLCTPU_TESTS_TESTING_H_
