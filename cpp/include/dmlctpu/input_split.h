@@ -1,0 +1,67 @@
+// dmlctpu/input_split.h — sharded record input: partition a dataset (one or
+// many files, any registered filesystem) into num_parts byte ranges with
+// record-boundary healing at shard edges.
+// Parity: reference include/dmlc/io.h InputSplit (:155-301) and the engine in
+// src/io/input_split_base.* — same iteration surface (NextRecord / NextChunk /
+// NextBatch / BeforeFirst / ResetPartition / HintChunkSize / GetTotalSize)
+// and the same URI sugar ("a.txt;b.txt", trailing-component regex, directory
+// expansion, "#cachefile", "stdin").
+#ifndef DMLCTPU_INPUT_SPLIT_H_
+#define DMLCTPU_INPUT_SPLIT_H_
+
+#include <cstddef>
+#include <memory>
+#include <string>
+
+namespace dmlctpu {
+
+class InputSplit {
+ public:
+  /*! \brief a view into memory owned by the split */
+  struct Blob {
+    void* dptr = nullptr;
+    size_t size = 0;
+  };
+
+  virtual ~InputSplit() = default;
+
+  /*! \brief reset iteration to the beginning of this partition */
+  virtual void BeforeFirst() = 0;
+  /*!
+   * \brief get the next complete record; the blob stays valid until the next
+   *        call into the split.  Text records are '\0'-terminated in place.
+   */
+  virtual bool NextRecord(Blob* out) = 0;
+  /*! \brief get the next chunk of multiple complete records */
+  virtual bool NextChunk(Blob* out) = 0;
+  /*! \brief get a batch of approximately n_records records (indexed splits) */
+  virtual bool NextBatch(Blob* out, size_t n_records) { return NextChunk(out); }
+  /*! \brief re-target this split at another (rank, num_parts) partition */
+  virtual void ResetPartition(unsigned rank, unsigned num_parts) = 0;
+  /*! \brief suggest a chunk size (bytes) for NextChunk */
+  virtual void HintChunkSize(size_t /*chunk_size*/) {}
+  /*! \brief total byte size of the underlying dataset */
+  virtual size_t GetTotalSize() { return 0; }
+
+  /*!
+   * \brief create a sharded input split.
+   * \param uri        dataset URI; supports ';' lists, trailing-component
+   *                   regex, directories, '?k=v' args and '#cachefile' sugar;
+   *                   "stdin" reads standard input (no partitioning)
+   * \param part       this reader's partition index in [0, num_parts)
+   * \param num_parts  total number of partitions (data-parallel world size)
+   * \param type       "text" | "recordio" | "indexed_recordio"
+   */
+  static std::unique_ptr<InputSplit> Create(const char* uri, unsigned part,
+                                            unsigned num_parts, const char* type);
+
+  /*! \brief extended factory with indexed-recordio batching/shuffle controls */
+  static std::unique_ptr<InputSplit> Create(const char* uri, const char* index_uri,
+                                            unsigned part, unsigned num_parts,
+                                            const char* type, bool shuffle = false,
+                                            int seed = 0, size_t batch_size = 256,
+                                            bool recurse_directories = false);
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_INPUT_SPLIT_H_

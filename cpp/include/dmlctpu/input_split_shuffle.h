@@ -1,0 +1,96 @@
+// dmlctpu/input_split_shuffle.h — coarse global shuffle over an InputSplit:
+// each rank's partition is subdivided into num_shuffle_parts virtual
+// sub-splits visited in per-epoch shuffled order.  Record order inside a
+// sub-split is preserved; shuffling granularity is the sub-split.
+// Parity: reference include/dmlc/input_split_shuffle.h (Create:138, epoch
+// reshuffle with seed magic + part info :113).
+#ifndef DMLCTPU_INPUT_SPLIT_SHUFFLE_H_
+#define DMLCTPU_INPUT_SPLIT_SHUFFLE_H_
+
+#include <algorithm>
+#include <memory>
+#include <numeric>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "./input_split.h"
+#include "./logging.h"
+
+namespace dmlctpu {
+
+class InputSplitShuffle : public InputSplit {
+ public:
+  static constexpr int kRandMagic = 666;
+
+  /*!
+   * \brief create a shuffled split: rank `part` of `num_parts` reads its data
+   *        as `num_shuffle_parts` sub-splits in shuffled order.
+   */
+  static std::unique_ptr<InputSplit> Create(const char* uri, unsigned part,
+                                            unsigned num_parts, const char* type,
+                                            unsigned num_shuffle_parts, int seed) {
+    if (num_shuffle_parts <= 1) return InputSplit::Create(uri, part, num_parts, type);
+    return std::unique_ptr<InputSplit>(
+        new InputSplitShuffle(uri, part, num_parts, type, num_shuffle_parts, seed));
+  }
+
+  void BeforeFirst() override {
+    std::shuffle(order_.begin(), order_.end(), rnd_);  // new order each epoch
+    cursor_ = 0;
+    ActivateCurrent();
+  }
+  bool NextRecord(Blob* out) override {
+    while (!base_->NextRecord(out)) {
+      if (!AdvanceSubSplit()) return false;
+    }
+    return true;
+  }
+  bool NextChunk(Blob* out) override {
+    while (!base_->NextChunk(out)) {
+      if (!AdvanceSubSplit()) return false;
+    }
+    return true;
+  }
+  void ResetPartition(unsigned rank, unsigned num_parts) override {
+    part_ = rank;
+    num_parts_ = num_parts;
+    BeforeFirst();
+  }
+  void HintChunkSize(size_t chunk_size) override { base_->HintChunkSize(chunk_size); }
+  size_t GetTotalSize() override { return base_->GetTotalSize(); }
+
+ private:
+  InputSplitShuffle(const char* uri, unsigned part, unsigned num_parts, const char* type,
+                    unsigned num_shuffle_parts, int seed)
+      : part_(part),
+        num_parts_(num_parts),
+        num_shuffle_parts_(num_shuffle_parts),
+        order_(num_shuffle_parts),
+        rnd_(kRandMagic + part * 7919u + static_cast<unsigned>(seed)) {
+    std::iota(order_.begin(), order_.end(), 0u);
+    base_ = InputSplit::Create(uri, SubSplitIndex(order_[0]), num_parts * num_shuffle_parts,
+                               type);
+  }
+  unsigned SubSplitIndex(unsigned slot) const { return part_ * num_shuffle_parts_ + slot; }
+  void ActivateCurrent() {
+    base_->ResetPartition(SubSplitIndex(order_[cursor_]), num_parts_ * num_shuffle_parts_);
+  }
+  bool AdvanceSubSplit() {
+    if (cursor_ + 1 >= order_.size()) return false;
+    ++cursor_;
+    ActivateCurrent();
+    return true;
+  }
+
+  unsigned part_;
+  unsigned num_parts_;
+  unsigned num_shuffle_parts_;
+  std::vector<unsigned> order_;
+  std::mt19937 rnd_;
+  std::unique_ptr<InputSplit> base_;
+  size_t cursor_ = 0;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_INPUT_SPLIT_SHUFFLE_H_

@@ -113,9 +113,26 @@ class ThreadedIter : public DataIter<DType> {
     reset_requested_ = true;
     ++generation_;  // invalidates any item the producer is filling right now
     state_ = State::kRunning;
+    paused_ = false;
     cv_producer_.notify_one();
     cv_consumer_.wait(lk, [this] { return !reset_requested_ || destroyed_; });
     ThrowIfSetLocked();
+  }
+
+  /*!
+   * \brief stop production and wait until the producer is idle; queued items
+   *        are reclaimed.  The source object can then be mutated safely (e.g.
+   *        ResetPartition).  Resume with BeforeFirst().
+   */
+  void Pause() {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (destroyed_) return;
+    paused_ = true;
+    ++generation_;  // discard any in-flight production
+    for (DType* c : queue_) free_cells_.push_back(c);
+    queue_.clear();
+    cv_producer_.notify_all();
+    cv_consumer_.wait(lk, [this] { return !producer_busy_ || destroyed_; });
   }
 
   // DataIter surface: Next() + Value() pull interface over the cell API.
@@ -168,7 +185,7 @@ class ThreadedIter : public DataIter<DType> {
         std::unique_lock<std::mutex> lk(mu_);
         cv_producer_.wait(lk, [this] {
           return destroyed_ || reset_requested_ ||
-                 (state_ == State::kRunning && queue_.size() < capacity_);
+                 (!paused_ && state_ == State::kRunning && queue_.size() < capacity_);
         });
         if (destroyed_) return;
         if (reset_requested_) {
@@ -195,25 +212,29 @@ class ThreadedIter : public DataIter<DType> {
           free_cells_.pop_back();
         }
         gen = generation_;
+        producer_busy_ = true;
       }
       bool has_next = false;
       try {
         has_next = next_fn_(&cell);
       } catch (...) {
         std::lock_guard<std::mutex> lk(mu_);
+        producer_busy_ = false;
         if (cell != nullptr) free_cells_.push_back(cell);
         if (generation_ == gen) {
           if (!eptr_) eptr_ = std::current_exception();
           state_ = State::kEnd;
-          cv_consumer_.notify_all();
         }
+        cv_consumer_.notify_all();
         continue;
       }
       std::lock_guard<std::mutex> lk(mu_);
+      producer_busy_ = false;
       if (generation_ != gen) {
-        // a BeforeFirst() raced with this production: the item belongs to the
-        // previous epoch — drop it and service the reset on the next spin
+        // a BeforeFirst()/Pause() raced with this production: the item belongs
+        // to the previous epoch — drop it and re-examine state on the next spin
         if (cell != nullptr) free_cells_.push_back(cell);
+        cv_consumer_.notify_all();
         continue;
       }
       if (has_next) {
@@ -249,6 +270,8 @@ class ThreadedIter : public DataIter<DType> {
   State state_ = State::kRunning;
   uint64_t generation_ = 0;
   bool reset_requested_ = false;
+  bool paused_ = false;
+  bool producer_busy_ = false;
   bool destroyed_ = false;
   size_t capacity_;
   NextFn next_fn_;

@@ -1,0 +1,129 @@
+// cached_split.h — first epoch tees prefetched chunks into a local cache file
+// ([u64 size][bytes] frames); later epochs stream from the cache instead of
+// re-reading (possibly remote) sources.  Selected by the '#cachefile' URI
+// sugar.  ResetPartition is unsupported (the cache is partition-specific).
+// Behavior parity: reference src/io/cached_input_split.h.
+#ifndef DMLCTPU_SRC_IO_CACHED_SPLIT_H_
+#define DMLCTPU_SRC_IO_CACHED_SPLIT_H_
+
+#include <algorithm>
+#include <memory>
+#include <string>
+
+#include "./split_base.h"
+#include "dmlctpu/threaded_iter.h"
+
+namespace dmlctpu {
+namespace io {
+
+class CachedInputSplit : public InputSplit {
+ public:
+  CachedInputSplit(std::unique_ptr<SplitterBase> base, const char* cache_file,
+                   bool reuse_exist_cache = true)
+      : base_(std::move(base)),
+        buffer_units_(base_->buffer_units()),
+        cache_file_(cache_file) {
+    if (!reuse_exist_cache || !InitCachedIter()) InitPreprocIter();
+  }
+  ~CachedInputSplit() override {
+    if (preproc_ != nullptr) preproc_->Destroy();
+    preproc_.reset();
+    fo_.reset();
+    cached_.Destroy();
+    delete tmp_chunk_;
+  }
+
+  void BeforeFirst() override {
+    if (preproc_ != nullptr) {
+      // drain the first pass so the cache file is complete, then swap over
+      if (tmp_chunk_ != nullptr) preproc_->Recycle(&tmp_chunk_);
+      SplitterBase::Chunk* c = nullptr;
+      while (preproc_->Next(&c)) preproc_->Recycle(&c);
+      preproc_.reset();
+      fo_.reset();
+      TCHECK(InitCachedIter()) << "failed to reopen cache file " << cache_file_;
+    } else {
+      if (tmp_chunk_ != nullptr) cached_.Recycle(&tmp_chunk_);
+      cached_.BeforeFirst();
+    }
+  }
+  void ResetPartition(unsigned, unsigned) override {
+    TLOG(Fatal) << "CachedInputSplit cannot be re-partitioned (cache is per-part)";
+  }
+  void HintChunkSize(size_t chunk_size) override {
+    buffer_units_ = std::max(chunk_size / sizeof(uint32_t), buffer_units_);
+  }
+  size_t GetTotalSize() override { return base_->GetTotalSize(); }
+
+  bool NextRecord(Blob* out) override {
+    auto* iter = ActiveIter();
+    if (tmp_chunk_ == nullptr && !iter->Next(&tmp_chunk_)) return false;
+    while (!base_->ExtractNextRecord(out, tmp_chunk_)) {
+      iter->Recycle(&tmp_chunk_);
+      if (!iter->Next(&tmp_chunk_)) return false;
+    }
+    return true;
+  }
+  bool NextChunk(Blob* out) override {
+    auto* iter = ActiveIter();
+    if (tmp_chunk_ == nullptr && !iter->Next(&tmp_chunk_)) return false;
+    while (!base_->ExtractNextChunk(out, tmp_chunk_)) {
+      iter->Recycle(&tmp_chunk_);
+      if (!iter->Next(&tmp_chunk_)) return false;
+    }
+    return true;
+  }
+
+ private:
+  ThreadedIter<SplitterBase::Chunk>* ActiveIter() {
+    return preproc_ != nullptr ? preproc_.get() : &cached_;
+  }
+
+  void InitPreprocIter() {
+    fo_ = Stream::Create(cache_file_.c_str(), "w");
+    preproc_ = std::make_unique<ThreadedIter<SplitterBase::Chunk>>(16);
+    preproc_->Init([this](SplitterBase::Chunk** cell) {
+      if (*cell == nullptr) *cell = new SplitterBase::Chunk(buffer_units_);
+      SplitterBase::Chunk* c = *cell;
+      if (!base_->NextChunkEx(c)) return false;
+      uint64_t size = static_cast<uint64_t>(c->end - c->begin);
+      fo_->Write(&size, sizeof(size));
+      fo_->Write(c->begin, size);
+      return true;
+    });
+  }
+
+  bool InitCachedIter() {
+    fi_ = SeekStream::CreateForRead(cache_file_.c_str(), /*allow_null=*/true);
+    if (fi_ == nullptr) return false;
+    cached_.Init(
+        [this](SplitterBase::Chunk** cell) {
+          if (*cell == nullptr) *cell = new SplitterBase::Chunk(buffer_units_);
+          SplitterBase::Chunk* c = *cell;
+          uint64_t size;
+          size_t n = fi_->Read(&size, sizeof(size));
+          if (n == 0) return false;
+          TCHECK_EQ(n, sizeof(size)) << cache_file_ << ": corrupt cache frame";
+          c->data.resize(size / sizeof(uint32_t) + 1);
+          c->begin = reinterpret_cast<char*>(c->data.data());
+          c->end = c->begin + size;
+          fi_->ReadAll(c->begin, size);
+          return true;
+        },
+        [this] { fi_->Seek(0); });
+    return true;
+  }
+
+  std::unique_ptr<SplitterBase> base_;
+  size_t buffer_units_;
+  std::string cache_file_;
+  std::unique_ptr<Stream> fo_;
+  std::unique_ptr<SeekStream> fi_;
+  std::unique_ptr<ThreadedIter<SplitterBase::Chunk>> preproc_;
+  ThreadedIter<SplitterBase::Chunk> cached_;
+  SplitterBase::Chunk* tmp_chunk_ = nullptr;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_CACHED_SPLIT_H_

@@ -1,0 +1,110 @@
+#include "./indexed_recordio_split.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "dmlctpu/logging.h"
+#include "dmlctpu/strtonum.h"
+
+namespace dmlctpu {
+namespace io {
+
+void IndexedRecordIOSplitter::ReadIndexFile(const std::string& index_uri) {
+  std::vector<URI> expanded = ExpandURI(index_uri);
+  TCHECK_EQ(expanded.size(), 1u) << "indexed_recordio expects exactly one index file";
+  auto fi = filesys_->Open(expanded[0], "r");
+  // the index is text lines of "<record_id> <byte_offset>"
+  std::string content;
+  char buf[1 << 16];
+  size_t n;
+  while ((n = fi->Read(buf, sizeof(buf))) != 0) content.append(buf, n);
+  std::vector<size_t> offsets;
+  const char* p = content.data();
+  const char* end = p + content.size();
+  while (p != end) {
+    uint64_t id, offset;
+    if (!TryParseNum(&p, end, &id)) break;
+    TCHECK(TryParseNum(&p, end, &offset)) << "malformed index line in " << index_uri;
+    offsets.push_back(offset);
+  }
+  TCHECK(!offsets.empty()) << "empty index file " << index_uri;
+  std::sort(offsets.begin(), offsets.end());
+  index_.reserve(offsets.size());
+  for (size_t i = 0; i + 1 < offsets.size(); ++i) {
+    index_.emplace_back(offsets[i], offsets[i + 1] - offsets[i]);
+  }
+  index_.emplace_back(offsets.back(), file_offset_.back() - offsets.back());
+}
+
+void IndexedRecordIOSplitter::ResetPartition(unsigned rank, unsigned num_parts) {
+  size_t total = index_.size();
+  size_t step = (total + num_parts - 1) / num_parts;
+  index_begin_ = std::min(static_cast<size_t>(rank) * step, total);
+  index_end_ = std::min(index_begin_ + step, total);
+  BeforeFirst();
+}
+
+void IndexedRecordIOSplitter::BeforeFirst() {
+  if (shuffle_) {
+    permutation_.resize(index_end_ - index_begin_);
+    for (size_t i = 0; i < permutation_.size(); ++i) permutation_[i] = index_begin_ + i;
+    std::shuffle(permutation_.begin(), permutation_.end(), rnd_);  // fresh each epoch
+  }
+  cursor_ = 0;
+  tmp_chunk_.begin = tmp_chunk_.end = nullptr;
+  overflow_.clear();
+}
+
+void IndexedRecordIOSplitter::ReadAt(size_t offset, size_t len, char* dst) {
+  while (len != 0) {
+    size_t fp = static_cast<size_t>(std::upper_bound(file_offset_.begin(), file_offset_.end(),
+                                                     offset) -
+                                    file_offset_.begin()) - 1;
+    TCHECK_LT(fp, files_.size()) << "record offset beyond dataset";
+    if (fs_ == nullptr || fp != file_ptr_) {
+      file_ptr_ = fp;
+      fs_ = filesys_->OpenForRead(files_[fp].path);
+    }
+    fs_->Seek(offset - file_offset_[fp]);
+    size_t in_file = std::min(len, file_offset_[fp + 1] - offset);
+    fs_->ReadAll(dst, in_file);
+    dst += in_file;
+    offset += in_file;
+    len -= in_file;
+  }
+}
+
+bool IndexedRecordIOSplitter::NextBatchEx(Chunk* chunk, size_t n_records) {
+  size_t remaining = index_end_ - index_begin_ - cursor_;
+  size_t take = std::min(n_records, remaining);
+  if (take == 0) return false;
+  // gather the record ranges for this batch
+  std::vector<std::pair<size_t, size_t>> ranges;  // (offset, len), coalesced
+  size_t total_bytes = 0;
+  for (size_t i = 0; i < take; ++i) {
+    size_t rec = shuffle_ ? permutation_[cursor_ + i] : index_begin_ + cursor_ + i;
+    const auto& [offset, len] = index_[rec];
+    total_bytes += len;
+    if (!ranges.empty() && ranges.back().first + ranges.back().second == offset) {
+      ranges.back().second += len;  // contiguous: one bigger read
+    } else {
+      ranges.emplace_back(offset, len);
+    }
+  }
+  cursor_ += take;
+  if (chunk->data.size() * sizeof(uint32_t) < total_bytes + 1) {
+    chunk->data.resize(total_bytes / sizeof(uint32_t) + 2);
+  }
+  char* dst = reinterpret_cast<char*>(chunk->data.data());
+  char* w = dst;
+  for (const auto& [offset, len] : ranges) {
+    ReadAt(offset, len, w);
+    w += len;
+  }
+  chunk->begin = dst;
+  chunk->end = dst + total_bytes;
+  return true;
+}
+
+}  // namespace io
+}  // namespace dmlctpu

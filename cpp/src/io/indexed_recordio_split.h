@@ -1,0 +1,65 @@
+// indexed_recordio_split.h — RecordIO sharding by *record count* using an
+// external "index offset" text file; supports per-epoch shuffled batched
+// reads (seek to each permuted record).
+// Behavior parity: reference src/io/indexed_recordio_split.{h,cc} — count
+// partitioning, kRandMagic=111 seeding, epoch reshuffle in BeforeFirst —
+// with a cleaner batch reader: records are fetched by exact byte ranges
+// (contiguous runs coalesced into single reads) instead of re-using the
+// healing ReadChunk path.
+#ifndef DMLCTPU_SRC_IO_INDEXED_RECORDIO_SPLIT_H_
+#define DMLCTPU_SRC_IO_INDEXED_RECORDIO_SPLIT_H_
+
+#include <random>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "./recordio_split.h"
+
+namespace dmlctpu {
+namespace io {
+
+class IndexedRecordIOSplitter : public RecordIOSplitter {
+ public:
+  static constexpr int kRandMagic = 111;
+
+  IndexedRecordIOSplitter(FileSystem* fs, const char* uri, const char* index_uri,
+                          unsigned rank, unsigned num_parts, size_t batch_size,
+                          bool shuffle, int seed = 0)
+      : shuffle_(shuffle), batch_size_(batch_size), rnd_(kRandMagic + seed) {
+    Init(fs, uri, /*align_bytes=*/4);
+    ReadIndexFile(index_uri);
+    ResetPartition(rank, num_parts);
+  }
+
+  void ResetPartition(unsigned rank, unsigned num_parts) override;
+  void BeforeFirst() override;
+  bool NextBatchEx(Chunk* chunk, size_t n_records) override;
+  bool NextChunkEx(Chunk* chunk) override { return NextBatchEx(chunk, batch_size_); }
+  bool NextBatch(Blob* out, size_t n_records) override {
+    while (!ExtractNextChunk(out, &tmp_chunk_)) {
+      if (!NextBatchEx(&tmp_chunk_, n_records)) return false;
+    }
+    return true;
+  }
+  void SetBatchSize(size_t batch_size) { batch_size_ = batch_size; }
+  size_t num_records() const { return index_.size(); }
+
+ protected:
+  void ReadIndexFile(const std::string& index_uri);
+  /*! \brief read [offset, offset+len) (absolute dataset offsets) into dst */
+  void ReadAt(size_t offset, size_t len, char* dst);
+
+  std::vector<std::pair<size_t, size_t>> index_;  // (absolute offset, byte length)
+  std::vector<size_t> permutation_;
+  bool shuffle_;
+  size_t batch_size_;
+  size_t index_begin_ = 0;   // first record of this partition
+  size_t index_end_ = 0;     // one past last record
+  size_t cursor_ = 0;        // position within the partition (or permutation)
+  std::mt19937 rnd_;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_INDEXED_RECORDIO_SPLIT_H_

@@ -1,0 +1,59 @@
+// InputSplit::Create — type dispatch + threaded/cached wrapping.
+// Parity: reference src/io.cc:74-130.
+#include "dmlctpu/input_split.h"
+
+#include <cstring>
+#include <memory>
+
+#include "./cached_split.h"
+#include "./indexed_recordio_split.h"
+#include "./line_split.h"
+#include "./recordio_split.h"
+#include "./single_file_split.h"
+#include "./threaded_split.h"
+#include "dmlctpu/io/filesystem.h"
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+
+std::unique_ptr<InputSplit> InputSplit::Create(const char* uri, unsigned part,
+                                               unsigned num_parts, const char* type) {
+  return Create(uri, nullptr, part, num_parts, type);
+}
+
+std::unique_ptr<InputSplit> InputSplit::Create(const char* uri, const char* index_uri,
+                                               unsigned part, unsigned num_parts,
+                                               const char* type, bool shuffle, int seed,
+                                               size_t batch_size, bool recurse_directories) {
+  io::URISpec spec(uri, part, num_parts);
+  if (spec.uri == "stdin" || spec.uri == "-") {
+    return std::make_unique<io::SingleFileSplit>(spec.uri.c_str());
+  }
+  TCHECK_LT(part, num_parts) << "part index must be < num_parts";
+  io::URI path(spec.uri);
+  io::FileSystem* fs = io::FileSystem::GetInstance(path);
+
+  std::unique_ptr<io::SplitterBase> split;
+  if (std::strcmp(type, "text") == 0) {
+    split = std::make_unique<io::LineSplitter>(fs, spec.uri.c_str(), part, num_parts,
+                                               recurse_directories);
+  } else if (std::strcmp(type, "recordio") == 0) {
+    split = std::make_unique<io::RecordIOSplitter>(fs, spec.uri.c_str(), part, num_parts,
+                                                   recurse_directories);
+  } else if (std::strcmp(type, "indexed_recordio") == 0) {
+    TCHECK(index_uri != nullptr) << "indexed_recordio requires an index file URI";
+    io::URISpec index_spec(index_uri, part, num_parts);
+    split = std::make_unique<io::IndexedRecordIOSplitter>(
+        fs, spec.uri.c_str(), index_spec.uri.c_str(), part, num_parts, batch_size, shuffle,
+        seed);
+  } else {
+    TLOG(Fatal) << "unknown input split type '" << type
+                << "' (expected text|recordio|indexed_recordio)";
+  }
+  if (spec.cache_file.empty()) {
+    return std::make_unique<io::ThreadedInputSplit>(std::move(split), batch_size);
+  }
+  return std::make_unique<io::CachedInputSplit>(std::move(split), spec.cache_file.c_str());
+}
+
+}  // namespace dmlctpu

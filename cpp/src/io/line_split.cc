@@ -1,0 +1,56 @@
+#include "./line_split.h"
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace io {
+
+namespace {
+inline bool IsEOL(char c) { return c == '\n' || c == '\r'; }
+}  // namespace
+
+size_t LineSplitter::SeekRecordBegin(Stream* fi) {
+  // skip forward past the next newline run; the partial line belongs to the
+  // previous partition
+  size_t skipped = 0;
+  char c;
+  // phase 1: consume up to and including the first EOL char
+  while (true) {
+    if (fi->Read(&c, 1) == 0) return skipped;
+    ++skipped;
+    if (IsEOL(c)) break;
+  }
+  // phase 2: consume the rest of the EOL run (\r\n, blank lines)
+  while (true) {
+    if (fi->Read(&c, 1) == 0) return skipped;
+    if (!IsEOL(c)) break;  // first record byte: not counted, will be re-read
+    ++skipped;
+  }
+  return skipped;
+}
+
+const char* LineSplitter::FindLastRecordBegin(const char* begin, const char* end) {
+  TCHECK(begin != end);
+  for (const char* p = end - 1; p != begin; --p) {
+    if (IsEOL(*p)) return p + 1;
+  }
+  return begin;
+}
+
+bool LineSplitter::ExtractNextRecord(Blob* out, Chunk* chunk) {
+  if (chunk->begin == chunk->end) return false;
+  char* p = chunk->begin;
+  while (p != chunk->end && !IsEOL(*p)) ++p;        // find end of line
+  char* line_end = p;
+  while (p != chunk->end && IsEOL(*p)) ++p;         // swallow the newline run
+  // '\0'-terminate in place, replacing the first EOL char (or the chunk's
+  // slack byte when the line runs to the end) — this also strips a '\r'
+  *line_end = '\0';
+  out->dptr = chunk->begin;
+  out->size = static_cast<size_t>(line_end - chunk->begin);
+  chunk->begin = p;
+  return true;
+}
+
+}  // namespace io
+}  // namespace dmlctpu

@@ -1,0 +1,30 @@
+// line_split.h — newline-delimited text splitter.
+// Behavior parity: reference src/io/line_split.{h,cc} — healing seeks to the
+// char after the next newline run; records are '\0'-terminated in place.
+#ifndef DMLCTPU_SRC_IO_LINE_SPLIT_H_
+#define DMLCTPU_SRC_IO_LINE_SPLIT_H_
+
+#include "./split_base.h"
+
+namespace dmlctpu {
+namespace io {
+
+class LineSplitter : public SplitterBase {
+ public:
+  LineSplitter(FileSystem* fs, const char* uri, unsigned rank, unsigned num_parts,
+               bool recurse_directories = false) {
+    Init(fs, uri, /*align_bytes=*/1, recurse_directories);
+    ResetPartition(rank, num_parts);
+  }
+
+  bool IsTextParser() const override { return true; }
+  bool ExtractNextRecord(Blob* out, Chunk* chunk) override;
+
+ protected:
+  size_t SeekRecordBegin(Stream* fi) override;
+  const char* FindLastRecordBegin(const char* begin, const char* end) override;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_LINE_SPLIT_H_

@@ -1,0 +1,87 @@
+#include "./recordio_split.h"
+
+#include <cstring>
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace io {
+
+namespace {
+inline uint32_t LoadWord(const char* p) {
+  uint32_t w;
+  std::memcpy(&w, p, 4);
+  return w;
+}
+inline bool IsRecordHead(uint32_t magic_word, uint32_t lrec) {
+  if (magic_word != RecordIOWriter::kMagic) return false;
+  uint32_t cflag = RecordIOWriter::DecodeFlag(lrec);
+  return cflag == 0u || cflag == 1u;
+}
+}  // namespace
+
+size_t RecordIOSplitter::SeekRecordBegin(Stream* fi) {
+  // scan 4-byte words until a record head (magic + cflag 0|1) appears;
+  // return the byte count consumed before that head
+  size_t skipped = 0;
+  uint32_t word, lrec;
+  while (true) {
+    if (fi->Read(&word, 4) == 0) return skipped;
+    skipped += 4;
+    if (word == RecordIOWriter::kMagic) {
+      TCHECK_EQ(fi->Read(&lrec, 4), 4u) << "truncated RecordIO header while healing shard";
+      skipped += 4;
+      uint32_t cflag = RecordIOWriter::DecodeFlag(lrec);
+      if (cflag == 0u || cflag == 1u) return skipped - 8;  // head itself not consumed
+    }
+  }
+}
+
+const char* RecordIOSplitter::FindLastRecordBegin(const char* begin, const char* end) {
+  TCHECK_EQ(reinterpret_cast<uintptr_t>(begin) & 3u, 0u) << "chunk misaligned";
+  TCHECK_GE(end - begin, 8);
+  for (const char* p = end - 8; p != begin; p -= 4) {
+    if (IsRecordHead(LoadWord(p), LoadWord(p + 4))) return p;
+  }
+  return begin;
+}
+
+bool RecordIOSplitter::ExtractNextRecord(Blob* out, Chunk* chunk) {
+  if (chunk->begin == chunk->end) return false;
+  TCHECK_LE(static_cast<const void*>(chunk->begin + 8), static_cast<const void*>(chunk->end))
+      << "corrupt RecordIO chunk";
+  uint32_t lrec = LoadWord(chunk->begin + 4);
+  uint32_t cflag = RecordIOWriter::DecodeFlag(lrec);
+  uint32_t len = RecordIOWriter::DecodeLength(lrec);
+  auto padded = [](uint32_t n) { return (n + 3u) & ~3u; };
+  out->dptr = chunk->begin + 8;
+  out->size = len;
+  chunk->begin += 8 + padded(len);
+  TCHECK_LE(static_cast<const void*>(chunk->begin), static_cast<const void*>(chunk->end))
+      << "corrupt RecordIO chunk";
+  if (cflag == 0u) return true;
+  // escape-split record: compact the pieces in place, restoring the elided
+  // magic word between them (same layout trick as the reference — the pieces
+  // are contiguous in the chunk, so memmove-left never overlaps wrongly)
+  TCHECK_EQ(cflag, 1u) << "corrupt RecordIO chunk: expected record start";
+  char* dst = static_cast<char*>(out->dptr);
+  while (cflag != 3u) {
+    TCHECK_LE(static_cast<const void*>(chunk->begin + 8), static_cast<const void*>(chunk->end));
+    TCHECK_EQ(LoadWord(chunk->begin), RecordIOWriter::kMagic);
+    lrec = LoadWord(chunk->begin + 4);
+    cflag = RecordIOWriter::DecodeFlag(lrec);
+    len = RecordIOWriter::DecodeLength(lrec);
+    const uint32_t magic = RecordIOWriter::kMagic;
+    std::memcpy(dst + out->size, &magic, 4);
+    out->size += 4;
+    if (len != 0) {
+      std::memmove(dst + out->size, chunk->begin + 8, len);
+      out->size += len;
+    }
+    chunk->begin += 8 + padded(len);
+  }
+  return true;
+}
+
+}  // namespace io
+}  // namespace dmlctpu

@@ -1,0 +1,33 @@
+// recordio_split.h — RecordIO binary splitter: shards on 4-byte-aligned magic
+// headers, reassembles escape-split records in place.
+// Behavior parity: reference src/io/recordio_split.{h,cc}.
+#ifndef DMLCTPU_SRC_IO_RECORDIO_SPLIT_H_
+#define DMLCTPU_SRC_IO_RECORDIO_SPLIT_H_
+
+#include "./split_base.h"
+#include "dmlctpu/recordio.h"
+
+namespace dmlctpu {
+namespace io {
+
+class RecordIOSplitter : public SplitterBase {
+ public:
+  RecordIOSplitter(FileSystem* fs, const char* uri, unsigned rank, unsigned num_parts,
+                   bool recurse_directories = false) {
+    Init(fs, uri, /*align_bytes=*/4, recurse_directories);
+    ResetPartition(rank, num_parts);
+  }
+
+  bool IsTextParser() const override { return false; }
+  bool ExtractNextRecord(Blob* out, Chunk* chunk) override;
+
+ protected:
+  RecordIOSplitter() = default;  // for IndexedRecordIOSplitter
+
+  size_t SeekRecordBegin(Stream* fi) override;
+  const char* FindLastRecordBegin(const char* begin, const char* end) override;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_RECORDIO_SPLIT_H_

@@ -1,0 +1,121 @@
+// single_file_split.h — stdin / single-file fallback with no partitioning.
+// Behavior parity: reference src/io/single_file_split.h.
+#ifndef DMLCTPU_SRC_IO_SINGLE_FILE_SPLIT_H_
+#define DMLCTPU_SRC_IO_SINGLE_FILE_SPLIT_H_
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "dmlctpu/input_split.h"
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace io {
+
+/*! \brief reads a single FILE (or stdin) line by line; no sharding */
+class SingleFileSplit : public InputSplit {
+ public:
+  explicit SingleFileSplit(const char* fname) {
+    if (std::string(fname) == "stdin" || std::string(fname) == "-") {
+      fp_ = stdin;
+    } else {
+      fp_ = std::fopen(fname, "rb");
+      TCHECK(fp_ != nullptr) << "SingleFileSplit: cannot open " << fname;
+      own_ = true;
+    }
+    buffer_.resize(kBufferSize);
+  }
+  ~SingleFileSplit() override {
+    if (own_ && fp_ != nullptr) std::fclose(fp_);
+  }
+
+  void BeforeFirst() override {
+    if (own_) {
+      std::fseek(fp_, 0, SEEK_SET);
+      end_of_file_ = false;
+      read_ptr_ = read_end_ = 0;
+      overflow_.clear();
+    } else {
+      TCHECK(!started_) << "stdin cannot be re-read";
+    }
+  }
+  void ResetPartition(unsigned rank, unsigned num_parts) override {
+    TCHECK(rank == 0 && num_parts == 1) << "SingleFileSplit supports only one partition";
+    BeforeFirst();
+  }
+  size_t GetTotalSize() override { return 0; }
+
+  bool NextRecord(Blob* out) override {
+    started_ = true;
+    line_.clear();
+    if (!overflow_.empty()) {
+      line_ = overflow_;
+      overflow_.clear();
+    }
+    while (true) {
+      if (read_ptr_ == read_end_) {
+        if (end_of_file_) break;
+        read_end_ = std::fread(buffer_.data(), 1, buffer_.size(), fp_);
+        read_ptr_ = 0;
+        if (read_end_ == 0) {
+          end_of_file_ = true;
+          break;
+        }
+      }
+      char c = buffer_[read_ptr_++];
+      if (c == '\n' || c == '\r') {
+        if (!line_.empty() || seen_content_) {
+          seen_content_ = false;
+          out->dptr = line_.data();
+          out->size = line_.size();
+          return true;
+        }
+        continue;  // swallow EOL runs / blank leading lines
+      }
+      line_.push_back(c);
+      seen_content_ = true;
+    }
+    if (!line_.empty()) {
+      out->dptr = line_.data();
+      out->size = line_.size();
+      seen_content_ = false;
+      return true;
+    }
+    return false;
+  }
+
+  bool NextChunk(Blob* out) override {
+    // serve whole remaining buffer loads as chunks
+    started_ = true;
+    if (read_ptr_ == read_end_) {
+      if (end_of_file_) return false;
+      read_end_ = std::fread(buffer_.data(), 1, buffer_.size(), fp_);
+      read_ptr_ = 0;
+      if (read_end_ == 0) {
+        end_of_file_ = true;
+        return false;
+      }
+    }
+    out->dptr = buffer_.data() + read_ptr_;
+    out->size = read_end_ - read_ptr_;
+    read_ptr_ = read_end_;
+    return true;
+  }
+
+ private:
+  static constexpr size_t kBufferSize = 1u << 20u;
+  std::FILE* fp_ = nullptr;
+  bool own_ = false;
+  bool end_of_file_ = false;
+  bool started_ = false;
+  bool seen_content_ = false;
+  std::vector<char> buffer_;
+  size_t read_ptr_ = 0, read_end_ = 0;
+  std::string line_;
+  std::string overflow_;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_SINGLE_FILE_SPLIT_H_

@@ -1,0 +1,252 @@
+// Byte-range sharding engine.  Behavior contract from reference
+// src/io/input_split_base.cc: ResetPartition alignment+healing (:30-64),
+// cross-file reads with '\n' seam insertion (:177-219), overflow-tail chunk
+// reads (:221-258), URI expansion (:96-175).
+#include "./split_base.h"
+
+#include <algorithm>
+#include <cstring>
+#include <regex>
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace io {
+
+namespace {
+std::string StripTrailing(std::string s, char ch) {
+  while (!s.empty() && s.back() == ch) s.pop_back();
+  return s;
+}
+}  // namespace
+
+std::vector<URI> SplitterBase::ExpandURI(const std::string& uri) {
+  std::vector<URI> out;
+  for (const std::string& item : Split(uri, ';')) {
+    if (item.empty()) continue;
+    URI path(item);
+    size_t slash = path.name.rfind('/');
+    if (slash == std::string::npos || slash + 1 == path.name.size()) {
+      out.push_back(path);
+      continue;
+    }
+    // the last path component may be a regex: list the parent directory and
+    // match; exact names win without regex interpretation
+    URI dir = path;
+    dir.name = path.name.substr(0, slash);
+    std::vector<FileInfo> entries;
+    bool listed = true;
+    try {
+      filesys_->ListDirectory(dir, &entries);
+    } catch (const Error&) {
+      listed = false;  // parent not listable: treat as a literal path
+    }
+    if (!listed) {
+      out.push_back(path);
+      continue;
+    }
+    bool exact = false;
+    for (const FileInfo& e : entries) {
+      if (StripTrailing(e.path.name, '/') == StripTrailing(path.name, '/')) {
+        out.push_back(e.path);
+        exact = true;
+        break;
+      }
+    }
+    if (exact) continue;
+    try {
+      std::regex pattern(path.name);
+      for (const FileInfo& e : entries) {
+        if (e.type != FileType::kFile || e.size == 0) continue;
+        if (std::regex_match(StripTrailing(e.path.name, '/'), pattern)) {
+          out.push_back(e.path);
+        }
+      }
+    } catch (const std::regex_error& e) {
+      TLOG(Fatal) << "bad path regex '" << path.name << "': " << e.what();
+    }
+  }
+  return out;
+}
+
+void SplitterBase::CollectFiles(const std::string& uri, bool recurse_directories) {
+  for (const URI& path : ExpandURI(uri)) {
+    FileInfo info = filesys_->GetPathInfo(path);
+    if (info.type == FileType::kDirectory) {
+      std::vector<FileInfo> children;
+      if (recurse_directories) {
+        filesys_->ListDirectoryRecursive(info.path, &children);
+      } else {
+        filesys_->ListDirectory(info.path, &children);
+      }
+      std::sort(children.begin(), children.end(),
+                [](const FileInfo& a, const FileInfo& b) { return a.path.name < b.path.name; });
+      for (const FileInfo& c : children) {
+        if (c.type == FileType::kFile && c.size != 0) files_.push_back(c);
+      }
+    } else if (info.size != 0) {
+      files_.push_back(info);
+    }
+  }
+  TCHECK(!files_.empty()) << "no files match the URI pattern '" << uri << "'";
+}
+
+void SplitterBase::Init(FileSystem* fs, const char* uri, size_t align_bytes,
+                        bool recurse_directories) {
+  filesys_ = fs;
+  align_bytes_ = align_bytes;
+  CollectFiles(uri, recurse_directories);
+  file_offset_.resize(files_.size() + 1);
+  file_offset_[0] = 0;
+  for (size_t i = 0; i < files_.size(); ++i) {
+    TCHECK_EQ(files_[i].size % align_bytes, 0u)
+        << "file '" << files_[i].path.name << "' size is not a multiple of " << align_bytes;
+    file_offset_[i + 1] = file_offset_[i] + files_[i].size;
+  }
+}
+
+void SplitterBase::ResetPartition(unsigned rank, unsigned num_parts) {
+  size_t total = file_offset_.back();
+  size_t step = (total + num_parts - 1) / num_parts;
+  step = (step + align_bytes_ - 1) / align_bytes_ * align_bytes_;
+  offset_begin_ = std::min(step * rank, total);
+  offset_end_ = std::min(step * (rank + 1), total);
+  offset_curr_ = offset_begin_;
+  if (offset_begin_ == offset_end_) return;
+
+  auto file_of = [this](size_t offset) {
+    return static_cast<size_t>(std::upper_bound(file_offset_.begin(), file_offset_.end(), offset) -
+                               file_offset_.begin()) - 1;
+  };
+  file_ptr_ = file_of(offset_begin_);
+  file_ptr_end_ = file_of(offset_end_);
+  fs_.reset();
+
+  // heal the END of the range: advance past the partial record the next
+  // partition will own (unless we landed exactly on a file boundary)
+  if (offset_end_ != file_offset_[file_ptr_end_]) {
+    TCHECK_LT(file_ptr_end_, files_.size());
+    auto probe = filesys_->OpenForRead(files_[file_ptr_end_].path);
+    probe->Seek(offset_end_ - file_offset_[file_ptr_end_]);
+    offset_end_ += SeekRecordBegin(probe.get());
+  }
+  // heal the START of the range the same way
+  fs_ = filesys_->OpenForRead(files_[file_ptr_].path);
+  if (offset_begin_ != file_offset_[file_ptr_]) {
+    fs_->Seek(offset_begin_ - file_offset_[file_ptr_]);
+    offset_begin_ += SeekRecordBegin(fs_.get());
+  }
+  BeforeFirst();
+}
+
+void SplitterBase::BeforeFirst() {
+  if (offset_begin_ >= offset_end_) return;
+  size_t fp = static_cast<size_t>(std::upper_bound(file_offset_.begin(), file_offset_.end(),
+                                                   offset_begin_) -
+                                  file_offset_.begin()) - 1;
+  if (file_ptr_ != fp || fs_ == nullptr) {
+    file_ptr_ = fp;
+    fs_ = filesys_->OpenForRead(files_[file_ptr_].path);
+  }
+  fs_->Seek(offset_begin_ - file_offset_[file_ptr_]);
+  offset_curr_ = offset_begin_;
+  tmp_chunk_.begin = tmp_chunk_.end = nullptr;
+  overflow_.clear();
+}
+
+size_t SplitterBase::ReadSpanningFiles(void* ptr, size_t size) {
+  if (fs_ == nullptr || offset_begin_ >= offset_end_) return 0;
+  if (offset_curr_ + size > offset_end_) size = offset_end_ - offset_curr_;
+  if (size == 0) return 0;
+  const bool text = IsTextParser();
+  char* buf = static_cast<char*>(ptr);
+  size_t nleft = size;
+  while (nleft != 0) {
+    size_t n = fs_->Read(buf, nleft);
+    buf += n;
+    nleft -= n;
+    offset_curr_ += n;
+    if (nleft == 0) break;
+    if (n == 0) {
+      // current file exhausted
+      if (text) {
+        // synthesize a '\n' between files so a NOEOL last line still
+        // terminates (reference PR dmlc-core#385 behavior)
+        *buf++ = '\n';
+        --nleft;
+      }
+      TCHECK_EQ(offset_curr_, file_offset_[file_ptr_ + 1])
+          << "file offset table inconsistent while crossing a file seam";
+      if (file_ptr_ + 1 >= files_.size()) break;
+      ++file_ptr_;
+      fs_ = filesys_->OpenForRead(files_[file_ptr_].path);
+    }
+  }
+  return size - nleft;
+}
+
+bool SplitterBase::ReadChunk(void* buf, size_t* size) {
+  size_t capacity = *size;
+  if (capacity <= overflow_.size()) {
+    // caller's buffer cannot even hold the carried tail: ask for a bigger one
+    *size = 0;
+    return true;
+  }
+  char* out = static_cast<char*>(buf);
+  size_t olen = overflow_.size();
+  if (olen != 0) std::memcpy(out, overflow_.data(), olen);
+  overflow_.clear();
+  size_t nread = ReadSpanningFiles(out + olen, capacity - olen) + olen;
+  if (nread == 0) return false;
+  if (IsTextParser()) {
+    if (nread == olen) {
+      // only the carried tail remains (source exhausted): terminate it
+      // (reference PR dmlc-core#452 behavior); the +1 slack byte in Chunk
+      // guarantees room
+      out[nread++] = '\n';
+    }
+  } else if (nread != capacity) {
+    *size = nread;  // binary source drained: everything read is whole records
+    return true;
+  }
+  const char* last = FindLastRecordBegin(out, out + nread);
+  *size = static_cast<size_t>(last - out);
+  overflow_.assign(last, nread - *size);
+  return true;
+}
+
+bool SplitterBase::Chunk::Load(SplitterBase* split, size_t units) {
+  if (data.size() < units + 1) data.resize(units + 1);
+  while (true) {
+    size_t size = (data.size() - 1) * sizeof(uint32_t);
+    data.back() = 0;  // keep the slack byte zeroed for string safety
+    if (!split->ReadChunk(data.data(), &size)) return false;
+    if (size == 0) {
+      data.resize(data.size() * 2);  // tail bigger than buffer: grow and retry
+    } else {
+      begin = reinterpret_cast<char*>(data.data());
+      end = begin + size;
+      return true;
+    }
+  }
+}
+
+bool SplitterBase::Chunk::Append(SplitterBase* split, size_t units) {
+  size_t prev = static_cast<size_t>(end - begin);
+  data.resize(data.size() + units);
+  while (true) {
+    size_t size = (data.size() - 1) * sizeof(uint32_t) - prev;
+    data.back() = 0;
+    if (!split->ReadChunk(reinterpret_cast<char*>(data.data()) + prev, &size)) return false;
+    if (size == 0) {
+      data.resize(data.size() * 2);  // carried tail larger than free space
+    } else {
+      begin = reinterpret_cast<char*>(data.data());
+      end = begin + prev + size;
+      return true;
+    }
+  }
+}
+
+}  // namespace io
+}  // namespace dmlctpu

@@ -1,0 +1,132 @@
+// split_base.h — the byte-range sharding engine behind every InputSplit kind.
+// Behavior parity with reference src/io/input_split_base.{h,cc}: cumulative
+// file offsets, aligned partition ranges healed to record boundaries, reads
+// spanning file seams (inserting '\n' between text files for NOEOL handling),
+// chunked reads that keep the partial-record tail in an overflow buffer.
+#ifndef DMLCTPU_SRC_IO_SPLIT_BASE_H_
+#define DMLCTPU_SRC_IO_SPLIT_BASE_H_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "dmlctpu/input_split.h"
+#include "dmlctpu/io/filesystem.h"
+
+namespace dmlctpu {
+namespace io {
+
+class SplitterBase : public InputSplit {
+ public:
+  /*!
+   * \brief an owned, 4-byte-aligned buffer holding whole records, with a
+   *        cursor [begin, end) that record extraction advances through.
+   */
+  struct Chunk {
+    std::vector<uint32_t> data;  // uint32 units keep RecordIO 4B alignment
+    char* begin = nullptr;
+    char* end = nullptr;
+    explicit Chunk(size_t units = 0) : data(units + 1) {}
+    /*! \brief refill from the split; grows on demand; false at end of part */
+    bool Load(SplitterBase* split, size_t units);
+    /*! \brief append more data after the current content */
+    bool Append(SplitterBase* split, size_t units);
+  };
+
+  /*! \brief default chunk buffer: 8 MiB of uint32 units */
+  static constexpr size_t kDefaultBufferUnits = 2u << 20u;
+
+  ~SplitterBase() override = default;
+
+  void BeforeFirst() override;
+  void ResetPartition(unsigned rank, unsigned num_parts) override;
+  size_t GetTotalSize() override { return file_offset_.back(); }
+  void HintChunkSize(size_t chunk_size) override {
+    buffer_units_ = std::max(chunk_size / sizeof(uint32_t), buffer_units_);
+  }
+  bool NextRecord(Blob* out) override {
+    while (!ExtractNextRecord(out, &tmp_chunk_)) {
+      if (!NextChunkEx(&tmp_chunk_)) return false;
+    }
+    return true;
+  }
+  bool NextChunk(Blob* out) override {
+    while (!ExtractNextChunk(out, &tmp_chunk_)) {
+      if (!NextChunkEx(&tmp_chunk_)) return false;
+    }
+    return true;
+  }
+
+  // ---- chunk-level API used by the threaded/cached wrappers ----
+  /*! \brief fill an external chunk (bypasses tmp_chunk_) */
+  virtual bool NextChunkEx(Chunk* chunk) { return chunk->Load(this, buffer_units_); }
+  /*! \brief batch variant; only indexed splits distinguish n_records */
+  virtual bool NextBatchEx(Chunk* chunk, size_t /*n_records*/) { return NextChunkEx(chunk); }
+  /*! \brief pop one record out of a loaded chunk; false when drained */
+  virtual bool ExtractNextRecord(Blob* out, Chunk* chunk) = 0;
+  /*! \brief hand out the rest of a loaded chunk as one blob */
+  bool ExtractNextChunk(Blob* out, Chunk* chunk) {
+    if (chunk->begin == chunk->end) return false;
+    out->dptr = chunk->begin;
+    out->size = static_cast<size_t>(chunk->end - chunk->begin);
+    chunk->begin = chunk->end;
+    return true;
+  }
+  /*! \brief whether records are newline-delimited text */
+  virtual bool IsTextParser() const = 0;
+
+  /*!
+   * \brief read a chunk that ends exactly at a record boundary; the partial
+   *        tail is carried in overflow_ into the next call.
+   * \param buf destination (4-byte aligned); *size in = capacity, out = bytes
+   * \return false at end of partition
+   */
+  virtual bool ReadChunk(void* buf, size_t* size);
+
+  size_t buffer_units() const { return buffer_units_; }
+
+ protected:
+  SplitterBase() = default;
+
+  /*!
+   * \brief bind to URI and build the cumulative offset table.
+   * \param align_bytes partition boundaries (and every file size) must be
+   *        multiples of this (4 for RecordIO, 1 for text)
+   */
+  void Init(FileSystem* fs, const char* uri, size_t align_bytes,
+            bool recurse_directories = false);
+
+  // record-format hooks implemented per splitter kind
+  /*! \brief advance a freshly-seeked stream to the next record start; returns bytes skipped */
+  virtual size_t SeekRecordBegin(Stream* fi) = 0;
+  /*! \brief last position in [begin,end) where a record starts */
+  virtual const char* FindLastRecordBegin(const char* begin, const char* end) = 0;
+
+  /*! \brief sequential read across file seams within [offset_begin_, offset_end_) */
+  size_t ReadSpanningFiles(void* ptr, size_t size);
+
+  /*! \brief expand ';' lists / trailing regex / directories into concrete files */
+  std::vector<URI> ExpandURI(const std::string& uri);
+
+  FileSystem* filesys_ = nullptr;
+  std::vector<FileInfo> files_;
+  std::vector<size_t> file_offset_;  // prefix sums; size files_.size()+1
+  std::unique_ptr<SeekStream> fs_;
+  size_t file_ptr_ = 0;      // index of the open file
+  size_t file_ptr_end_ = 0;  // index of the file containing offset_end_
+  size_t offset_begin_ = 0;
+  size_t offset_end_ = 0;
+  size_t offset_curr_ = 0;
+  size_t align_bytes_ = 1;
+  size_t buffer_units_ = kDefaultBufferUnits;
+  Chunk tmp_chunk_{kDefaultBufferUnits};
+  std::string overflow_;  // partial record tail carried between ReadChunk calls
+
+ private:
+  void CollectFiles(const std::string& uri, bool recurse_directories);
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_SPLIT_BASE_H_

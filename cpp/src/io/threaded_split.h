@@ -1,0 +1,79 @@
+// threaded_split.h — wraps a SplitterBase with a background chunk-prefetch
+// thread (capacity 2): while the consumer parses chunk N, the producer reads
+// chunk N+1 from the filesystem.
+// Behavior parity: reference src/io/threaded_input_split.h.
+#ifndef DMLCTPU_SRC_IO_THREADED_SPLIT_H_
+#define DMLCTPU_SRC_IO_THREADED_SPLIT_H_
+
+#include <algorithm>
+#include <memory>
+
+#include "./split_base.h"
+#include "dmlctpu/threaded_iter.h"
+
+namespace dmlctpu {
+namespace io {
+
+class ThreadedInputSplit : public InputSplit {
+ public:
+  ThreadedInputSplit(std::unique_ptr<SplitterBase> base, size_t batch_size)
+      : base_(std::move(base)),
+        buffer_units_(std::max(base_->buffer_units(), SplitterBase::kDefaultBufferUnits)),
+        batch_size_(batch_size) {
+    iter_.set_max_capacity(2);
+    iter_.Init(
+        [this](SplitterBase::Chunk** cell) {
+          if (*cell == nullptr) *cell = new SplitterBase::Chunk(buffer_units_);
+          return base_->NextBatchEx(*cell, batch_size_);
+        },
+        [this] { base_->BeforeFirst(); });
+  }
+  ~ThreadedInputSplit() override {
+    iter_.Destroy();
+    delete tmp_chunk_;
+  }
+
+  void BeforeFirst() override {
+    iter_.BeforeFirst();
+    if (tmp_chunk_ != nullptr) iter_.Recycle(&tmp_chunk_);
+  }
+  void ResetPartition(unsigned rank, unsigned num_parts) override {
+    // quiesce the producer so the re-partition cannot race in-flight reads
+    iter_.Pause();
+    if (tmp_chunk_ != nullptr) iter_.Recycle(&tmp_chunk_);
+    base_->ResetPartition(rank, num_parts);
+    iter_.BeforeFirst();
+  }
+  void HintChunkSize(size_t chunk_size) override {
+    buffer_units_ = std::max(chunk_size / sizeof(uint32_t), buffer_units_);
+  }
+  size_t GetTotalSize() override { return base_->GetTotalSize(); }
+
+  bool NextRecord(Blob* out) override {
+    if (tmp_chunk_ == nullptr && !iter_.Next(&tmp_chunk_)) return false;
+    while (!base_->ExtractNextRecord(out, tmp_chunk_)) {
+      iter_.Recycle(&tmp_chunk_);
+      if (!iter_.Next(&tmp_chunk_)) return false;
+    }
+    return true;
+  }
+  bool NextChunk(Blob* out) override {
+    if (tmp_chunk_ == nullptr && !iter_.Next(&tmp_chunk_)) return false;
+    while (!base_->ExtractNextChunk(out, tmp_chunk_)) {
+      iter_.Recycle(&tmp_chunk_);
+      if (!iter_.Next(&tmp_chunk_)) return false;
+    }
+    return true;
+  }
+
+ private:
+  std::unique_ptr<SplitterBase> base_;
+  size_t buffer_units_;
+  size_t batch_size_;
+  ThreadedIter<SplitterBase::Chunk> iter_;
+  SplitterBase::Chunk* tmp_chunk_ = nullptr;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_THREADED_SPLIT_H_

@@ -1,0 +1,294 @@
+// Unit tests for the input-split engine, mirroring the reference's
+// unittest_inputsplit.cc strategy (SURVEY.md §4.1): write real files into a
+// TemporaryDirectory, instantiate ALL ranks' InputSplit(uri, k, n) in-process,
+// and assert every record appears exactly once across partitions — i.e.
+// simulated distributed reads without a cluster.  Covers NOEOL, CRLF,
+// multi-file seams, recordio with magic collisions, indexed recordio with
+// shuffle, the cache-file path, and the shuffle wrapper.
+#include <algorithm>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "dmlctpu/input_split.h"
+#include "dmlctpu/input_split_shuffle.h"
+#include "dmlctpu/io/filesystem.h"
+#include "dmlctpu/recordio.h"
+#include "dmlctpu/stream.h"
+#include "dmlctpu/temp_dir.h"
+#include "testing.h"
+
+using namespace dmlctpu;  // NOLINT
+
+namespace {
+
+void WriteFile(const std::string& path, const std::string& content) {
+  auto fo = Stream::Create(path.c_str(), "w");
+  fo->Write(content.data(), content.size());
+}
+
+/*! \brief read all records of one partition as strings */
+std::vector<std::string> ReadPart(const std::string& uri, unsigned part, unsigned nparts,
+                                  const char* type) {
+  auto split = InputSplit::Create(uri.c_str(), part, nparts, type);
+  std::vector<std::string> out;
+  InputSplit::Blob blob;
+  while (split->NextRecord(&blob)) {
+    out.emplace_back(static_cast<const char*>(blob.dptr), blob.size);
+  }
+  return out;
+}
+
+/*! \brief assert the union of all partitions equals expected (as multisets) */
+void CheckPartitionUnion(const std::string& uri, unsigned nparts, const char* type,
+                         const std::vector<std::string>& expected) {
+  std::multiset<std::string> seen;
+  for (unsigned part = 0; part < nparts; ++part) {
+    for (auto& r : ReadPart(uri, part, nparts, type)) seen.insert(r);
+  }
+  std::multiset<std::string> want(expected.begin(), expected.end());
+  EXPECT_EQV(seen.size(), want.size());
+  EXPECT_TRUE(seen == want);
+}
+
+std::vector<std::string> MakeLines(int n, const std::string& tag) {
+  std::vector<std::string> lines;
+  for (int i = 0; i < n; ++i) {
+    lines.push_back(tag + std::to_string(i) + " 1:0.5 7:" + std::to_string(i % 13));
+  }
+  return lines;
+}
+
+std::string Join(const std::vector<std::string>& lines, const std::string& sep,
+                 bool trailing) {
+  std::string out;
+  for (size_t i = 0; i < lines.size(); ++i) {
+    out += lines[i];
+    if (i + 1 != lines.size() || trailing) out += sep;
+  }
+  return out;
+}
+
+}  // namespace
+
+TESTCASE(text_split_every_row_exactly_once) {
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(473, "r");
+  WriteFile(tmp.path + "/data.txt", Join(lines, "\n", true));
+  for (unsigned nparts : {1u, 2u, 3u, 7u, 16u}) {
+    CheckPartitionUnion(tmp.path + "/data.txt", nparts, "text", lines);
+  }
+}
+
+TESTCASE(text_split_noeol_and_crlf) {
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(101, "x");
+  // no trailing newline
+  WriteFile(tmp.path + "/noeol.txt", Join(lines, "\n", false));
+  CheckPartitionUnion(tmp.path + "/noeol.txt", 4, "text", lines);
+  // CRLF line endings
+  WriteFile(tmp.path + "/crlf.txt", Join(lines, "\r\n", true));
+  CheckPartitionUnion(tmp.path + "/crlf.txt", 4, "text", lines);
+}
+
+TESTCASE(text_split_multi_file_with_noeol_seam) {
+  TemporaryDirectory tmp;
+  auto a = MakeLines(57, "a");
+  auto b = MakeLines(91, "b");
+  auto c = MakeLines(23, "c");
+  // middle file has NO trailing newline: the seam must still separate records
+  WriteFile(tmp.path + "/p0", Join(a, "\n", true));
+  WriteFile(tmp.path + "/p1", Join(b, "\n", false));
+  WriteFile(tmp.path + "/p2", Join(c, "\n", true));
+  std::vector<std::string> all;
+  for (auto* v : {&a, &b, &c}) {
+    for (auto& s : *v) all.push_back(s);
+  }
+  std::string uri = tmp.path + "/p0;" + tmp.path + "/p1;" + tmp.path + "/p2";
+  for (unsigned nparts : {1u, 3u, 5u}) {
+    CheckPartitionUnion(uri, nparts, "text", all);
+  }
+}
+
+TESTCASE(text_split_directory_and_regex) {
+  TemporaryDirectory tmp;
+  auto a = MakeLines(11, "d");
+  auto b = MakeLines(13, "e");
+  WriteFile(tmp.path + "/part-000", Join(a, "\n", true));
+  WriteFile(tmp.path + "/part-001", Join(b, "\n", true));
+  std::vector<std::string> all(a);
+  all.insert(all.end(), b.begin(), b.end());
+  // whole directory
+  CheckPartitionUnion(tmp.path, 2, "text", all);
+  // regex on the trailing component
+  CheckPartitionUnion(tmp.path + "/part-00[01]", 2, "text", all);
+  // regex matching only one file
+  CheckPartitionUnion(tmp.path + "/part-000", 2, "text", a);
+}
+
+TESTCASE(recordio_split_partition_union) {
+  TemporaryDirectory tmp;
+  const uint32_t magic = RecordIOWriter::kMagic;
+  std::vector<std::string> records;
+  for (int i = 0; i < 301; ++i) {
+    std::string r = "payload" + std::to_string(i);
+    if (i % 5 == 0) r.append(reinterpret_cast<const char*>(&magic), 4);  // collisions
+    if (i % 7 == 0) r.append(reinterpret_cast<const char*>(&magic), 4);
+    records.push_back(r);
+  }
+  std::string f1 = tmp.path + "/a.rec", f2 = tmp.path + "/b.rec";
+  {
+    auto fo = Stream::Create(f1.c_str(), "w");
+    RecordIOWriter w(fo.get());
+    for (int i = 0; i < 150; ++i) w.WriteRecord(records[i]);
+  }
+  {
+    auto fo = Stream::Create(f2.c_str(), "w");
+    RecordIOWriter w(fo.get());
+    for (size_t i = 150; i < records.size(); ++i) w.WriteRecord(records[i]);
+  }
+  std::string uri = f1 + ";" + f2;
+  for (unsigned nparts : {1u, 2u, 4u, 9u}) {
+    CheckPartitionUnion(uri, nparts, "recordio", records);
+  }
+}
+
+TESTCASE(recordio_reset_partition_reuse) {
+  TemporaryDirectory tmp;
+  std::vector<std::string> records;
+  for (int i = 0; i < 64; ++i) records.push_back("rec" + std::to_string(i));
+  std::string f = tmp.path + "/data.rec";
+  {
+    auto fo = Stream::Create(f.c_str(), "w");
+    RecordIOWriter w(fo.get());
+    for (auto& r : records) w.WriteRecord(r);
+  }
+  // one split object re-targeted across partitions must cover everything
+  auto split = InputSplit::Create(f.c_str(), 0, 4, "recordio");
+  std::multiset<std::string> seen;
+  for (unsigned part = 0; part < 4; ++part) {
+    split->ResetPartition(part, 4);
+    InputSplit::Blob blob;
+    while (split->NextRecord(&blob)) {
+      seen.insert(std::string(static_cast<const char*>(blob.dptr), blob.size));
+    }
+  }
+  std::multiset<std::string> want(records.begin(), records.end());
+  EXPECT_TRUE(seen == want);
+}
+
+TESTCASE(text_split_epoch_repeatable) {
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(200, "z");
+  WriteFile(tmp.path + "/d.txt", Join(lines, "\n", true));
+  auto split = InputSplit::Create((tmp.path + "/d.txt").c_str(), 1, 3, "text");
+  auto read_all = [&] {
+    std::vector<std::string> out;
+    InputSplit::Blob b;
+    while (split->NextRecord(&b)) out.emplace_back(static_cast<const char*>(b.dptr), b.size);
+    return out;
+  };
+  auto first = read_all();
+  split->BeforeFirst();
+  auto second = read_all();
+  EXPECT_TRUE(!first.empty());
+  EXPECT_TRUE(first == second);
+}
+
+TESTCASE(indexed_recordio_sequential_and_shuffle) {
+  TemporaryDirectory tmp;
+  std::vector<std::string> records;
+  std::string f = tmp.path + "/data.rec";
+  std::string idx = tmp.path + "/data.idx";
+  {
+    auto fo = Stream::Create(f.c_str(), "w");
+    RecordIOWriter w(fo.get());
+    std::string index_text;
+    for (int i = 0; i < 97; ++i) {
+      // record offsets: the writer is at a known position before each write
+      // (Tell not available on Stream; recompute: header 8B + padded payload)
+      records.push_back("idxrec-" + std::to_string(i) + std::string(i % 4, 'p'));
+    }
+    size_t offset = 0;
+    for (size_t i = 0; i < records.size(); ++i) {
+      index_text += std::to_string(i) + "\t" + std::to_string(offset) + "\n";
+      w.WriteRecord(records[i]);
+      size_t padded = (records[i].size() + 3) & ~size_t(3);
+      offset += 8 + padded;  // no magic collisions in these payloads
+    }
+    WriteFile(idx, index_text);
+  }
+  // sequential: partitions by record count, each record exactly once
+  std::multiset<std::string> seen;
+  for (unsigned part = 0; part < 3; ++part) {
+    auto split = InputSplit::Create(f.c_str(), idx.c_str(), part, 3, "indexed_recordio",
+                                    false, 0, 16);
+    InputSplit::Blob b;
+    while (split->NextRecord(&b)) {
+      seen.insert(std::string(static_cast<const char*>(b.dptr), b.size));
+    }
+  }
+  std::multiset<std::string> want(records.begin(), records.end());
+  EXPECT_TRUE(seen == want);
+  // shuffled: same multiset, different order across epochs
+  auto split = InputSplit::Create(f.c_str(), idx.c_str(), 0, 1, "indexed_recordio",
+                                  true, 42, 8);
+  auto read_epoch = [&] {
+    std::vector<std::string> out;
+    InputSplit::Blob b;
+    while (split->NextRecord(&b)) out.emplace_back(static_cast<const char*>(b.dptr), b.size);
+    return out;
+  };
+  auto e1 = read_epoch();
+  split->BeforeFirst();
+  auto e2 = read_epoch();
+  EXPECT_EQV(e1.size(), records.size());
+  EXPECT_TRUE(std::multiset<std::string>(e1.begin(), e1.end()) == want);
+  EXPECT_TRUE(std::multiset<std::string>(e2.begin(), e2.end()) == want);
+  EXPECT_TRUE(e1 != e2);  // astronomically unlikely to coincide
+}
+
+TESTCASE(cached_split_second_epoch_from_cache) {
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(333, "c");
+  WriteFile(tmp.path + "/d.txt", Join(lines, "\n", true));
+  std::string cache = tmp.path + "/cachef";
+  std::string uri = tmp.path + "/d.txt#" + cache;
+  auto split = InputSplit::Create(uri.c_str(), 0, 1, "text");
+  auto read_all = [&] {
+    std::vector<std::string> out;
+    InputSplit::Blob b;
+    while (split->NextRecord(&b)) out.emplace_back(static_cast<const char*>(b.dptr), b.size);
+    return out;
+  };
+  auto first = read_all();
+  EXPECT_EQV(first.size(), lines.size());
+  split->BeforeFirst();  // finalizes cache, swaps to cached iter
+  EXPECT_TRUE(io::LocalFileSystem::GetInstance()
+                  ->GetPathInfo(io::URI(cache)).size > 0);
+  auto second = read_all();
+  EXPECT_TRUE(first == second);
+  // records come back even after the source file is deleted (cache serving)
+  std::filesystem::remove(tmp.path + "/d.txt");
+  split->BeforeFirst();
+  auto third = read_all();
+  EXPECT_TRUE(first == third);
+}
+
+TESTCASE(shuffle_wrapper_coarse_shuffle) {
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(240, "s");
+  WriteFile(tmp.path + "/d.txt", Join(lines, "\n", true));
+  auto split = InputSplitShuffle::Create((tmp.path + "/d.txt").c_str(), 0, 1, "text", 8, 3);
+  split->BeforeFirst();
+  std::vector<std::string> out;
+  InputSplit::Blob b;
+  while (split->NextRecord(&b)) out.emplace_back(static_cast<const char*>(b.dptr), b.size);
+  EXPECT_EQV(out.size(), lines.size());
+  EXPECT_TRUE(std::multiset<std::string>(out.begin(), out.end()) ==
+              std::multiset<std::string>(lines.begin(), lines.end()));
+  EXPECT_TRUE(out != lines);  // order must differ (8 shuffled sub-splits)
+}
+
+TESTMAIN()
