@@ -1,0 +1,76 @@
+// dmlctpu/c_api.h — flat C surface of the native runtime, consumed by the
+// Python package through ctypes (no pybind11 in this build).  All functions
+// return 0 on success / -1 on error (query DmlcTpuGetLastError), except
+// "next" style calls which return 1 = item, 0 = end, -1 = error.
+// Handles are opaque; every *Free is idempotent on NULL.
+#ifndef DMLCTPU_C_API_H_
+#define DMLCTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/*! \brief borrowed view of a parsed CSR batch (uint64 indices, f32 values) */
+typedef struct {
+  uint64_t size;            /* rows */
+  const uint64_t* offset;   /* length size+1, starts at 0 */
+  const float* label;       /* length size */
+  const float* weight;      /* length size, or NULL */
+  const uint64_t* qid;      /* length size, or NULL */
+  const uint64_t* field;    /* length offset[size], or NULL */
+  const uint64_t* index;    /* length offset[size] */
+  const float* value;       /* length offset[size], or NULL (implicit 1.0) */
+} DmlcTpuRowBlockC;
+
+/*! \brief last error message on this thread (empty string if none) */
+const char* DmlcTpuGetLastError(void);
+
+/* ---- Parser: uri → stream of RowBlocks ---------------------------------- */
+typedef void* DmlcTpuParserHandle;
+int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
+                        const char* format, DmlcTpuParserHandle* out);
+int DmlcTpuParserNext(DmlcTpuParserHandle handle, DmlcTpuRowBlockC* out);
+int DmlcTpuParserBeforeFirst(DmlcTpuParserHandle handle);
+int64_t DmlcTpuParserBytesRead(DmlcTpuParserHandle handle);
+void DmlcTpuParserFree(DmlcTpuParserHandle handle);
+
+/* ---- InputSplit: sharded raw records ------------------------------------ */
+typedef void* DmlcTpuInputSplitHandle;
+int DmlcTpuInputSplitCreate(const char* uri, const char* index_uri, unsigned part,
+                            unsigned num_parts, const char* type, int shuffle, int seed,
+                            uint64_t batch_size, DmlcTpuInputSplitHandle* out);
+/*! \brief next record; *data/*size borrowed until the next call */
+int DmlcTpuInputSplitNextRecord(DmlcTpuInputSplitHandle handle, const void** data,
+                                uint64_t* size);
+int DmlcTpuInputSplitNextChunk(DmlcTpuInputSplitHandle handle, const void** data,
+                               uint64_t* size);
+int DmlcTpuInputSplitBeforeFirst(DmlcTpuInputSplitHandle handle);
+int DmlcTpuInputSplitResetPartition(DmlcTpuInputSplitHandle handle, unsigned part,
+                                    unsigned num_parts);
+int64_t DmlcTpuInputSplitTotalSize(DmlcTpuInputSplitHandle handle);
+void DmlcTpuInputSplitFree(DmlcTpuInputSplitHandle handle);
+
+/* ---- RecordIO container ------------------------------------------------- */
+typedef void* DmlcTpuRecordIOWriterHandle;
+typedef void* DmlcTpuRecordIOReaderHandle;
+int DmlcTpuRecordIOWriterCreate(const char* uri, DmlcTpuRecordIOWriterHandle* out);
+int DmlcTpuRecordIOWriterWrite(DmlcTpuRecordIOWriterHandle handle, const void* data,
+                               uint64_t size);
+/*! \brief closes the underlying stream */
+void DmlcTpuRecordIOWriterFree(DmlcTpuRecordIOWriterHandle handle);
+int DmlcTpuRecordIOReaderCreate(const char* uri, DmlcTpuRecordIOReaderHandle* out);
+int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** data,
+                              uint64_t* size);
+void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle);
+
+/* ---- misc ---------------------------------------------------------------- */
+/*! \brief library version string */
+const char* DmlcTpuVersion(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* DMLCTPU_C_API_H_ */

@@ -1,0 +1,162 @@
+// dmlctpu/data.h — sparse row-batch views and the parser interface.
+// Parity: reference include/dmlc/data.h (Row/RowBlock:74-236, DataIter:56,
+// RowBlockIter::Create:267, Parser::Create:307, registry macro:358).
+// The CSR layout is deliberately the same POD shape the TPU staging layer
+// uploads: offset[size+1] + label/weight/qid per row + field/index/value per
+// nonzero — contiguous arrays that pad/bucket cleanly into static XLA shapes.
+#ifndef DMLCTPU_DATA_H_
+#define DMLCTPU_DATA_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+
+#include "./data_iter.h"
+#include "./registry.h"
+
+namespace dmlctpu {
+
+using real_t = float;
+
+/*! \brief one sparse row view (points into a RowBlock) */
+template <typename IndexType, typename DType = real_t>
+struct Row {
+  real_t label;
+  real_t weight;
+  uint64_t qid;
+  size_t length;
+  const IndexType* field;  // may be null
+  const IndexType* index;
+  const DType* value;  // null => implicit 1.0
+
+  inline IndexType get_field(size_t i) const { return field[i]; }
+  inline IndexType get_index(size_t i) const { return index[i]; }
+  inline DType get_value(size_t i) const {
+    return value == nullptr ? DType(1.0f) : value[i];
+  }
+  /*! \brief row · dense-weight dot product (the linear-model hot op) */
+  inline real_t SDot(const real_t* weight_vec, size_t dim) const {
+    real_t sum = 0;
+    if (value == nullptr) {
+      for (size_t i = 0; i < length; ++i) {
+        if (index[i] < dim) sum += weight_vec[index[i]];
+      }
+    } else {
+      for (size_t i = 0; i < length; ++i) {
+        if (index[i] < dim) sum += weight_vec[index[i]] * value[i];
+      }
+    }
+    return sum;
+  }
+};
+
+/*! \brief a CSR batch of rows, all arrays borrowed */
+template <typename IndexType, typename DType = real_t>
+struct RowBlock {
+  size_t size = 0;             // number of rows
+  const size_t* offset = nullptr;   // length size+1
+  const real_t* label = nullptr;    // length size
+  const real_t* weight = nullptr;   // length size or null
+  const uint64_t* qid = nullptr;    // length size or null
+  const IndexType* field = nullptr;  // length offset[size] or null
+  const IndexType* index = nullptr;  // length offset[size]
+  const DType* value = nullptr;      // length offset[size] or null
+
+  inline Row<IndexType, DType> operator[](size_t rowid) const {
+    Row<IndexType, DType> row;
+    row.label = label[rowid];
+    row.weight = weight == nullptr ? 1.0f : weight[rowid];
+    row.qid = qid == nullptr ? 0 : qid[rowid];
+    row.length = offset[rowid + 1] - offset[rowid];
+    row.field = field == nullptr ? nullptr : field + offset[rowid];
+    row.index = index + offset[rowid];
+    row.value = value == nullptr ? nullptr : value + offset[rowid];
+    return row;
+  }
+  /*! \brief sub-range view [begin, end) */
+  inline RowBlock Slice(size_t begin, size_t end) const {
+    RowBlock out = *this;
+    out.size = end - begin;
+    out.offset = offset + begin;
+    out.label = label + begin;
+    out.weight = weight == nullptr ? nullptr : weight + begin;
+    out.qid = qid == nullptr ? nullptr : qid + begin;
+    return out;
+  }
+  /*! \brief approximate in-memory cost in bytes */
+  inline size_t MemCostBytes() const {
+    size_t nnz = offset[size] - offset[0];
+    size_t cost = size * (sizeof(size_t) + sizeof(real_t)) + nnz * sizeof(IndexType);
+    if (weight != nullptr) cost += size * sizeof(real_t);
+    if (qid != nullptr) cost += size * sizeof(uint64_t);
+    if (field != nullptr) cost += nnz * sizeof(IndexType);
+    if (value != nullptr) cost += nnz * sizeof(DType);
+    return cost;
+  }
+};
+
+/*!
+ * \brief streaming parser over a sharded data source, yielding RowBlocks.
+ *        Iteration follows the DataIter pull contract.
+ */
+template <typename IndexType, typename DType = real_t>
+class Parser : public DataIter<RowBlock<IndexType, DType>> {
+ public:
+  /*!
+   * \brief create a parser for part `part` of `num_parts` of uri.
+   * \param type "libsvm" | "csv" | "libfm" | "auto" ("auto" resolves the
+   *        '?format=' URI arg, defaulting to libsvm)
+   */
+  static std::unique_ptr<Parser<IndexType, DType>> Create(const char* uri, unsigned part,
+                                                          unsigned num_parts,
+                                                          const char* type);
+  /*! \brief bytes consumed so far (throughput accounting) */
+  virtual size_t BytesRead() const = 0;
+};
+
+/*! \brief iterator over row blocks with schema info, optionally disk-cached */
+template <typename IndexType, typename DType = real_t>
+class RowBlockIter : public DataIter<RowBlock<IndexType, DType>> {
+ public:
+  /*! \brief create from uri; '#cachefile' sugar selects the disk-backed iter */
+  static std::unique_ptr<RowBlockIter<IndexType, DType>> Create(const char* uri,
+                                                                unsigned part,
+                                                                unsigned num_parts,
+                                                                const char* type);
+  /*! \brief number of columns (max feature index + 1) */
+  virtual size_t NumCol() const = 0;
+};
+
+/*! \brief registry entry for parser factories (plugin surface) */
+template <typename IndexType, typename DType = real_t>
+struct ParserFactoryReg
+    : public FunctionRegEntryBase<ParserFactoryReg<IndexType, DType>> {
+  using Factory = std::function<Parser<IndexType, DType>*(
+      const std::string& path, const std::map<std::string, std::string>& args,
+      unsigned part, unsigned num_parts)>;
+  Factory body;
+
+  ParserFactoryReg& set_body(Factory f) {
+    body = std::move(f);
+    return *this;
+  }
+};
+
+/*!
+ * \brief register a parser for uint32 and uint64 index types:
+ *   DMLCTPU_REGISTER_DATA_PARSER(my_format, DType, CreateFn)
+ */
+#define DMLCTPU_REGISTER_DATA_PARSER(TypeName, DataType, FactoryFn)           \
+  DMLCTPU_REGISTRY_REGISTER(Parser32_##DataType, TypeName,                    \
+                            ::dmlctpu::ParserFactoryReg<uint32_t, DataType>)  \
+      .set_body(FactoryFn<uint32_t, DataType>);                               \
+  DMLCTPU_REGISTRY_REGISTER(Parser64_##DataType, TypeName,                    \
+                            ::dmlctpu::ParserFactoryReg<uint64_t, DataType>)  \
+      .set_body(FactoryFn<uint64_t, DataType>)
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_DATA_H_

@@ -121,18 +121,20 @@ class Registry {
 
 /*!
  * \brief put in exactly one .cc per EntryType to instantiate the singleton.
+ *        Variadic so template types with commas work unparenthesized.
  */
-#define DMLCTPU_REGISTRY_ENABLE(EntryType)              \
-  template <>                                           \
-  ::dmlctpu::Registry<EntryType>* ::dmlctpu::Registry<EntryType>::Get() { \
-    static ::dmlctpu::Registry<EntryType> inst;         \
-    return &inst;                                       \
+#define DMLCTPU_REGISTRY_ENABLE(...)                                        \
+  template <>                                                               \
+  ::dmlctpu::Registry<__VA_ARGS__>* ::dmlctpu::Registry<__VA_ARGS__>::Get() { \
+    static ::dmlctpu::Registry<__VA_ARGS__> inst;                           \
+    return &inst;                                                           \
   }
 
-/*! \brief register an entry at static-init time */
-#define DMLCTPU_REGISTRY_REGISTER(EntryType, EntryTypeName, Name)    \
-  static EntryType& __make_##EntryTypeName##_##Name##__ =            \
-      ::dmlctpu::Registry<EntryType>::Get()->__REGISTER__(#Name)
+/*! \brief register an entry at static-init time; EntryType is the trailing
+ *         (variadic) argument so template commas are legal */
+#define DMLCTPU_REGISTRY_REGISTER(UniqueTag, Name, ...)                     \
+  static __VA_ARGS__& __make_##UniqueTag##_##Name##__ =                     \
+      ::dmlctpu::Registry<__VA_ARGS__>::Get()->__REGISTER__(#Name)
 
 // Link-survival tags (parity: DMLC_REGISTRY_FILE_TAG / LINK_TAG): a static
 // library drops unreferenced objects, which silently loses registrations;

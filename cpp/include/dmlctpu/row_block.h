@@ -1,0 +1,112 @@
+// row_block.h — owning, growable CSR container with binary Save/Load.
+// Parity: reference src/data/row_block.h (Push:*, Save/Load:191-215,
+// max_index/max_field tracking).
+#ifndef DMLCTPU_SRC_DATA_ROW_BLOCK_H_
+#define DMLCTPU_SRC_DATA_ROW_BLOCK_H_
+
+#include <algorithm>
+#include <limits>
+#include <vector>
+
+#include "./data.h"
+#include "./logging.h"
+#include "./stream.h"
+
+namespace dmlctpu {
+namespace data {
+
+template <typename IndexType, typename DType = real_t>
+struct RowBlockContainer {
+  std::vector<size_t> offset{0};
+  std::vector<real_t> label;
+  std::vector<real_t> weight;
+  std::vector<uint64_t> qid;
+  std::vector<IndexType> field;
+  std::vector<IndexType> index;
+  std::vector<DType> value;
+  IndexType max_field = 0;
+  IndexType max_index = 0;
+
+  size_t Size() const { return label.size(); }
+  void Clear() {
+    offset.assign(1, 0);
+    label.clear();
+    weight.clear();
+    qid.clear();
+    field.clear();
+    index.clear();
+    value.clear();
+    max_field = 0;
+    max_index = 0;
+  }
+  size_t MemCostBytes() const {
+    return offset.size() * sizeof(size_t) + label.size() * sizeof(real_t) +
+           weight.size() * sizeof(real_t) + qid.size() * sizeof(uint64_t) +
+           (field.size() + index.size()) * sizeof(IndexType) + value.size() * sizeof(DType);
+  }
+
+  /*! \brief borrow the content as a RowBlock view */
+  RowBlock<IndexType, DType> GetBlock() const {
+    RowBlock<IndexType, DType> b;
+    b.size = Size();
+    b.offset = offset.data();
+    b.label = label.data();
+    b.weight = weight.empty() ? nullptr : weight.data();
+    b.qid = qid.empty() ? nullptr : qid.data();
+    b.field = field.empty() ? nullptr : field.data();
+    b.index = index.empty() ? nullptr : index.data();
+    b.value = value.empty() ? nullptr : value.data();
+    return b;
+  }
+
+  void Push(const Row<IndexType, DType>& row) {
+    label.push_back(row.label);
+    // weight/qid columns materialize lazily; backfill defaults if a row with
+    // a non-default value appears after default-only rows
+    if (row.weight != 1.0f || !weight.empty()) {
+      if (weight.size() + 1 < label.size()) weight.resize(label.size() - 1, 1.0f);
+      weight.push_back(row.weight);
+    }
+    if (row.qid != 0 || !qid.empty()) {
+      if (qid.size() + 1 < label.size()) qid.resize(label.size() - 1, 0);
+      qid.push_back(row.qid);
+    }
+    for (size_t i = 0; i < row.length; ++i) {
+      if (row.field != nullptr) {
+        field.push_back(row.get_field(i));
+        max_field = std::max(max_field, row.get_field(i));
+      }
+      index.push_back(row.get_index(i));
+      max_index = std::max(max_index, row.get_index(i));
+      if (row.value != nullptr) value.push_back(row.get_value(i));
+    }
+    offset.push_back(index.size());
+  }
+  void Push(const RowBlock<IndexType, DType>& batch) {
+    for (size_t i = 0; i < batch.size; ++i) Push(batch[i]);
+  }
+
+  void Save(Stream* fo) const {
+    fo->WriteObj(offset);
+    fo->WriteObj(label);
+    fo->WriteObj(weight);
+    fo->WriteObj(qid);
+    fo->WriteObj(field);
+    fo->WriteObj(index);
+    fo->WriteObj(value);
+    fo->WriteObj(max_field);
+    fo->WriteObj(max_index);
+  }
+  bool Load(Stream* fi) {
+    if (!fi->ReadObj(&offset)) return false;
+    TCHECK(fi->ReadObj(&label) && fi->ReadObj(&weight) && fi->ReadObj(&qid) &&
+           fi->ReadObj(&field) && fi->ReadObj(&index) && fi->ReadObj(&value) &&
+           fi->ReadObj(&max_field) && fi->ReadObj(&max_index))
+        << "corrupt RowBlockContainer stream";
+    return true;
+  }
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_ROW_BLOCK_H_

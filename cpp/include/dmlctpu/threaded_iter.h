@@ -190,19 +190,23 @@ class ThreadedIter : public DataIter<DType> {
         if (destroyed_) return;
         if (reset_requested_) {
           lk.unlock();
-          bool ok = true;
+          // capture into a local exception_ptr and publish it under the lock
+          // AFTER the catch scope closed, so this thread never holds the last
+          // reference once the consumer can see it (the final release would
+          // otherwise race with the consumer reading the rethrown exception)
+          std::exception_ptr caught;
           try {
             if (before_first_fn_) before_first_fn_();
           } catch (...) {
-            ok = false;
-            std::lock_guard<std::mutex> lk2(mu_);
-            if (!eptr_) eptr_ = std::current_exception();
-            state_ = State::kEnd;
+            caught = std::current_exception();
           }
           {
             std::lock_guard<std::mutex> lk2(mu_);
             reset_requested_ = false;
-            if (!ok) state_ = State::kEnd;
+            if (caught) {
+              if (!eptr_) eptr_ = std::move(caught);
+              state_ = State::kEnd;
+            }
           }
           cv_consumer_.notify_all();
           continue;
@@ -215,21 +219,25 @@ class ThreadedIter : public DataIter<DType> {
         producer_busy_ = true;
       }
       bool has_next = false;
+      std::exception_ptr caught;
       try {
         has_next = next_fn_(&cell);
       } catch (...) {
-        std::lock_guard<std::mutex> lk(mu_);
-        producer_busy_ = false;
+        caught = std::current_exception();
+      }
+      // the catch scope is closed: `caught` is our only reference, moved into
+      // eptr_ under the lock so the consumer's rethrow owns the last release
+      std::lock_guard<std::mutex> lk(mu_);
+      producer_busy_ = false;
+      if (caught) {
         if (cell != nullptr) free_cells_.push_back(cell);
         if (generation_ == gen) {
-          if (!eptr_) eptr_ = std::current_exception();
+          if (!eptr_) eptr_ = std::move(caught);
           state_ = State::kEnd;
         }
         cv_consumer_.notify_all();
         continue;
       }
-      std::lock_guard<std::mutex> lk(mu_);
-      producer_busy_ = false;
       if (generation_ != gen) {
         // a BeforeFirst()/Pause() raced with this production: the item belongs
         // to the previous epoch — drop it and re-examine state on the next spin

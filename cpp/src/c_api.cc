@@ -1,0 +1,202 @@
+// C API implementation: exception → error-string translation at the boundary.
+#include "dmlctpu/c_api.h"
+
+#include <memory>
+#include <string>
+
+#include "dmlctpu/data.h"
+#include "dmlctpu/input_split.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/recordio.h"
+#include "dmlctpu/stream.h"
+
+namespace {
+
+thread_local std::string last_error;
+
+template <typename Fn>
+int Guard(Fn&& fn) {
+  try {
+    return std::forward<Fn>(fn)();
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return -1;
+  } catch (...) {
+    last_error = "unknown native error";
+    return -1;
+  }
+}
+
+struct ParserCtx {
+  std::unique_ptr<dmlctpu::Parser<uint64_t, float>> parser;
+};
+struct SplitCtx {
+  std::unique_ptr<dmlctpu::InputSplit> split;
+};
+struct WriterCtx {
+  std::unique_ptr<dmlctpu::Stream> stream;
+  std::unique_ptr<dmlctpu::RecordIOWriter> writer;
+};
+struct ReaderCtx {
+  std::unique_ptr<dmlctpu::Stream> stream;
+  std::unique_ptr<dmlctpu::RecordIOReader> reader;
+  std::string record;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* DmlcTpuGetLastError(void) { return last_error.c_str(); }
+const char* DmlcTpuVersion(void) { return "0.1.0"; }
+
+int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
+                        const char* format, DmlcTpuParserHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<ParserCtx>();
+    ctx->parser = dmlctpu::Parser<uint64_t, float>::Create(uri, part, num_parts, format);
+    ctx->parser->BeforeFirst();
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuParserNext(DmlcTpuParserHandle handle, DmlcTpuRowBlockC* out) {
+  return Guard([&] {
+    auto* ctx = static_cast<ParserCtx*>(handle);
+    if (!ctx->parser->Next()) return 0;
+    const auto& b = ctx->parser->Value();
+    out->size = b.size;
+    out->offset = b.offset;
+    out->label = b.label;
+    out->weight = b.weight;
+    out->qid = b.qid;
+    out->field = b.field;
+    out->index = b.index;
+    out->value = b.value;
+    return 1;
+  });
+}
+
+int DmlcTpuParserBeforeFirst(DmlcTpuParserHandle handle) {
+  return Guard([&] {
+    static_cast<ParserCtx*>(handle)->parser->BeforeFirst();
+    return 0;
+  });
+}
+
+int64_t DmlcTpuParserBytesRead(DmlcTpuParserHandle handle) {
+  return static_cast<int64_t>(static_cast<ParserCtx*>(handle)->parser->BytesRead());
+}
+
+void DmlcTpuParserFree(DmlcTpuParserHandle handle) {
+  delete static_cast<ParserCtx*>(handle);
+}
+
+int DmlcTpuInputSplitCreate(const char* uri, const char* index_uri, unsigned part,
+                            unsigned num_parts, const char* type, int shuffle, int seed,
+                            uint64_t batch_size, DmlcTpuInputSplitHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<SplitCtx>();
+    ctx->split = dmlctpu::InputSplit::Create(uri, index_uri, part, num_parts, type,
+                                             shuffle != 0, seed, batch_size);
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuInputSplitNextRecord(DmlcTpuInputSplitHandle handle, const void** data,
+                                uint64_t* size) {
+  return Guard([&] {
+    auto* ctx = static_cast<SplitCtx*>(handle);
+    dmlctpu::InputSplit::Blob blob;
+    if (!ctx->split->NextRecord(&blob)) return 0;
+    *data = blob.dptr;
+    *size = blob.size;
+    return 1;
+  });
+}
+
+int DmlcTpuInputSplitNextChunk(DmlcTpuInputSplitHandle handle, const void** data,
+                               uint64_t* size) {
+  return Guard([&] {
+    auto* ctx = static_cast<SplitCtx*>(handle);
+    dmlctpu::InputSplit::Blob blob;
+    if (!ctx->split->NextChunk(&blob)) return 0;
+    *data = blob.dptr;
+    *size = blob.size;
+    return 1;
+  });
+}
+
+int DmlcTpuInputSplitBeforeFirst(DmlcTpuInputSplitHandle handle) {
+  return Guard([&] {
+    static_cast<SplitCtx*>(handle)->split->BeforeFirst();
+    return 0;
+  });
+}
+
+int DmlcTpuInputSplitResetPartition(DmlcTpuInputSplitHandle handle, unsigned part,
+                                    unsigned num_parts) {
+  return Guard([&] {
+    static_cast<SplitCtx*>(handle)->split->ResetPartition(part, num_parts);
+    return 0;
+  });
+}
+
+int64_t DmlcTpuInputSplitTotalSize(DmlcTpuInputSplitHandle handle) {
+  return static_cast<int64_t>(static_cast<SplitCtx*>(handle)->split->GetTotalSize());
+}
+
+void DmlcTpuInputSplitFree(DmlcTpuInputSplitHandle handle) {
+  delete static_cast<SplitCtx*>(handle);
+}
+
+int DmlcTpuRecordIOWriterCreate(const char* uri, DmlcTpuRecordIOWriterHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<WriterCtx>();
+    ctx->stream = dmlctpu::Stream::Create(uri, "w");
+    ctx->writer = std::make_unique<dmlctpu::RecordIOWriter>(ctx->stream.get());
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuRecordIOWriterWrite(DmlcTpuRecordIOWriterHandle handle, const void* data,
+                               uint64_t size) {
+  return Guard([&] {
+    static_cast<WriterCtx*>(handle)->writer->WriteRecord(data, size);
+    return 0;
+  });
+}
+
+void DmlcTpuRecordIOWriterFree(DmlcTpuRecordIOWriterHandle handle) {
+  delete static_cast<WriterCtx*>(handle);
+}
+
+int DmlcTpuRecordIOReaderCreate(const char* uri, DmlcTpuRecordIOReaderHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<ReaderCtx>();
+    ctx->stream = dmlctpu::Stream::Create(uri, "r");
+    ctx->reader = std::make_unique<dmlctpu::RecordIOReader>(ctx->stream.get());
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** data,
+                              uint64_t* size) {
+  return Guard([&] {
+    auto* ctx = static_cast<ReaderCtx*>(handle);
+    if (!ctx->reader->NextRecord(&ctx->record)) return 0;
+    *data = ctx->record.data();
+    *size = ctx->record.size();
+    return 1;
+  });
+}
+
+void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle) {
+  delete static_cast<ReaderCtx*>(handle);
+}
+
+}  // extern "C"

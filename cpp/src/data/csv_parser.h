@@ -1,0 +1,118 @@
+// csv_parser.h — dense CSV → sparse RowBlock parser.
+// Parity: reference src/data/csv_parser.h (param:24-40, ParseBlock:74-147):
+// label_column / weight_column extraction, configurable single-char
+// delimiter, missing value = omitted cell (empty field), float/int32/int64
+// payloads.
+#ifndef DMLCTPU_SRC_DATA_CSV_PARSER_H_
+#define DMLCTPU_SRC_DATA_CSV_PARSER_H_
+
+#include <cmath>
+#include <limits>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+
+#include "./text_parser.h"
+#include "dmlctpu/parameter.h"
+#include "dmlctpu/strtonum.h"
+
+namespace dmlctpu {
+namespace data {
+
+struct CSVParserParam : public Parameter<CSVParserParam> {
+  std::string format;
+  int label_column;
+  std::string delimiter;
+  int weight_column;
+  DMLCTPU_DECLARE_PARAMETER(CSVParserParam) {
+    DMLCTPU_DECLARE_FIELD(format).set_default("csv").describe("file format");
+    DMLCTPU_DECLARE_FIELD(label_column)
+        .set_default(-1)
+        .describe("column index holding the label; -1 = no label column");
+    DMLCTPU_DECLARE_FIELD(delimiter).set_default(",").describe("field delimiter character");
+    DMLCTPU_DECLARE_FIELD(weight_column)
+        .set_default(-1)
+        .describe("column index holding the instance weight; -1 = none");
+  }
+};
+
+template <typename IndexType, typename DType = real_t>
+class CSVParser : public TextParserBase<IndexType, DType> {
+ public:
+  CSVParser(std::unique_ptr<InputSplit> source,
+            const std::map<std::string, std::string>& args, int nthread)
+      : TextParserBase<IndexType, DType>(std::move(source), nthread) {
+    param_.Init(args);
+    TCHECK_EQ(param_.delimiter.size(), 1u) << "delimiter must be one character";
+    delim_ = param_.delimiter[0];
+  }
+
+ protected:
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType, DType>* out) override {
+    out->Clear();
+    const char* p = begin;
+    while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+    while (p != end) {
+      const char* line_end = p;
+      while (line_end != end && *line_end != '\n' && *line_end != '\r' &&
+             *line_end != '\0') {
+        ++line_end;
+      }
+      ParseLine(p, line_end, out);
+      p = line_end;
+      while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+    }
+  }
+
+ private:
+  void ParseLine(const char* p, const char* end, RowBlockContainer<IndexType, DType>* out) {
+    int column = 0;
+    IndexType feat = 0;
+    DType label = DType(0);
+    real_t weight = std::numeric_limits<real_t>::quiet_NaN();
+    bool any_field = false;
+    while (true) {
+      // one cell: [p, cell_end)
+      const char* cell_end = p;
+      while (cell_end != end && *cell_end != delim_) ++cell_end;
+      DType v{};
+      const char* q = p;
+      bool has_value = TryParseNum(&q, cell_end, &v);
+      if (column == param_.label_column) {
+        if (has_value) label = v;
+      } else if (std::is_same_v<DType, real_t> && column == param_.weight_column) {
+        if (has_value) weight = static_cast<real_t>(v);
+      } else {
+        if (has_value) {
+          out->value.push_back(v);
+          out->index.push_back(feat);
+          out->max_index = std::max(out->max_index, feat);
+        }
+        ++feat;  // missing cells still advance the feature position
+        any_field = true;
+      }
+      ++column;
+      if (cell_end == end) break;
+      p = cell_end + 1;
+    }
+    TCHECK(any_field || param_.label_column >= 0)
+        << "csv line with no parseable field (check the delimiter '" << delim_ << "')";
+    out->label.push_back(static_cast<real_t>(label));
+    if (!std::isnan(weight)) {
+      if (out->weight.size() + 1 < out->label.size()) {
+        out->weight.resize(out->label.size() - 1, 1.0f);
+      }
+      out->weight.push_back(weight);
+    }
+    out->offset.push_back(out->index.size());
+  }
+
+  CSVParserParam param_;
+  char delim_ = ',';
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_CSV_PARSER_H_

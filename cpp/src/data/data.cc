@@ -1,0 +1,125 @@
+// data.cc — parser factory wiring: registry enable, built-in parser
+// registration (libsvm/csv/libfm × uint32/uint64 index × float/int32/int64
+// value), Parser::Create / RowBlockIter::Create dispatch.
+// Parity: reference src/data.cc (CreateParser_:62-85, CreateIter_:87-107,
+// registrations:230-256, explicit instantiations:114-221).
+#include "dmlctpu/data.h"
+
+#include <memory>
+#include <string>
+
+#include "./basic_row_iter.h"
+#include "./csv_parser.h"
+#include "./disk_row_iter.h"
+#include "./libfm_parser.h"
+#include "./libsvm_parser.h"
+#include "./parser_impl.h"
+#include "dmlctpu/input_split.h"
+#include "dmlctpu/io/filesystem.h"
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+
+// registry singletons for every (IndexType, DType) combination
+DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint32_t, real_t>);
+DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint32_t, int32_t>);
+DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint32_t, int64_t>);
+DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint64_t, real_t>);
+DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint64_t, int32_t>);
+DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint64_t, int64_t>);
+
+namespace data {
+
+template <template <typename, typename> class ParserCls, typename IndexType, typename DType>
+Parser<IndexType, DType>* CreateTextParser(const std::string& path,
+                                           const std::map<std::string, std::string>& args,
+                                           unsigned part, unsigned num_parts) {
+  auto source = InputSplit::Create(path.c_str(), part, num_parts, "text");
+  auto base = std::make_unique<ParserCls<IndexType, DType>>(std::move(source), args, 2);
+  return new ThreadedParser<IndexType, DType>(std::move(base));
+}
+
+template <typename IndexType, typename DType>
+Parser<IndexType, DType>* CreateLibSVMParser(const std::string& path,
+                                             const std::map<std::string, std::string>& args,
+                                             unsigned part, unsigned num_parts) {
+  return CreateTextParser<LibSVMParser, IndexType, DType>(path, args, part, num_parts);
+}
+template <typename IndexType, typename DType>
+Parser<IndexType, DType>* CreateCSVParser(const std::string& path,
+                                          const std::map<std::string, std::string>& args,
+                                          unsigned part, unsigned num_parts) {
+  return CreateTextParser<CSVParser, IndexType, DType>(path, args, part, num_parts);
+}
+template <typename IndexType, typename DType>
+Parser<IndexType, DType>* CreateLibFMParser(const std::string& path,
+                                            const std::map<std::string, std::string>& args,
+                                            unsigned part, unsigned num_parts) {
+  return CreateTextParser<LibFMParser, IndexType, DType>(path, args, part, num_parts);
+}
+
+/*! \brief resolve type ("auto" → ?format= arg → libsvm) through the registry */
+template <typename IndexType, typename DType>
+Parser<IndexType, DType>* CreateParserImpl(const char* uri_, unsigned part,
+                                           unsigned num_parts, const char* type) {
+  std::string ptype = type;
+  io::URISpec spec(uri_, part, num_parts);
+  if (ptype == "auto") {
+    auto it = spec.args.find("format");
+    ptype = (it != spec.args.end()) ? it->second : "libsvm";
+  }
+  const auto* entry = Registry<ParserFactoryReg<IndexType, DType>>::Get()->Find(ptype);
+  TCHECK(entry != nullptr) << "unknown data format '" << ptype << "'";
+  return entry->body(spec.uri, spec.args, part, num_parts);
+}
+
+template <typename IndexType, typename DType>
+RowBlockIter<IndexType, DType>* CreateIterImpl(const char* uri_, unsigned part,
+                                               unsigned num_parts, const char* type) {
+  io::URISpec spec(uri_, part, num_parts);
+  std::unique_ptr<Parser<IndexType, DType>> parser(
+      CreateParserImpl<IndexType, DType>(uri_, part, num_parts, type));
+  if (!spec.cache_file.empty()) {
+    return new DiskRowIter<IndexType, DType>(std::move(parser), spec.cache_file.c_str(),
+                                             /*reuse_cache=*/true);
+  }
+  return new BasicRowIter<IndexType, DType>(std::move(parser));
+}
+
+}  // namespace data
+
+// built-in format registrations
+DMLCTPU_REGISTER_DATA_PARSER(libsvm, real_t, data::CreateLibSVMParser)
+    .describe("LibSVM sparse text format: label[:weight] [qid:n] idx[:val]...");
+DMLCTPU_REGISTER_DATA_PARSER(csv, real_t, data::CreateCSVParser)
+    .describe("dense CSV with configurable label/weight columns and delimiter");
+DMLCTPU_REGISTER_DATA_PARSER(csv, int32_t, data::CreateCSVParser);
+DMLCTPU_REGISTER_DATA_PARSER(csv, int64_t, data::CreateCSVParser);
+DMLCTPU_REGISTER_DATA_PARSER(libfm, real_t, data::CreateLibFMParser)
+    .describe("libFM text format: label field:index:value ...");
+
+// ---- public factory entry points -------------------------------------------
+template <typename IndexType, typename DType>
+std::unique_ptr<Parser<IndexType, DType>> Parser<IndexType, DType>::Create(
+    const char* uri, unsigned part, unsigned num_parts, const char* type) {
+  return std::unique_ptr<Parser<IndexType, DType>>(
+      data::CreateParserImpl<IndexType, DType>(uri, part, num_parts, type));
+}
+template <typename IndexType, typename DType>
+std::unique_ptr<RowBlockIter<IndexType, DType>> RowBlockIter<IndexType, DType>::Create(
+    const char* uri, unsigned part, unsigned num_parts, const char* type) {
+  return std::unique_ptr<RowBlockIter<IndexType, DType>>(
+      data::CreateIterImpl<IndexType, DType>(uri, part, num_parts, type));
+}
+
+// explicit instantiations of the public surface
+template class Parser<uint32_t, real_t>;
+template class Parser<uint32_t, int32_t>;
+template class Parser<uint32_t, int64_t>;
+template class Parser<uint64_t, real_t>;
+template class Parser<uint64_t, int32_t>;
+template class Parser<uint64_t, int64_t>;
+template class RowBlockIter<uint32_t, real_t>;
+template class RowBlockIter<uint64_t, real_t>;
+
+}  // namespace dmlctpu

@@ -1,0 +1,115 @@
+// disk_row_iter.h — disk-backed RowBlockIter: parses once into ~64MB
+// RowBlockContainer pages appended to a cache file; every epoch replays pages
+// through a ThreadedIter.  Selected by the '#cachefile' URI sugar.
+// Parity: reference src/data/disk_row_iter.h (kPageSize:32, BuildCache:111,
+// TryLoadCache:96).
+#ifndef DMLCTPU_SRC_DATA_DISK_ROW_ITER_H_
+#define DMLCTPU_SRC_DATA_DISK_ROW_ITER_H_
+
+#include <memory>
+#include <string>
+#include <utility>
+
+#include "./parser_impl.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/stream.h"
+#include "dmlctpu/threaded_iter.h"
+#include "dmlctpu/timer.h"
+
+namespace dmlctpu {
+namespace data {
+
+template <typename IndexType, typename DType = real_t>
+class DiskRowIter : public RowBlockIter<IndexType, DType> {
+ public:
+  using Container = RowBlockContainer<IndexType, DType>;
+  static constexpr size_t kPageBytes = 64u << 20u;
+  static constexpr uint64_t kCacheMagic = 0x74707564726f7769ull;  // "tpudrowi"
+
+  DiskRowIter(std::unique_ptr<Parser<IndexType, DType>> parser, const char* cache_file,
+              bool reuse_cache)
+      : cache_file_(cache_file), iter_(4) {
+    if (!reuse_cache || !TryLoadCache()) {
+      BuildCache(parser.get());
+      TCHECK(TryLoadCache()) << "failed to reopen freshly built cache " << cache_file_;
+    }
+  }
+  ~DiskRowIter() override { iter_.Destroy(); }
+
+  void BeforeFirst() override { iter_.BeforeFirst(); }
+  bool Next() override {
+    if (!iter_.Next()) return false;
+    block_ = iter_.Value().GetBlock();
+    return true;
+  }
+  const RowBlock<IndexType, DType>& Value() const override { return block_; }
+  size_t NumCol() const override { return num_col_; }
+
+ private:
+  bool TryLoadCache() {
+    auto fi = SeekStream::CreateForRead(cache_file_.c_str(), /*allow_null=*/true);
+    if (fi == nullptr) return false;
+    uint64_t magic, ncol;
+    if (!fi->ReadObj(&magic) || magic != kCacheMagic || !fi->ReadObj(&ncol)) return false;
+    num_col_ = ncol;
+    fi_ = std::move(fi);
+    data_begin_ = fi_->Tell();
+    iter_.Init(
+        [this](Container** cell) {
+          if (*cell == nullptr) *cell = new Container();
+          return (*cell)->Load(fi_.get());
+        },
+        [this] { fi_->Seek(data_begin_); });
+    return true;
+  }
+
+  void BuildCache(Parser<IndexType, DType>* parser) {
+    auto fo = Stream::Create(cache_file_.c_str(), "w");
+    uint64_t magic = kCacheMagic, ncol = 0;
+    fo->WriteObj(magic);
+    fo->WriteObj(ncol);  // patched after the pass
+    Container page;
+    Stopwatch watch;
+    size_t rows = 0;
+    parser->BeforeFirst();
+    while (parser->Next()) {
+      page.Push(parser->Value());
+      ncol = std::max<uint64_t>(ncol, static_cast<uint64_t>(page.max_index) + 1);
+      if (page.MemCostBytes() >= kPageBytes) {
+        page.Save(fo.get());
+        rows += page.Size();
+        page.Clear();
+      }
+    }
+    if (page.Size() != 0) {
+      page.Save(fo.get());
+      rows += page.Size();
+    }
+    fo.reset();
+    // patch the column count in the header
+    {
+      std::FILE* fp = std::fopen(cache_file_.c_str(), "r+b");
+      TCHECK(fp != nullptr);
+      std::fseek(fp, sizeof(uint64_t), SEEK_SET);
+      uint64_t ncol_le = ncol;
+      if (kIONeedsByteSwap) ByteSwap(&ncol_le, sizeof(ncol_le), 1);
+      std::fwrite(&ncol_le, sizeof(ncol_le), 1, fp);
+      std::fclose(fp);
+    }
+    double elapsed = watch.Elapsed();
+    TLOG(Info) << "cached " << rows << " rows to " << cache_file_ << " in " << elapsed
+               << "s (" << (parser->BytesRead() / (std::max(elapsed, 1e-9) * 1e6))
+               << " MB/sec)";
+  }
+
+  std::string cache_file_;
+  std::unique_ptr<SeekStream> fi_;
+  size_t data_begin_ = 0;
+  size_t num_col_ = 0;
+  ThreadedIter<Container> iter_;
+  RowBlock<IndexType, DType> block_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_DISK_ROW_ITER_H_

@@ -1,0 +1,92 @@
+// libfm_parser.h — "label field:index:value ..." factorization-machine format.
+// Parity: reference src/data/libfm_parser.h (ParseBlock:67-144, shared
+// indexing heuristic applied to both field and index).
+#ifndef DMLCTPU_SRC_DATA_LIBFM_PARSER_H_
+#define DMLCTPU_SRC_DATA_LIBFM_PARSER_H_
+
+#include <limits>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+
+#include "./text_parser.h"
+#include "dmlctpu/parameter.h"
+#include "dmlctpu/strtonum.h"
+
+namespace dmlctpu {
+namespace data {
+
+struct LibFMParserParam : public Parameter<LibFMParserParam> {
+  std::string format;
+  int indexing_mode;
+  DMLCTPU_DECLARE_PARAMETER(LibFMParserParam) {
+    DMLCTPU_DECLARE_FIELD(format).set_default("libfm").describe("file format");
+    DMLCTPU_DECLARE_FIELD(indexing_mode)
+        .set_default(0)
+        .describe(">0: 1-based field/index; 0: 0-based; <0: auto-detect");
+  }
+};
+
+template <typename IndexType, typename DType = real_t>
+class LibFMParser : public TextParserBase<IndexType, DType> {
+ public:
+  LibFMParser(std::unique_ptr<InputSplit> source,
+              const std::map<std::string, std::string>& args, int nthread)
+      : TextParserBase<IndexType, DType>(std::move(source), nthread) {
+    param_.Init(args);
+  }
+
+ protected:
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType, DType>* out) override {
+    out->Clear();
+    IndexType min_field = std::numeric_limits<IndexType>::max();
+    IndexType min_index = std::numeric_limits<IndexType>::max();
+    const char* p = begin;
+    while (p != end) {
+      const char* line_end = p;
+      while (line_end != end && *line_end != '\n' && *line_end != '\r' && *line_end != '\0') {
+        ++line_end;
+      }
+      // label
+      const char* q = p;
+      real_t label;
+      if (TryParseNum(&q, line_end, &label)) {
+        out->label.push_back(label);
+        // field:index:value triples
+        while (true) {
+          while (q != line_end && IsSpaceChar(*q)) ++q;
+          if (q == line_end) break;
+          IndexType field, index;
+          DType value;
+          if (!ParseTriple(&q, line_end, ':', &field, &index, &value)) break;
+          out->field.push_back(field);
+          out->index.push_back(index);
+          out->value.push_back(value);
+          out->max_field = std::max(out->max_field, field);
+          out->max_index = std::max(out->max_index, index);
+          min_field = std::min(min_field, field);
+          min_index = std::min(min_index, index);
+        }
+        out->offset.push_back(out->index.size());
+      }
+      p = line_end;
+      while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+    }
+    if (param_.indexing_mode > 0 ||
+        (param_.indexing_mode < 0 && !out->index.empty() && min_field > 0 && min_index > 0)) {
+      for (IndexType& f : out->field) --f;
+      for (IndexType& i : out->index) --i;
+      if (out->max_field > 0) --out->max_field;
+      if (out->max_index > 0) --out->max_index;
+    }
+  }
+
+ private:
+  LibFMParserParam param_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_LIBFM_PARSER_H_

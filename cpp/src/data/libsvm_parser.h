@@ -1,0 +1,120 @@
+// libsvm_parser.h — "label[:weight] [qid:n] idx[:val]..." text parser with
+// '#' comments and 0/1-based indexing auto-detection.
+// Parity: reference src/data/libsvm_parser.h (param:24-39, ParseBlock:87-169,
+// sklearn-style indexing heuristic:159-168).
+#ifndef DMLCTPU_SRC_DATA_LIBSVM_PARSER_H_
+#define DMLCTPU_SRC_DATA_LIBSVM_PARSER_H_
+
+#include <cstring>
+#include <limits>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+
+#include "./text_parser.h"
+#include "dmlctpu/parameter.h"
+#include "dmlctpu/strtonum.h"
+
+namespace dmlctpu {
+namespace data {
+
+struct LibSVMParserParam : public Parameter<LibSVMParserParam> {
+  std::string format;
+  int indexing_mode;
+  DMLCTPU_DECLARE_PARAMETER(LibSVMParserParam) {
+    DMLCTPU_DECLARE_FIELD(format).set_default("libsvm").describe("file format");
+    DMLCTPU_DECLARE_FIELD(indexing_mode)
+        .set_default(0)
+        .describe(
+            ">0: indices are 1-based; 0: 0-based; <0: auto-detect "
+            "(1-based iff every index in the block is > 0, like "
+            "sklearn.datasets.load_svmlight_file)");
+  }
+};
+
+template <typename IndexType, typename DType = real_t>
+class LibSVMParser : public TextParserBase<IndexType, DType> {
+ public:
+  LibSVMParser(std::unique_ptr<InputSplit> source,
+               const std::map<std::string, std::string>& args, int nthread)
+      : TextParserBase<IndexType, DType>(std::move(source), nthread) {
+    param_.Init(args);
+  }
+
+ protected:
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType, DType>* out) override {
+    out->Clear();
+    IndexType min_index = std::numeric_limits<IndexType>::max();
+    const char* p = begin;
+    while (p != end) {
+      const char* line_end = p;
+      while (line_end != end && *line_end != '\n' && *line_end != '\r' && *line_end != '\0') {
+        ++line_end;
+      }
+      ParseLine(p, line_end, out, &min_index);
+      p = line_end;
+      while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+    }
+    // indexing-mode resolution
+    if (param_.indexing_mode > 0 ||
+        (param_.indexing_mode < 0 && !out->index.empty() && min_index > 0)) {
+      for (IndexType& idx : out->index) --idx;
+      if (out->max_index > 0) --out->max_index;
+    }
+  }
+
+ private:
+  void ParseLine(const char* p, const char* end, RowBlockContainer<IndexType, DType>* out,
+                 IndexType* min_index) {
+    SkipSpaceAndComment(&p, end);
+    real_t label, weight = 1.0f;
+    bool has_weight = false;
+    if (!ParsePair<real_t, real_t>(&p, end, ':', &label, &weight, &has_weight)) {
+      return;  // blank / comment-only line
+    }
+    out->label.push_back(label);
+    if (has_weight) {
+      if (out->weight.size() + 1 < out->label.size()) {
+        out->weight.resize(out->label.size() - 1, 1.0f);
+      }
+      out->weight.push_back(weight);
+    }
+    // optional qid:n
+    SkipSpaceAndComment(&p, end);
+    if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
+      p += 4;
+      uint64_t qid = ParseNum<uint64_t>(&p, end);
+      if (out->qid.size() + 1 < out->label.size()) {
+        out->qid.resize(out->label.size() - 1, 0);
+      }
+      out->qid.push_back(qid);
+    }
+    // features idx[:val]
+    while (true) {
+      SkipSpaceAndComment(&p, end);
+      if (p == end) break;
+      IndexType idx;
+      DType val;
+      bool has_val = false;
+      if (!ParsePair<IndexType, DType>(&p, end, ':', &idx, &val, &has_val)) break;
+      out->index.push_back(idx);
+      out->max_index = std::max(out->max_index, idx);
+      *min_index = std::min(*min_index, idx);
+      if (has_val) out->value.push_back(val);
+    }
+    out->offset.push_back(out->index.size());
+  }
+
+  static void SkipSpaceAndComment(const char** p, const char* end) {
+    while (*p != end && IsSpaceChar(**p)) ++*p;
+    if (*p != end && **p == '#') *p = end;  // rest of line is a comment
+  }
+
+  LibSVMParserParam param_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_LIBSVM_PARSER_H_

@@ -1,0 +1,123 @@
+// parser_impl.h — parser base (iterates per-thread RowBlockContainers) and
+// the parse-ahead ThreadedParser wrapper.
+// Parity: reference src/data/parser.h (ParserImpl:24-66, ThreadedParser
+// capacity-8:71-126).
+#ifndef DMLCTPU_SRC_DATA_PARSER_IMPL_H_
+#define DMLCTPU_SRC_DATA_PARSER_IMPL_H_
+
+#include <memory>
+#include <utility>
+#include <vector>
+
+#include "dmlctpu/row_block.h"
+#include "dmlctpu/data.h"
+#include "dmlctpu/threaded_iter.h"
+
+namespace dmlctpu {
+namespace data {
+
+/*!
+ * \brief base implementation: ParseNext fills a vector of containers (one per
+ *        parse thread); Next() walks them one block at a time.
+ */
+template <typename IndexType, typename DType = real_t>
+class ParserImpl : public Parser<IndexType, DType> {
+ public:
+  using Blocks = std::vector<RowBlockContainer<IndexType, DType>>;
+
+  void BeforeFirst() override {
+    at_head_ = true;
+    blk_ptr_ = 0;
+    data_.clear();
+  }
+  bool Next() override {
+    while (true) {
+      while (blk_ptr_ < data_.size()) {
+        if (data_[blk_ptr_].Size() == 0) {
+          ++blk_ptr_;
+          continue;
+        }
+        block_ = data_[blk_ptr_].GetBlock();
+        ++blk_ptr_;
+        return true;
+      }
+      if (!ParseNext(&data_)) return false;
+      blk_ptr_ = 0;
+    }
+  }
+  const RowBlock<IndexType, DType>& Value() const override { return block_; }
+  size_t BytesRead() const override { return bytes_read_; }
+  /*! \brief public forwarding shim used by ThreadedParser's producer lambda */
+  bool CallParseNext(Blocks* data) { return ParseNext(data); }
+
+ protected:
+  /*! \brief fill data with freshly parsed blocks; false at end of source */
+  virtual bool ParseNext(Blocks* data) = 0;
+
+  size_t bytes_read_ = 0;
+
+ private:
+  bool at_head_ = true;
+  size_t blk_ptr_ = 0;
+  Blocks data_;
+  RowBlock<IndexType, DType> block_;
+};
+
+/*!
+ * \brief runs any ParserImpl's ParseNext on a background thread with a
+ *        bounded queue of parsed block-vectors (parse-ahead pipeline stage).
+ */
+template <typename IndexType, typename DType = real_t>
+class ThreadedParser : public Parser<IndexType, DType> {
+ public:
+  using Blocks = std::vector<RowBlockContainer<IndexType, DType>>;
+
+  explicit ThreadedParser(std::unique_ptr<ParserImpl<IndexType, DType>> base)
+      : base_(std::move(base)), iter_(8) {
+    iter_.Init(
+        [this](Blocks** cell) {
+          if (*cell == nullptr) *cell = new Blocks();
+          return base_->CallParseNext(*cell);
+        },
+        [this] { base_->BeforeFirst(); });
+  }
+  ~ThreadedParser() override {
+    iter_.Destroy();
+    delete tmp_;
+  }
+
+  void BeforeFirst() override {
+    iter_.BeforeFirst();
+    if (tmp_ != nullptr) iter_.Recycle(&tmp_);
+    blk_ptr_ = 0;
+  }
+  bool Next() override {
+    while (true) {
+      while (tmp_ != nullptr && blk_ptr_ < tmp_->size()) {
+        if ((*tmp_)[blk_ptr_].Size() == 0) {
+          ++blk_ptr_;
+          continue;
+        }
+        block_ = (*tmp_)[blk_ptr_].GetBlock();
+        ++blk_ptr_;
+        return true;
+      }
+      if (tmp_ != nullptr) iter_.Recycle(&tmp_);
+      if (!iter_.Next(&tmp_)) return false;
+      blk_ptr_ = 0;
+    }
+  }
+  const RowBlock<IndexType, DType>& Value() const override { return block_; }
+  size_t BytesRead() const override { return base_->BytesRead(); }
+
+ private:
+  std::unique_ptr<ParserImpl<IndexType, DType>> base_;
+  ThreadedIter<Blocks> iter_;
+  Blocks* tmp_ = nullptr;
+  size_t blk_ptr_ = 0;
+  RowBlock<IndexType, DType> block_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_PARSER_IMPL_H_

@@ -1,0 +1,102 @@
+// text_parser.h — chunk → N worker threads, each parsing a newline-aligned
+// byte range into its own RowBlockContainer, with exception relay.
+// Parity: reference src/data/text_parser.h (FillData:110-146, nthread
+// heuristic:33-34, UTF-8 BOM skip:81).
+#ifndef DMLCTPU_SRC_DATA_TEXT_PARSER_H_
+#define DMLCTPU_SRC_DATA_TEXT_PARSER_H_
+
+#include <algorithm>
+#include <memory>
+#include <thread>
+#include <vector>
+
+#include "./parser_impl.h"
+#include "dmlctpu/common.h"
+#include "dmlctpu/input_split.h"
+
+namespace dmlctpu {
+namespace data {
+
+template <typename IndexType, typename DType = real_t>
+class TextParserBase : public ParserImpl<IndexType, DType> {
+ public:
+  using Blocks = typename ParserImpl<IndexType, DType>::Blocks;
+
+  TextParserBase(std::unique_ptr<InputSplit> source, int nthread)
+      : source_(std::move(source)) {
+    unsigned cores = std::thread::hardware_concurrency();
+    int cap = std::max(static_cast<int>(cores) / 2 - 4, 1);
+    nthread_ = std::max(std::min(nthread, cap), 1);
+  }
+
+  void BeforeFirst() override {
+    ParserImpl<IndexType, DType>::BeforeFirst();
+    source_->BeforeFirst();
+  }
+
+ protected:
+  /*! \brief parse one contiguous text range into out */
+  virtual void ParseBlock(const char* begin, const char* end,
+                          RowBlockContainer<IndexType, DType>* out) = 0;
+
+  bool ParseNext(Blocks* data) override {
+    InputSplit::Blob chunk;
+    if (!source_->NextChunk(&chunk)) return false;
+    this->bytes_read_ += chunk.size;
+    const char* head = static_cast<const char*>(chunk.dptr);
+    const char* tail = head + chunk.size;
+    SkipUTF8BOM(&head, tail);
+
+    const int nthread = nthread_;
+    data->resize(nthread);
+    if (nthread == 1) {
+      ParseBlock(head, tail, &(*data)[0]);
+      return true;
+    }
+    // newline-aligned sub-ranges, one worker thread each
+    std::vector<std::thread> workers;
+    ExceptionRelay relay;
+    size_t total = static_cast<size_t>(tail - head);
+    size_t step = (total + nthread - 1) / nthread;
+    const char* range_begin = head;
+    for (int t = 0; t < nthread; ++t) {
+      const char* range_end =
+          (t + 1 == nthread) ? tail : BackFindLineEnd(head + std::min((t + 1) * step, total),
+                                                      range_begin, tail);
+      auto* out = &(*data)[t];
+      const char* b = range_begin;
+      const char* e = range_end;
+      workers.emplace_back([this, b, e, out, &relay] {
+        relay.Run([&] { this->ParseBlock(b, e, out); });
+      });
+      range_begin = range_end;
+    }
+    for (auto& w : workers) w.join();
+    relay.Rethrow();
+    return true;
+  }
+
+  /*! \brief step backward/forward to a line boundary so ranges do not split lines */
+  static const char* BackFindLineEnd(const char* p, const char* begin, const char* end) {
+    if (p >= end) return end;
+    // advance to just past the next newline (forward search keeps ranges
+    // non-overlapping when lines are long)
+    while (p != end && *p != '\n' && *p != '\r') ++p;
+    while (p != end && (*p == '\n' || *p == '\r')) ++p;
+    (void)begin;
+    return p;
+  }
+  static void SkipUTF8BOM(const char** begin, const char* end) {
+    if (end - *begin >= 3 && (*begin)[0] == '\xEF' && (*begin)[1] == '\xBB' &&
+        (*begin)[2] == '\xBF') {
+      *begin += 3;
+    }
+  }
+
+  std::unique_ptr<InputSplit> source_;
+  int nthread_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_TEXT_PARSER_H_
