@@ -1,0 +1,25 @@
+"""dmlc_core_tpu — a TPU-native rebuild of the dmlc-core substrate.
+
+Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
+  * native C++ runtime (cpp/ → libdmlctpu.so): streams, sharded InputSplit
+    with record healing, RecordIO, text parsers, prefetch pipelines;
+  * `io` / `data`: Python bindings + DeviceStagingIter that pads ragged CSR
+    batches into static XLA shapes resident in TPU HBM;
+  * `ops` / `models`: jittable sparse compute (segment-sum CSR kernels) and
+    model families (sparse linear, factorization machine);
+  * `parallel`: device-mesh data parallelism, psum collectives over ICI, and
+    the DMLC_* env bootstrap onto jax.distributed;
+  * `tracker`: dmlc-submit job launch + rabit-compatible rendezvous.
+"""
+from . import data, io, models, ops, parallel
+from ._native import NativeError, version as native_version
+from .data import DeviceStagingIter, PaddedBatch, Parser, RowBlock
+from .io import InputSplit, RecordIOReader, RecordIOWriter
+
+__version__ = "0.1.0"
+__all__ = [
+    "data", "io", "models", "ops", "parallel",
+    "NativeError", "native_version",
+    "DeviceStagingIter", "PaddedBatch", "Parser", "RowBlock",
+    "InputSplit", "RecordIOReader", "RecordIOWriter",
+]

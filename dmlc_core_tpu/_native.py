@@ -1,0 +1,117 @@
+"""ctypes binding to the native dmlctpu runtime (libdmlctpu.so).
+
+The native library provides the Stream/InputSplit/Parser/RecordIO substrate
+(reference parity: include/dmlc + src of /root/reference, rebuilt TPU-first
+in cpp/).  This module only loads the shared object and declares signatures;
+pythonic wrappers live in `dmlc_core_tpu.io` and `dmlc_core_tpu.data`.
+
+Resolution order for the library path:
+  1. $DMLCTPU_LIBRARY_PATH
+  2. <repo>/build/libdmlctpu.so
+  3. alongside this package (wheel layout)
+If absent, it is built on demand with cmake+ninja (dev convenience).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+class RowBlockC(ctypes.Structure):
+    """Mirror of DmlcTpuRowBlockC (cpp/include/dmlctpu/c_api.h)."""
+
+    _fields_ = [
+        ("size", ctypes.c_uint64),
+        ("offset", ctypes.POINTER(ctypes.c_uint64)),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
+        ("qid", ctypes.POINTER(ctypes.c_uint64)),
+        ("field", ctypes.POINTER(ctypes.c_uint64)),
+        ("index", ctypes.POINTER(ctypes.c_uint64)),
+        ("value", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def _candidate_paths():
+    env = os.environ.get("DMLCTPU_LIBRARY_PATH")
+    if env:
+        yield Path(env)
+    yield _REPO_ROOT / "build" / "libdmlctpu.so"
+    yield Path(__file__).resolve().parent / "libdmlctpu.so"
+
+
+def _build_native() -> Path:
+    build_dir = _REPO_ROOT / "build"
+    subprocess.run(
+        ["cmake", "-B", str(build_dir), "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        cwd=_REPO_ROOT, check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", str(build_dir), "dmlctpu"],
+                   cwd=_REPO_ROOT, check=True, capture_output=True)
+    return build_dir / "libdmlctpu.so"
+
+
+def _load() -> ctypes.CDLL:
+    for path in _candidate_paths():
+        if path.exists():
+            return ctypes.CDLL(str(path))
+    return ctypes.CDLL(str(_build_native()))
+
+
+_LIB = _load()
+
+# ---- signatures -------------------------------------------------------------
+_LIB.DmlcTpuGetLastError.restype = ctypes.c_char_p
+_LIB.DmlcTpuVersion.restype = ctypes.c_char_p
+
+_LIB.DmlcTpuParserCreate.argtypes = [
+    ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuParserNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(RowBlockC)]
+_LIB.DmlcTpuParserBeforeFirst.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuParserBytesRead.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuParserBytesRead.restype = ctypes.c_int64
+_LIB.DmlcTpuParserFree.argtypes = [ctypes.c_void_p]
+
+_LIB.DmlcTpuInputSplitCreate.argtypes = [
+    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
+    ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuInputSplitNextRecord.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+_LIB.DmlcTpuInputSplitNextChunk.argtypes = list(_LIB.DmlcTpuInputSplitNextRecord.argtypes)
+_LIB.DmlcTpuInputSplitBeforeFirst.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuInputSplitResetPartition.argtypes = [
+    ctypes.c_void_p, ctypes.c_uint, ctypes.c_uint]
+_LIB.DmlcTpuInputSplitTotalSize.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuInputSplitTotalSize.restype = ctypes.c_int64
+_LIB.DmlcTpuInputSplitFree.argtypes = [ctypes.c_void_p]
+
+_LIB.DmlcTpuRecordIOWriterCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuRecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+_LIB.DmlcTpuRecordIOWriterFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuRecordIOReaderCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuRecordIOReaderNext.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+_LIB.DmlcTpuRecordIOReaderFree.argtypes = [ctypes.c_void_p]
+
+
+class NativeError(RuntimeError):
+    """Error raised by the native dmlctpu runtime."""
+
+
+def check(status: int) -> int:
+    """Raise NativeError on -1; pass through 0/1 returns."""
+    if status == -1:
+        raise NativeError(_LIB.DmlcTpuGetLastError().decode(errors="replace"))
+    return status
+
+
+def lib() -> ctypes.CDLL:
+    return _LIB
+
+
+def version() -> str:
+    return _LIB.DmlcTpuVersion().decode()

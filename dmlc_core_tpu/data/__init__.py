@@ -1,0 +1,5 @@
+"""Data layer: parsed RowBlocks (numpy) and TPU HBM staging."""
+from .rowblock import RowBlock, Parser
+from .staging import PaddedBatch, DeviceStagingIter
+
+__all__ = ["RowBlock", "Parser", "PaddedBatch", "DeviceStagingIter"]

@@ -1,0 +1,222 @@
+"""DeviceStagingIter — the TPU-native piece the reference never had: a
+prefetching iterator that turns ragged parsed RowBlocks into *static-shape*
+padded CSR batches resident in TPU HBM.
+
+Design (SURVEY.md §7 step 7):
+  * rows are packed to a fixed ``batch_size`` (final short batch zero-padded,
+    padding rows carry weight 0 so losses ignore them);
+  * nonzeros are padded to the next multiple of ``nnz_bucket`` — a handful of
+    distinct shapes total, so XLA compiles a handful of executables instead of
+    one per batch (ragged shapes would retrace every step);
+  * padded nnz slots point at row ``batch_size-1`` / column 0 with value 0 —
+    numerically inert in segment-sum compute;
+  * a background thread runs parse+pack+``device_put`` one batch ahead
+    (double buffering): JAX dispatch is async, so the host→HBM DMA of batch
+    N+1 overlaps the device compute of batch N;
+  * with a mesh, batches are laid out sharded over the data axis via
+    ``jax.make_array_from_process_local_data`` (multi-host: each process
+    contributes its local InputSplit shard; single host: plain sharded put).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .rowblock import Parser, RowBlock
+
+
+@dataclass
+class PaddedBatch:
+    """Static-shape CSR batch (a pytree; arrays live on device after staging).
+
+    nnz arrays are flattened COO: ``row_id[k]`` is the row of nonzero k.
+    Padding rows have ``weight == 0``; padding nonzeros have ``value == 0``.
+    """
+
+    label: jax.Array    # f32 [batch]
+    weight: jax.Array   # f32 [batch]
+    index: jax.Array    # i32 [nnz_pad] column ids
+    value: jax.Array    # f32 [nnz_pad]
+    row_id: jax.Array   # i32 [nnz_pad]
+    num_rows: jax.Array  # i32 [] true (unpadded) row count
+    field: Optional[jax.Array] = None  # i32 [nnz_pad] (libfm)
+
+    @property
+    def batch_size(self) -> int:
+        return self.label.shape[0]
+
+
+jax.tree_util.register_dataclass(
+    PaddedBatch,
+    data_fields=["label", "weight", "index", "value", "row_id", "num_rows", "field"],
+    meta_fields=[])
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((max(n, 1) + mult - 1) // mult) * mult
+
+
+class _Packer:
+    """Accumulates RowBlocks and emits fixed-size numpy batches."""
+
+    def __init__(self, batch_size: int, nnz_bucket: int, with_field: bool):
+        self.batch_size = batch_size
+        self.nnz_bucket = nnz_bucket
+        self.with_field = with_field
+        self._rows: list = []  # per-row tuples (label, weight, index, value, field)
+        self.max_index = 0
+
+    def push_block(self, block: RowBlock) -> None:
+        values = block.values_or_ones()
+        offsets = block.offset
+        if block.num_nonzero:
+            self.max_index = max(self.max_index, int(block.index.max()))
+        for r in range(block.size):
+            lo, hi = int(offsets[r]), int(offsets[r + 1])
+            self._rows.append((
+                float(block.label[r]),
+                float(block.weight[r]) if block.weight is not None else 1.0,
+                block.index[lo:hi],
+                values[lo:hi],
+                block.field[lo:hi] if (self.with_field and block.field is not None) else None,
+            ))
+
+    def ready(self) -> bool:
+        return len(self._rows) >= self.batch_size
+
+    def pop_batch(self, allow_partial: bool) -> Optional[dict]:
+        n = min(len(self._rows), self.batch_size)
+        if n == 0 or (n < self.batch_size and not allow_partial):
+            return None
+        rows, self._rows = self._rows[:n], self._rows[n:]
+        B = self.batch_size
+        label = np.zeros(B, np.float32)
+        weight = np.zeros(B, np.float32)  # padding rows stay weight 0
+        nnz = sum(len(r[2]) for r in rows)
+        nnz_pad = _round_up(nnz, self.nnz_bucket)
+        index = np.zeros(nnz_pad, np.int32)
+        value = np.zeros(nnz_pad, np.float32)
+        row_id = np.full(nnz_pad, B - 1, np.int32)  # inert padding target
+        field = np.zeros(nnz_pad, np.int32) if self.with_field else None
+        k = 0
+        for r, (lab, wgt, idx, val, fld) in enumerate(rows):
+            label[r] = lab
+            weight[r] = wgt
+            m = len(idx)
+            index[k:k + m] = idx.astype(np.int32)
+            value[k:k + m] = val
+            row_id[k:k + m] = r
+            if field is not None and fld is not None:
+                field[k:k + m] = fld.astype(np.int32)
+            k += m
+        return dict(label=label, weight=weight, index=index, value=value,
+                    row_id=row_id, num_rows=np.int32(n), field=field)
+
+
+class DeviceStagingIter:
+    """Iterate PaddedBatches staged into device memory, one batch ahead.
+
+    Parameters
+    ----------
+    parser : Parser | str
+        a Parser, or a URI (then part/num_parts/format apply).
+    batch_size : rows per emitted batch (global batch when sharded).
+    nnz_bucket : pad nonzeros to a multiple of this (shape-bucketing).
+    sharding : optional ``jax.sharding.Sharding`` for the staged arrays
+        (e.g. NamedSharding(mesh, P('data')) on the leading axis).  Scalars
+        and ``num_rows`` are replicated.
+    prefetch : how many staged batches the background thread keeps in flight.
+    """
+
+    def __init__(self, parser, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
+                 part: int = 0, num_parts: int = 1, format: str = "auto",  # noqa: A002
+                 sharding=None, with_field: bool = False, prefetch: int = 2,
+                 drop_remainder: bool = False):
+        if isinstance(parser, str):
+            parser = Parser(parser, part, num_parts, format)
+        self._parser = parser
+        self._packer = _Packer(batch_size, nnz_bucket, with_field)
+        self._sharding = sharding
+        self._prefetch = max(prefetch, 1)
+        self._drop_remainder = drop_remainder
+        self.batches_staged = 0
+
+    @property
+    def bytes_read(self) -> int:
+        return self._parser.bytes_read
+
+    @property
+    def max_index(self) -> int:
+        """Largest column id seen so far (after at least one epoch: the dim)."""
+        return self._packer.max_index
+
+    # ---- staging ------------------------------------------------------------
+    def _stage(self, host: dict) -> PaddedBatch:
+        def put(x, shard_rows: bool):
+            if x is None:
+                return None
+            if self._sharding is not None and shard_rows:
+                if jax.process_count() > 1:
+                    return jax.make_array_from_process_local_data(self._sharding, x)
+                return jax.device_put(x, self._sharding)
+            return jnp.asarray(x)
+
+        batch = PaddedBatch(
+            label=put(host["label"], True),
+            weight=put(host["weight"], True),
+            index=put(host["index"], True),
+            value=put(host["value"], True),
+            row_id=put(host["row_id"], True),
+            num_rows=jnp.asarray(host["num_rows"]),
+            field=put(host["field"], True),
+        )
+        self.batches_staged += 1
+        return batch
+
+    def _host_batches(self) -> Iterator[dict]:
+        self._parser.before_first()
+        for block in self._parser:
+            self._packer.push_block(block)
+            while self._packer.ready():
+                yield self._packer.pop_batch(allow_partial=False)
+        if not self._drop_remainder:
+            tail = self._packer.pop_batch(allow_partial=True)
+            if tail is not None:
+                yield tail
+
+    def __iter__(self) -> Iterator[PaddedBatch]:
+        """Yield device-resident batches; parse+pack+transfer runs one ahead."""
+        q: queue.Queue = queue.Queue(maxsize=self._prefetch)
+        sentinel = object()
+        error: list = []
+
+        def producer():
+            try:
+                for host in self._host_batches():
+                    # device_put here (producer thread): the DMA is issued
+                    # while the consumer is still computing on batch N-1
+                    q.put(self._stage(host))
+            except BaseException as e:  # relayed to consumer
+                error.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+            if error:
+                raise error[0]
+        finally:
+            t.join(timeout=5.0)

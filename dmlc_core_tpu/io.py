@@ -1,0 +1,125 @@
+"""Pythonic wrappers over the native IO substrate.
+
+Parity surface (reference: include/dmlc/io.h, include/dmlc/recordio.h):
+`InputSplit` (sharded record iteration with healing), `RecordIOWriter`,
+`RecordIOReader`.  Records cross the boundary as `bytes`; zero-copy staging
+for parsed numeric data goes through `dmlc_core_tpu.data` instead.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional
+
+from ._native import check, lib
+
+
+class InputSplit:
+    """Shard `part` of `num_parts` of a dataset URI, record-aligned.
+
+    Parameters mirror ``dmlc::InputSplit::Create`` (reference
+    include/dmlc/io.h:261-301): URI sugar supports ``;`` lists, trailing
+    regex, directories, ``?k=v`` args and ``#cachefile``.
+    """
+
+    def __init__(self, uri: str, part: int = 0, num_parts: int = 1,
+                 split_type: str = "text", index_uri: Optional[str] = None,
+                 shuffle: bool = False, seed: int = 0, batch_size: int = 256):
+        self._handle = ctypes.c_void_p()
+        check(lib().DmlcTpuInputSplitCreate(
+            uri.encode(), index_uri.encode() if index_uri else None,
+            part, num_parts, split_type.encode(), int(shuffle), seed, batch_size,
+            ctypes.byref(self._handle)))
+
+    def __iter__(self) -> Iterator[bytes]:
+        data = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        while check(lib().DmlcTpuInputSplitNextRecord(
+                self._handle, ctypes.byref(data), ctypes.byref(size))) == 1:
+            yield ctypes.string_at(data, size.value)
+
+    def next_chunk(self) -> Optional[bytes]:
+        """Next multi-record chunk, or None at end of partition."""
+        data = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        if check(lib().DmlcTpuInputSplitNextChunk(
+                self._handle, ctypes.byref(data), ctypes.byref(size))) == 0:
+            return None
+        return ctypes.string_at(data, size.value)
+
+    def before_first(self) -> None:
+        check(lib().DmlcTpuInputSplitBeforeFirst(self._handle))
+
+    def reset_partition(self, part: int, num_parts: int) -> None:
+        check(lib().DmlcTpuInputSplitResetPartition(self._handle, part, num_parts))
+
+    @property
+    def total_size(self) -> int:
+        return lib().DmlcTpuInputSplitTotalSize(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            lib().DmlcTpuInputSplitFree(self._handle)
+            self._handle = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+class RecordIOWriter:
+    """Write records into the splittable RecordIO container format."""
+
+    def __init__(self, uri: str):
+        self._handle = ctypes.c_void_p()
+        check(lib().DmlcTpuRecordIOWriterCreate(uri.encode(), ctypes.byref(self._handle)))
+
+    def write(self, record: bytes) -> None:
+        check(lib().DmlcTpuRecordIOWriterWrite(self._handle, record, len(record)))
+
+    def close(self) -> None:
+        if self._handle:
+            lib().DmlcTpuRecordIOWriterFree(self._handle)
+            self._handle = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+class RecordIOReader:
+    """Stream logical records back out of a RecordIO container."""
+
+    def __init__(self, uri: str):
+        self._handle = ctypes.c_void_p()
+        check(lib().DmlcTpuRecordIOReaderCreate(uri.encode(), ctypes.byref(self._handle)))
+
+    def __iter__(self) -> Iterator[bytes]:
+        data = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        while check(lib().DmlcTpuRecordIOReaderNext(
+                self._handle, ctypes.byref(data), ctypes.byref(size))) == 1:
+            yield ctypes.string_at(data, size.value)
+
+    def close(self) -> None:
+        if self._handle:
+            lib().DmlcTpuRecordIOReaderFree(self._handle)
+            self._handle = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()

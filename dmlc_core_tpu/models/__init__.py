@@ -1,0 +1,7 @@
+"""Model families over sparse RowBlock data: the training-side consumers the
+reference delegates to downstream DMLC projects (XGBoost/MXNet), rebuilt as
+jittable JAX models over PaddedBatch pytrees."""
+from .linear import SparseLinearModel
+from .fm import FactorizationMachine
+
+__all__ = ["SparseLinearModel", "FactorizationMachine"]

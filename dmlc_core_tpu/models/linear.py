@@ -1,0 +1,94 @@
+"""Sparse linear model (logistic / squared loss) — the end-to-end slice of
+SURVEY.md §7: LibSVM shards → DeviceStagingIter → SGD with data-parallel
+gradient psum; the Row::SDot analogue vectorized through csr_matvec.
+
+Pure-functional: params is a pytree {"w": f32[dim], "b": f32[]}; all steps
+are jittable; under a mesh, replicated params + data-sharded batches make
+XLA insert the gradient all-reduce automatically.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..data.staging import PaddedBatch
+from ..ops.sparse import csr_matvec, padded_row_mean
+
+
+class SparseLinearModel:
+    """Logistic regression / linear regression over sparse batches.
+
+    objective: "logistic" (labels in {0,1} or {-1,1}) or "squared".
+    """
+
+    def __init__(self, num_features: int, objective: str = "logistic",
+                 l2: float = 0.0, learning_rate: float = 0.1):
+        if objective not in ("logistic", "squared"):
+            raise ValueError(f"unknown objective '{objective}'")
+        self.num_features = num_features
+        self.objective = objective
+        self.l2 = l2
+        self.learning_rate = learning_rate
+
+    def init(self, seed: int = 0) -> dict:
+        del seed  # linear model: zero init is canonical
+        return {"w": jnp.zeros(self.num_features, jnp.float32),
+                "b": jnp.zeros((), jnp.float32)}
+
+    # ---- pure functions (jit-friendly) --------------------------------------
+    def margins(self, params: dict, batch: PaddedBatch) -> jax.Array:
+        """Per-row scores w·x + b."""
+        return csr_matvec(params["w"], batch.index, batch.value, batch.row_id,
+                          batch.batch_size) + params["b"]
+
+    def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
+        m = self.margins(params, batch)
+        if self.objective == "logistic":
+            y = jnp.where(batch.label > 0.5, 1.0, 0.0)  # accept {-1,1} or {0,1}
+            per_row = jnp.maximum(m, 0) - m * y + jnp.log1p(jnp.exp(-jnp.abs(m)))
+        else:
+            per_row = 0.5 * (m - batch.label) ** 2
+        data_loss = padded_row_mean(per_row, batch.weight)
+        if self.l2 > 0.0:
+            data_loss = data_loss + 0.5 * self.l2 * jnp.sum(params["w"] ** 2)
+        return data_loss
+
+    def predict(self, params: dict, batch: PaddedBatch) -> jax.Array:
+        m = self.margins(params, batch)
+        if self.objective == "logistic":
+            return jax.nn.sigmoid(m)
+        return m
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, params: dict, batch: PaddedBatch) -> Tuple[dict, jax.Array]:
+        """One SGD step; returns (new_params, loss).
+
+        Under jit with replicated params and a data-sharded batch, the grad
+        reduction lowers to a psum over the mesh — the rabit-allreduce path.
+        """
+        loss, grads = jax.value_and_grad(self.loss)(params, batch)
+        new_params = jax.tree.map(
+            lambda p, g: p - self.learning_rate * g, params, grads)
+        return new_params, loss
+
+    def evaluate(self, params: dict, batches) -> dict:
+        """Accuracy/loss over an iterable of batches (host-side reduce)."""
+        total_w = 0.0
+        total_loss = 0.0
+        correct = 0.0
+        for batch in batches:
+            m = self.margins(params, batch)
+            w = batch.weight
+            total_w += float(jnp.sum(w))
+            total_loss += float(self.loss(params, batch)) * float(jnp.sum(w))
+            if self.objective == "logistic":
+                y = jnp.where(batch.label > 0.5, 1.0, 0.0)
+                pred = (m > 0).astype(jnp.float32)
+                correct += float(jnp.sum((pred == y) * w))
+        out = {"loss": total_loss / max(total_w, 1.0)}
+        if self.objective == "logistic":
+            out["accuracy"] = correct / max(total_w, 1.0)
+        return out

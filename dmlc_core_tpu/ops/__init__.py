@@ -1,0 +1,4 @@
+"""TPU compute ops over padded CSR batches."""
+from .sparse import csr_matvec, csr_matmul, csr_row_sumsq_matmul, padded_row_mean
+
+__all__ = ["csr_matvec", "csr_matmul", "csr_row_sumsq_matmul", "padded_row_mean"]

@@ -1,0 +1,47 @@
+"""Sparse CSR/COO ops on static padded shapes — the TPU analogue of the
+reference's Row::SDot loop (include/dmlc/data.h:146-161).
+
+All ops take flattened COO arrays (index/value/row_id from a PaddedBatch) so
+they jit to gathers + segment-sums with fully static shapes.  The dense-side
+operands (weight vectors / embedding tables) are where the MXU work lives for
+FM-style models; segment_sum lowers to efficient TPU scatter-adds.
+Padding convention: value == 0 ⇒ the entry contributes nothing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def csr_matvec(weights: jax.Array, index: jax.Array, value: jax.Array,
+               row_id: jax.Array, num_rows: int) -> jax.Array:
+    """Per-row sparse dot product: out[r] = Σ_{k: row_id[k]=r} w[index[k]]·value[k].
+
+    The vectorized Row::SDot: one gather + one segment-sum.
+    """
+    contrib = weights[index] * value
+    return jax.ops.segment_sum(contrib, row_id, num_segments=num_rows)
+
+
+def csr_matmul(table: jax.Array, index: jax.Array, value: jax.Array,
+               row_id: jax.Array, num_rows: int) -> jax.Array:
+    """Sparse×dense: out[r, :] = Σ_k value[k] · table[index[k], :].
+
+    `table` is [num_features, K] (an embedding / factor matrix); output
+    [num_rows, K].  Gather rows, scale, segment-sum.
+    """
+    gathered = table[index] * value[:, None]
+    return jax.ops.segment_sum(gathered, row_id, num_segments=num_rows)
+
+
+def csr_row_sumsq_matmul(table: jax.Array, index: jax.Array, value: jax.Array,
+                         row_id: jax.Array, num_rows: int) -> jax.Array:
+    """out[r, :] = Σ_k value[k]² · table[index[k], :]² (FM second-order term)."""
+    gathered = (table[index] ** 2) * (value[:, None] ** 2)
+    return jax.ops.segment_sum(gathered, row_id, num_segments=num_rows)
+
+
+def padded_row_mean(per_row: jax.Array, weight: jax.Array) -> jax.Array:
+    """Weighted mean over rows that treats padding rows (weight 0) as absent."""
+    total = jnp.sum(weight)
+    return jnp.sum(per_row * weight) / jnp.maximum(total, 1.0)

@@ -1,0 +1,73 @@
+"""Collectives — the rabit-allreduce equivalent, on XLA/ICI.
+
+The reference builds a TCP tree+ring and brokers peer links through the
+tracker (tracker/dmlc_tracker/tracker.py:185-252).  On TPU the topology is
+the hardware: a jitted psum over a mesh axis lowers to an ICI all-reduce.
+``allreduce`` is the drop-in API; ``allreduce_bench`` measures achieved
+bus bandwidth (BASELINE config 4).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..timer import Stopwatch
+
+_OPS: dict[str, Callable] = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+    "mean": jax.lax.pmean,
+}
+
+
+def allreduce(x: jax.Array, op: str = "sum", axis_name: str = "data") -> jax.Array:
+    """All-reduce across a mesh axis; call inside shard_map/pmap-traced code."""
+    try:
+        fn = _OPS[op]
+    except KeyError:
+        raise ValueError(f"unknown allreduce op '{op}' (have {sorted(_OPS)})") from None
+    return fn(x, axis_name)
+
+
+def _bench_step(mesh: Mesh, nfloats_per_dev: int):
+    """Build a jitted shard_map that psums one f32 buffer per device."""
+    from jax.experimental.shard_map import shard_map
+
+    def reduce_fn(x):
+        return jax.lax.psum(x, "data")
+
+    sharded = shard_map(reduce_fn, mesh=mesh, in_specs=P("data"), out_specs=P())
+    return jax.jit(sharded)
+
+
+def allreduce_bench(mesh: Mesh, mib_per_device: float = 64.0, iters: int = 10) -> dict:
+    """Measure all-reduce bus bandwidth over the mesh's ``data`` axis.
+
+    Returns {bytes, seconds_per_iter, algo_gbps, bus_gbps}.  Bus bandwidth
+    uses the standard 2(n-1)/n ring factor.
+    """
+    n = mesh.devices.size
+    nfloats = int(mib_per_device * (1 << 20) // 4)
+    step = _bench_step(mesh, nfloats)
+    x = jax.device_put(
+        np.random.default_rng(0).standard_normal((n * nfloats,), dtype=np.float32),
+        NamedSharding(mesh, P("data")))
+    # warmup + compile
+    step(x).block_until_ready()
+    watch = Stopwatch()
+    for _ in range(iters):
+        out = step(x)
+    out.block_until_ready()
+    secs = watch.elapsed() / iters
+    nbytes = nfloats * 4
+    algo = nbytes / secs / 1e9
+    bus = algo * (2.0 * (n - 1) / n)
+    return {"devices": n, "bytes": nbytes, "seconds_per_iter": secs,
+            "algo_gbps": algo, "bus_gbps": bus}

@@ -1,0 +1,47 @@
+"""Device-mesh helpers.
+
+The reference's only scaling axis is row-sharded data parallelism
+(InputSplit(uri, rank, nparts) + downstream rabit allreduce — SURVEY.md §5).
+Here that axis maps onto a JAX mesh axis named ``data``: each rank reads its
+InputSplit shard, batches are laid out sharded over ``data``, and gradient
+reduction is XLA's psum over ICI instead of a TCP tree/ring.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_sizes: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("data",),
+              devices=None) -> Mesh:
+    """Build a Mesh over all (or the given) devices.
+
+    Default: 1-D ``data`` mesh over every addressable-or-global device —
+    the dmlc data-parallel world.  Pass e.g. ``axis_sizes=(4, 2)``,
+    ``axis_names=('data', 'model')`` for richer layouts.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = (n,) if len(axis_names) == 1 else None
+    assert axis_sizes is not None, "axis_sizes required for multi-axis meshes"
+    assert int(np.prod(axis_sizes)) == n, (
+        f"mesh {tuple(axis_sizes)} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(axis_sizes)
+    return Mesh(dev_array, axis_names)
+
+
+def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (row) dimension over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated layout (model parameters in pure DP)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,21 @@
+"""Test environment: force an 8-device virtual CPU mesh so every sharding /
+collective path is exercised without TPU hardware (the driver separately
+dry-runs the multi-chip path; bench.py runs on the real chip).
+
+Note: the session's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS pinned to the TPU plugin, so mutating os.environ here is too
+late — the jax config object must be updated directly, before any backend
+is initialized (pytest imports conftest before test modules, so this runs
+ahead of every `import dmlc_core_tpu`/`import jax` in tests).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

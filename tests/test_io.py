@@ -1,0 +1,99 @@
+"""Python binding tests: InputSplit, RecordIO, Parser (native round trips)."""
+import os
+
+import numpy as np
+import pytest
+
+import dmlc_core_tpu as dt
+
+
+@pytest.fixture
+def tmp_libsvm(tmp_path):
+    lines = [f"{i % 2} {i % 31}:{(i % 7) * 0.5} {(i * 3) % 31}:1.5" for i in range(500)]
+    p = tmp_path / "data.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p), lines
+
+
+def test_input_split_partition_union(tmp_libsvm):
+    uri, lines = tmp_libsvm
+    seen = []
+    for part in range(4):
+        with dt.InputSplit(uri, part, 4, "text") as split:
+            seen.extend(rec.decode() for rec in split)
+    assert sorted(seen) == sorted(lines)
+
+
+def test_input_split_reset_and_total_size(tmp_libsvm):
+    uri, lines = tmp_libsvm
+    with dt.InputSplit(uri, 0, 2, "text") as split:
+        first = [r.decode() for r in split]
+        split.before_first()
+        again = [r.decode() for r in split]
+        assert first == again
+        assert split.total_size == os.path.getsize(uri)
+        split.reset_partition(1, 2)
+        other = [r.decode() for r in split]
+    assert sorted(first + other) == sorted(lines)
+
+
+def test_recordio_roundtrip(tmp_path):
+    uri = str(tmp_path / "data.rec")
+    records = [os.urandom(n % 257) for n in range(300)]
+    with dt.RecordIOWriter(uri) as writer:
+        for r in records:
+            writer.write(r)
+    with dt.RecordIOReader(uri) as reader:
+        back = list(reader)
+    assert back == records
+
+
+def test_recordio_split_sharded(tmp_path):
+    uri = str(tmp_path / "s.rec")
+    records = [f"record-{i}".encode() for i in range(256)]
+    with dt.RecordIOWriter(uri) as writer:
+        for r in records:
+            writer.write(r)
+    seen = []
+    for part in range(3):
+        with dt.InputSplit(uri, part, 3, "recordio") as split:
+            seen.extend(split)
+    assert sorted(seen) == sorted(records)
+
+
+def test_parser_blocks(tmp_libsvm):
+    uri, lines = tmp_libsvm
+    with dt.Parser(uri, 0, 1, "libsvm") as parser:
+        total_rows = 0
+        nnz = 0
+        labels = []
+        for block in parser:
+            assert isinstance(block, dt.RowBlock)
+            assert block.offset[0] == 0
+            assert block.offset[-1] == block.num_nonzero
+            total_rows += block.size
+            nnz += block.num_nonzero
+            labels.extend(block.label.tolist())
+        assert total_rows == len(lines)
+        assert parser.bytes_read > 0
+    assert np.allclose(sorted(labels), sorted(float(l.split()[0]) for l in lines))
+
+
+def test_parser_bad_uri_raises():
+    with pytest.raises(dt.NativeError):
+        dt.Parser("/no/such/file.libsvm", 0, 1, "libsvm")
+
+
+def test_row_ids_and_values(tmp_path):
+    p = tmp_path / "t.libsvm"
+    p.write_text("1 0:2 5:3\n0 1:4\n1\n")
+    with dt.Parser(str(p), 0, 1, "libsvm") as parser:
+        blocks = list(parser)
+    block = blocks[0]
+    assert block.size == 3
+    np.testing.assert_array_equal(block.row_ids(), [0, 0, 1])
+    np.testing.assert_allclose(block.values_or_ones(), [2, 3, 4])
+
+
+def test_native_version():
+    assert dt.native_version()
