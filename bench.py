@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""bench.py — headline benchmark: libsvm parse → TPU HBM staging throughput.
+
+BASELINE.md config 1+2: the reference's own instrument is
+test/libsvm_parser_test.cc (prints MB/sec of multi-threaded parse into
+RowBlocks, CPU only, no device).  Here the same bytes go further: native
+parse → pad/bucket → device_put into TPU HBM, measured end to end.  The
+baseline number is the reference driver compiled from /root/reference and
+run on the same generated file; vs_baseline = ours / reference.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": R, ...extras}
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+CACHE = Path(os.environ.get("DMLCTPU_BENCH_CACHE", "/tmp/dmlctpu_bench"))
+DATA_MB = int(os.environ.get("DMLCTPU_BENCH_MB", "64"))
+REF_SRC = Path("/root/reference")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_dataset() -> Path:
+    """Synthetic agaricus-style libsvm: binary labels, ~20 binary features/row."""
+    CACHE.mkdir(parents=True, exist_ok=True)
+    path = CACHE / f"agaricus_{DATA_MB}mb.libsvm"
+    if path.exists() and path.stat().st_size >= DATA_MB << 20:
+        return path
+    import numpy as np
+    rng = np.random.default_rng(42)
+    target = DATA_MB << 20
+    with open(path, "w") as f:
+        written = 0
+        while written < target:
+            rows = []
+            for _ in range(4096):
+                y = int(rng.integers(0, 2))
+                nnz = int(rng.integers(12, 28))
+                feats = np.unique(rng.integers(0, 127, size=nnz))
+                rows.append(f"{y} " + " ".join(f"{j}:1" for j in feats))
+            chunk = "\n".join(rows) + "\n"
+            f.write(chunk)
+            written += len(chunk)
+    return path
+
+
+def ensure_reference_binary() -> Path | None:
+    exe = CACHE / "ref_libsvm_parser_test"
+    if exe.exists():
+        return exe
+    if not REF_SRC.exists():
+        return None
+    srcs = [REF_SRC / "test/libsvm_parser_test.cc", REF_SRC / "src/io.cc",
+            REF_SRC / "src/data.cc", REF_SRC / "src/recordio.cc"]
+    srcs += [REF_SRC / "src/io" / n for n in
+             ("filesys.cc", "local_filesys.cc", "input_split_base.cc",
+              "line_split.cc", "recordio_split.cc", "indexed_recordio_split.cc")]
+    cmd = ["g++", "-O2", "-std=c++17", f"-I{REF_SRC}/include",
+           *map(str, srcs), "-o", str(exe), "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        log(f"[bench] reference build failed: {e}")
+        return None
+    return exe
+
+
+def run_reference(exe: Path, data: Path) -> float | None:
+    """Run the reference driver; return its final MB/sec reading."""
+    nthread = max(os.cpu_count() or 1, 1)
+    try:
+        proc = subprocess.run([str(exe), str(data), "0", "1", str(nthread)],
+                              capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return None
+    rates = re.findall(r"([0-9.]+) MB/sec", proc.stdout)
+    return float(rates[-1]) if rates else None
+
+
+def pick_backend():
+    """Prefer the TPU backend; fall back to CPU if its init fails."""
+    import jax
+    try:
+        devs = jax.devices()
+        return jax, devs[0].platform
+    except RuntimeError as e:
+        log(f"[bench] TPU backend unavailable ({e}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        return jax, jax.devices()[0].platform
+
+
+def run_ours(data: Path) -> dict:
+    jax, platform = pick_backend()
+    import jax.numpy as jnp  # noqa: F401
+    from dmlc_core_tpu.data import DeviceStagingIter
+
+    def drain() -> dict:
+        it = DeviceStagingIter(str(data), batch_size=65536, nnz_bucket=1 << 21)
+        t0 = time.monotonic()
+        rows = 0
+        last = None
+        for batch in it:
+            rows += int(batch.num_rows)
+            last = batch
+        last.label.block_until_ready()  # wait for the final device transfer
+        secs = time.monotonic() - t0
+        nbytes = it.bytes_read
+        return {"rows": rows, "bytes": nbytes, "secs": secs,
+                "mb_s": (nbytes / (1 << 20)) / secs, "rows_s": rows / secs}
+
+    drain()  # warmup: compile device_put layouts, page cache
+    result = drain()
+    result["platform"] = platform
+    return result
+
+
+def main() -> None:
+    data = make_dataset()
+    log(f"[bench] dataset {data} ({data.stat().st_size >> 20} MB)")
+
+    ref_rate = None
+    exe = ensure_reference_binary()
+    if exe is not None:
+        run_reference(exe, data)  # warmup (page cache parity)
+        ref_rate = run_reference(exe, data)
+        log(f"[bench] reference libsvm_parser_test: {ref_rate} MB/s (parse only, no device)")
+
+    ours = run_ours(data)
+    log(f"[bench] dmlc_core_tpu staging: {ours['mb_s']:.1f} MB/s, "
+        f"{ours['rows_s']:.0f} rows/s -> {ours['platform']} ({ours['rows']} rows)")
+
+    vs = (ours["mb_s"] / ref_rate) if ref_rate else None
+    print(json.dumps({
+        "metric": "libsvm_parse_to_hbm_mb_s",
+        "value": round(ours["mb_s"], 2),
+        "unit": "MB/s",
+        "vs_baseline": round(vs, 3) if vs is not None else None,
+        "rows_per_sec": round(ours["rows_s"]),
+        "platform": ours["platform"],
+        "baseline_mb_s": ref_rate,
+        "data_mb": data.stat().st_size >> 20,
+    }))
+
+
+if __name__ == "__main__":
+    main()

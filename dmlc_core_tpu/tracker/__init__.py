@@ -1,0 +1,6 @@
+"""Distributed job bootstrap: rabit-compatible rendezvous tracker, a worker
+client, and cluster launchers behind `dmlc-submit`."""
+from .rendezvous import RabitTracker, PSTracker, WorkerClient, get_host_ip
+from .submit import submit
+
+__all__ = ["RabitTracker", "PSTracker", "WorkerClient", "get_host_ip", "submit"]

@@ -1,0 +1,5 @@
+"""`python -m dmlc_core_tpu.tracker.dmlc_submit` — the dmlc-submit CLI."""
+from .submit import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Cluster launcher backends for dmlc-submit."""
+from __future__ import annotations
+
+from importlib import import_module
+
+_BACKENDS = {
+    "local": ".local",
+    "ssh": ".ssh",
+    "tpu": ".tpu",
+    "mpi": ".mpi",
+    "sge": ".sge",
+    "slurm": ".slurm",
+}
+
+
+def get(name: str):
+    """Resolve a launcher's run(args) entry point."""
+    if name not in _BACKENDS:
+        raise ValueError(f"unknown cluster backend '{name}' (have {sorted(_BACKENDS)})")
+    return import_module(_BACKENDS[name], __package__).run

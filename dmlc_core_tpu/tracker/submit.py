@@ -1,0 +1,55 @@
+"""Job submission core: start the tracker, hand envs to a launcher backend.
+
+Parity: reference tracker/dmlc_tracker/{submit.py:38-56, tracker.py:410-433}.
+The env contract handed to workers is kept verbatim (DMLC_TRACKER_URI/PORT,
+DMLC_ROLE, DMLC_TASK_ID, DMLC_NUM_WORKER/SERVER, DMLC_PS_ROOT_URI/PORT,
+DMLC_JOB_CLUSTER) plus one TPU-era addition: DMLC_JAX_COORDINATOR, the
+address of the JAX coordination service (tracker host, tracker port + 1).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from .rendezvous import PSTracker, RabitTracker, get_host_ip
+
+
+def submit(num_workers: int, num_servers: int, fun_submit: Callable,
+           host_ip: str = "auto", pscmd: Optional[str] = None,
+           extra_envs: Optional[dict] = None) -> RabitTracker | PSTracker:
+    """Start the rendezvous and call fun_submit(num_workers, num_servers, envs).
+
+    Returns the tracker (caller may join()); rabit mode when num_servers == 0,
+    parameter-server scheduler mode otherwise.
+    """
+    envs = {"DMLC_NUM_WORKER": num_workers, "DMLC_NUM_SERVER": num_servers}
+    envs.update(extra_envs or {})
+    ip = get_host_ip(host_ip)
+
+    if num_servers == 0:
+        tracker = RabitTracker(host_ip=ip, num_workers=num_workers)
+        envs.update(tracker.worker_envs())
+        envs["DMLC_JAX_COORDINATOR"] = f"{ip}:{tracker.port + 1}"
+        tracker.start()
+        if tracker.alive():
+            fun_submit(num_workers, num_servers, envs)
+        return tracker
+    tracker = PSTracker(host_ip=ip, cmd=pscmd, envs=envs)
+    envs.update(tracker.worker_envs())
+    if tracker.alive() or pscmd is None:
+        fun_submit(num_workers, num_servers, envs)
+    return tracker
+
+
+def main(argv=None) -> None:
+    from . import launchers
+    from .opts import parse
+
+    args = parse(argv)
+    logging.basicConfig(level=getattr(logging, args.log_level))
+    launcher = launchers.get(args.cluster)
+    launcher(args)
+
+
+if __name__ == "__main__":
+    main()

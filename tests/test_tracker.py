@@ -1,0 +1,132 @@
+"""Tracker tests: topology maps, full multi-worker rendezvous (in-process,
+threads as workers — no cluster needed), recover path, dmlc-submit local
+end-to-end, env bootstrap parsing."""
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dmlc_core_tpu.parallel.bootstrap import dmlc_env_info
+from dmlc_core_tpu.tracker import RabitTracker, WorkerClient
+from dmlc_core_tpu.tracker.rendezvous import binary_tree, link_map
+
+
+def test_binary_tree_shape():
+    neighbours, parent = binary_tree(7)
+    assert parent[0] == -1
+    # heap: children of 0 are 1,2; of 1 are 3,4; of 2 are 5,6
+    assert sorted(neighbours[0]) == [1, 2]
+    assert sorted(neighbours[1]) == [0, 3, 4]
+    assert sorted(neighbours[6]) == [2]
+    for r in range(1, 7):
+        assert r in neighbours[parent[r]]
+
+
+@pytest.mark.parametrize("world", [1, 2, 3, 5, 8, 13])
+def test_link_map_ring_is_sequential(world):
+    tree, parent, ring = link_map(world)
+    assert len(tree) == world
+    # after relabelling the ring must be 0→1→…→n-1→0
+    for r in range(world):
+        prev, nxt = ring[r]
+        assert nxt == (r + 1) % world
+        assert prev == (r - 1) % world
+    # tree stays a tree: every non-root has its parent as a neighbour
+    roots = [r for r, p in parent.items() if p == -1]
+    assert len(roots) == 1
+    for r, p in parent.items():
+        if p != -1:
+            assert r in tree[p] and p in tree[r]
+
+
+def _run_worker(results, idx, port, world):
+    client = WorkerClient(tracker_uri="127.0.0.1", tracker_port=port,
+                          jobid=f"job-{idx}")
+    client.start(world_size=world)
+    # exchange a byte over every peer link to prove the links really work
+    for rank, sock in client.peer_socks.items():
+        sock.sendall(bytes([client.rank]))
+    peers_seen = {}
+    for rank, sock in client.peer_socks.items():
+        data = sock.recv(1)
+        peers_seen[rank] = data[0]
+    client.tracker_print(f"worker {client.rank} linked to {sorted(peers_seen)}")
+    results[idx] = (client.rank, client.world_size, client.parent_rank,
+                    dict(peers_seen))
+    client.shutdown()
+
+
+def test_full_rendezvous_eight_workers():
+    world = 8
+    tracker = RabitTracker("127.0.0.1", world)
+    tracker.start()
+    results = {}
+    threads = [threading.Thread(target=_run_worker,
+                                args=(results, i, tracker.port, world))
+               for i in range(world)]
+    for t in threads:
+        t.start()
+    tracker.join(timeout=30)
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == world
+    ranks = sorted(r for r, *_ in results.values())
+    assert ranks == list(range(world))
+    # every peer byte matches the peer's actual rank
+    for rank, ws, parent, peers in results.values():
+        assert ws == world
+        for peer_rank, seen in peers.items():
+            assert peer_rank == seen
+    # links are symmetric across workers
+    links = {r: set(p.keys()) for r, _, _, p in results.values()}
+    for r, peers in links.items():
+        for p in peers:
+            assert r in links[p]
+
+
+def test_tracker_envs():
+    tracker = RabitTracker("127.0.0.1", 2, extra_envs={"FOO": "bar"})
+    envs = tracker.worker_envs()
+    assert envs["DMLC_TRACKER_URI"] == "127.0.0.1"
+    assert envs["DMLC_TRACKER_PORT"] == tracker.port
+    assert envs["FOO"] == "bar"
+
+
+def test_dmlc_env_info_contract(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_TASK_ID", "3")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "8")
+    monkeypatch.setenv("DMLC_TRACKER_URI", "10.0.0.1")
+    monkeypatch.setenv("DMLC_TRACKER_PORT", "9091")
+    info = dmlc_env_info()
+    assert info.task_id == 3
+    assert info.num_workers == 8
+    assert info.coordinator_address == "10.0.0.1:9091"
+
+
+def test_dmlc_submit_local_end_to_end(tmp_path):
+    """dmlc-submit --cluster=local runs 3 workers that rendezvous and write
+    their ranks; the union must be {0,1,2}."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import os, sys
+sys.path.insert(0, {str(os.getcwd())!r})
+from dmlc_core_tpu.tracker import WorkerClient
+client = WorkerClient()
+client.start(world_size=int(os.environ["DMLC_NUM_WORKER"]))
+open(os.path.join({str(out_dir)!r}, f"rank-{{client.rank}}"), "w").write(
+    os.environ["DMLC_TASK_ID"])
+client.shutdown()
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.dmlc_submit",
+         "--cluster=local", "-n", "3", "--", sys.executable, str(worker)],
+        cwd=os.getcwd(), capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    ranks = sorted(p.name for p in out_dir.iterdir())
+    assert ranks == ["rank-0", "rank-1", "rank-2"]
