@@ -66,6 +66,34 @@ int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** d
                               uint64_t* size);
 void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle);
 
+/* ---- StagedBatcher: parse→pack→pad pipeline for device staging ---------- */
+typedef void* DmlcTpuStagedBatcherHandle;
+
+/*! \brief borrowed view of one fixed-shape padded COO batch */
+typedef struct {
+  uint32_t num_rows;        /* true rows (rest is padding) */
+  uint64_t batch_size;      /* padded row count */
+  uint64_t nnz_pad;         /* padded nonzero count (multiple of nnz_bucket) */
+  int64_t max_index;        /* max feature id seen so far (-1 if none) */
+  const float* label;       /* [batch_size] */
+  const float* weight;      /* [batch_size], 0 on padding rows */
+  const int32_t* index;     /* [nnz_pad] */
+  const float* value;       /* [nnz_pad], 0 on padding slots */
+  const int32_t* row_id;    /* [nnz_pad], batch_size-1 on padding slots */
+  const int32_t* field;     /* [nnz_pad] or NULL */
+} DmlcTpuStagedBatchC;
+
+int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
+                               const char* format, uint64_t batch_size,
+                               uint64_t nnz_bucket, int with_field,
+                               DmlcTpuStagedBatcherHandle* out);
+/*! \brief next batch (1/0/-1); buffers stay valid until the following call
+ *  to Next/BeforeFirst/Free on this handle */
+int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBatchC* out);
+int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle);
+int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle);
+void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle);
+
 /* ---- misc ---------------------------------------------------------------- */
 /*! \brief library version string */
 const char* DmlcTpuVersion(void);

@@ -4,6 +4,7 @@
 #include <memory>
 #include <string>
 
+#include "./data/staged_batcher.h"
 #include "dmlctpu/data.h"
 #include "dmlctpu/input_split.h"
 #include "dmlctpu/logging.h"
@@ -41,6 +42,11 @@ struct ReaderCtx {
   std::unique_ptr<dmlctpu::Stream> stream;
   std::unique_ptr<dmlctpu::RecordIOReader> reader;
   std::string record;
+};
+struct BatcherCtx {
+  std::unique_ptr<dmlctpu::data::StagedBatcher> batcher;
+  dmlctpu::data::StagedBatch* borrowed = nullptr;
+  uint64_t batch_size = 0;
 };
 
 }  // namespace
@@ -197,6 +203,62 @@ int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** d
 
 void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle) {
   delete static_cast<ReaderCtx*>(handle);
+}
+
+int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
+                               const char* format, uint64_t batch_size,
+                               uint64_t nnz_bucket, int with_field,
+                               DmlcTpuStagedBatcherHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<BatcherCtx>();
+    auto parser = dmlctpu::Parser<uint64_t, float>::Create(uri, part, num_parts, format);
+    ctx->batcher = std::make_unique<dmlctpu::data::StagedBatcher>(
+        std::move(parser), batch_size, nnz_bucket, with_field != 0);
+    ctx->batch_size = batch_size;
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBatchC* out) {
+  return Guard([&] {
+    auto* ctx = static_cast<BatcherCtx*>(handle);
+    if (ctx->borrowed != nullptr) {
+      ctx->batcher->Recycle(&ctx->borrowed);
+    }
+    if (!ctx->batcher->Next(&ctx->borrowed)) return 0;
+    const auto* b = ctx->borrowed;
+    out->num_rows = b->num_rows;
+    out->batch_size = ctx->batch_size;
+    out->nnz_pad = b->index.size();
+    out->max_index = b->max_index;
+    out->label = b->label.data();
+    out->weight = b->weight.data();
+    out->index = b->index.data();
+    out->value = b->value.data();
+    out->row_id = b->row_id.data();
+    out->field = b->field.empty() ? nullptr : b->field.data();
+    return 1;
+  });
+}
+
+int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle) {
+  return Guard([&] {
+    auto* ctx = static_cast<BatcherCtx*>(handle);
+    ctx->batcher->BeforeFirst();
+    if (ctx->borrowed != nullptr) {
+      ctx->batcher->Recycle(&ctx->borrowed);
+    }
+    return 0;
+  });
+}
+
+int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle) {
+  return static_cast<int64_t>(static_cast<BatcherCtx*>(handle)->batcher->BytesRead());
+}
+
+void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle) {
+  delete static_cast<BatcherCtx*>(handle);
 }
 
 }  // extern "C"

@@ -1,0 +1,183 @@
+// staged_batcher.h — native parse→pack→pad pipeline for device staging.
+// The TPU-era addition (SURVEY.md §7 step 7): drains a Parser's ragged
+// RowBlocks and emits fixed-size, bucket-padded COO batches whose buffers
+// Python wraps zero-copy and device_puts into HBM.  Row count is fixed at
+// batch_size (tail zero-padded, padding rows weight 0); nonzeros are padded
+// to a multiple of nnz_bucket (bounded set of XLA shapes); padded slots
+// carry value 0 and row_id batch_size-1 (numerically inert in segment-sum).
+// A ThreadedIter runs the packing one batch ahead of the consumer.
+#ifndef DMLCTPU_SRC_DATA_STAGED_BATCHER_H_
+#define DMLCTPU_SRC_DATA_STAGED_BATCHER_H_
+
+#include <algorithm>
+#include <cstring>
+#include <memory>
+#include <utility>
+#include <vector>
+
+#include "dmlctpu/data.h"
+#include "dmlctpu/threaded_iter.h"
+
+namespace dmlctpu {
+namespace data {
+
+struct StagedBatch {
+  std::vector<float> label;     // [batch_size]
+  std::vector<float> weight;    // [batch_size]
+  std::vector<int32_t> index;   // [nnz_pad]
+  std::vector<float> value;     // [nnz_pad]
+  std::vector<int32_t> row_id;  // [nnz_pad]
+  std::vector<int32_t> field;   // [nnz_pad] when with_field
+  uint32_t num_rows = 0;        // true rows (<= batch_size)
+  int64_t max_index = -1;       // running max feature id seen so far
+};
+
+class StagedBatcher {
+ public:
+  StagedBatcher(std::unique_ptr<Parser<uint64_t, float>> parser, size_t batch_size,
+                size_t nnz_bucket, bool with_field)
+      : parser_(std::move(parser)),
+        batch_size_(batch_size),
+        nnz_bucket_(std::max<size_t>(nnz_bucket, 1)),
+        with_field_(with_field),
+        iter_(4) {
+    parser_->BeforeFirst();
+    iter_.Init([this](StagedBatch** cell) { return Produce(cell); },
+               [this] {
+                 parser_->BeforeFirst();
+                 pend_ = Pending{};
+                 source_end_ = false;
+               });
+  }
+  ~StagedBatcher() { iter_.Destroy(); }
+
+  /*! \brief borrow the next batch; call Recycle when the consumer copied it out */
+  bool Next(StagedBatch** out) { return iter_.Next(out); }
+  void Recycle(StagedBatch** inout) { iter_.Recycle(inout); }
+  void BeforeFirst() { iter_.BeforeFirst(); }
+  size_t BytesRead() const { return parser_->BytesRead(); }
+
+ private:
+  /*! \brief rows accumulated but not yet emitted, in flat COO layout */
+  struct Pending {
+    std::vector<float> label, weight, value;
+    std::vector<uint64_t> index, field;
+    std::vector<size_t> row_nnz_end;  // prefix end of each row's nonzeros
+    int64_t max_index = -1;
+    size_t rows() const { return label.size(); }
+  };
+
+  void Absorb(const RowBlock<uint64_t, float>& b) {
+    size_t base_nnz = pend_.value.size();
+    size_t nnz = b.offset[b.size] - b.offset[0];
+    // bulk copies: parser offsets may not start at 0 inside a shared buffer
+    const uint64_t* idx = b.index + b.offset[0];
+    pend_.index.insert(pend_.index.end(), idx, idx + nnz);
+    if (b.value != nullptr) {
+      const float* val = b.value + b.offset[0];
+      pend_.value.insert(pend_.value.end(), val, val + nnz);
+    } else {
+      pend_.value.insert(pend_.value.end(), nnz, 1.0f);
+    }
+    if (with_field_) {
+      if (b.field != nullptr) {
+        const uint64_t* fld = b.field + b.offset[0];
+        pend_.field.insert(pend_.field.end(), fld, fld + nnz);
+      } else {
+        pend_.field.insert(pend_.field.end(), nnz, 0);
+      }
+    }
+    pend_.label.insert(pend_.label.end(), b.label, b.label + b.size);
+    if (b.weight != nullptr) {
+      pend_.weight.insert(pend_.weight.end(), b.weight, b.weight + b.size);
+    } else {
+      pend_.weight.insert(pend_.weight.end(), b.size, 1.0f);
+    }
+    for (size_t r = 0; r < b.size; ++r) {
+      pend_.row_nnz_end.push_back(base_nnz + (b.offset[r + 1] - b.offset[0]));
+    }
+    for (size_t k = 0; k < nnz; ++k) {
+      pend_.max_index = std::max<int64_t>(pend_.max_index,
+                                          static_cast<int64_t>(idx[k]));
+    }
+  }
+
+  bool Produce(StagedBatch** cell) {
+    while (pend_.rows() < batch_size_ && !source_end_) {
+      if (parser_->Next()) {
+        Absorb(parser_->Value());
+      } else {
+        source_end_ = true;
+      }
+    }
+    size_t take = std::min(pend_.rows(), batch_size_);
+    if (take == 0) return false;
+    if (*cell == nullptr) *cell = new StagedBatch();
+    Emit(*cell, take);
+    return true;
+  }
+
+  void Emit(StagedBatch* out, size_t take) {
+    const size_t B = batch_size_;
+    size_t nnz = pend_.row_nnz_end[take - 1];
+    size_t nnz_pad = ((nnz + nnz_bucket_ - 1) / nnz_bucket_) * nnz_bucket_;
+    out->num_rows = static_cast<uint32_t>(take);
+    out->max_index = pend_.max_index;
+    out->label.assign(B, 0.0f);
+    out->weight.assign(B, 0.0f);
+    std::memcpy(out->label.data(), pend_.label.data(), take * sizeof(float));
+    std::memcpy(out->weight.data(), pend_.weight.data(), take * sizeof(float));
+    out->index.assign(nnz_pad, 0);
+    out->value.assign(nnz_pad, 0.0f);
+    out->row_id.assign(nnz_pad, static_cast<int32_t>(B - 1));
+    for (size_t k = 0; k < nnz; ++k) {
+      out->index[k] = static_cast<int32_t>(pend_.index[k]);
+    }
+    std::memcpy(out->value.data(), pend_.value.data(), nnz * sizeof(float));
+    if (with_field_) {
+      out->field.assign(nnz_pad, 0);
+      for (size_t k = 0; k < nnz; ++k) {
+        out->field[k] = static_cast<int32_t>(pend_.field[k]);
+      }
+    } else {
+      out->field.clear();
+    }
+    size_t prev_end = 0;
+    for (size_t r = 0; r < take; ++r) {
+      size_t end = pend_.row_nnz_end[r];
+      std::fill(out->row_id.begin() + prev_end, out->row_id.begin() + end,
+                static_cast<int32_t>(r));
+      prev_end = end;
+    }
+    // drop the emitted prefix from the pending pool
+    Pending next;
+    size_t rem_rows = pend_.rows() - take;
+    if (rem_rows != 0) {
+      next.label.assign(pend_.label.begin() + take, pend_.label.end());
+      next.weight.assign(pend_.weight.begin() + take, pend_.weight.end());
+      next.index.assign(pend_.index.begin() + nnz, pend_.index.end());
+      next.value.assign(pend_.value.begin() + nnz, pend_.value.end());
+      if (with_field_) {
+        next.field.assign(pend_.field.begin() + nnz, pend_.field.end());
+      }
+      next.row_nnz_end.reserve(rem_rows);
+      for (size_t r = take; r < pend_.rows(); ++r) {
+        next.row_nnz_end.push_back(pend_.row_nnz_end[r] - nnz);
+      }
+    }
+    next.max_index = pend_.max_index;
+    pend_ = std::move(next);
+  }
+
+  std::unique_ptr<Parser<uint64_t, float>> parser_;
+  size_t batch_size_;
+  size_t nnz_bucket_;
+  bool with_field_;
+  Pending pend_;
+  bool source_end_ = false;
+  ThreadedIter<StagedBatch> iter_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_STAGED_BATCHER_H_

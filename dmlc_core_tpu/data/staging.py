@@ -3,22 +3,25 @@ prefetching iterator that turns ragged parsed RowBlocks into *static-shape*
 padded CSR batches resident in TPU HBM.
 
 Design (SURVEY.md §7 step 7):
+  * the parse→pack→pad pipeline is NATIVE (cpp/src/data/staged_batcher.h):
+    a C++ StagedBatcher drains the parser's RowBlocks into fixed-size
+    batches one batch ahead of the consumer, so Python only wraps buffers;
   * rows are packed to a fixed ``batch_size`` (final short batch zero-padded,
     padding rows carry weight 0 so losses ignore them);
   * nonzeros are padded to the next multiple of ``nnz_bucket`` — a handful of
-    distinct shapes total, so XLA compiles a handful of executables instead of
-    one per batch (ragged shapes would retrace every step);
+    distinct shapes total, so XLA compiles a handful of executables instead
+    of one per batch (ragged shapes would retrace every step);
   * padded nnz slots point at row ``batch_size-1`` / column 0 with value 0 —
     numerically inert in segment-sum compute;
-  * a background thread runs parse+pack+``device_put`` one batch ahead
-    (double buffering): JAX dispatch is async, so the host→HBM DMA of batch
-    N+1 overlaps the device compute of batch N;
+  * a Python thread runs ``device_put`` one batch ahead (double buffering):
+    the host→HBM DMA of batch N+1 overlaps the device compute of batch N;
   * with a mesh, batches are laid out sharded over the data axis via
     ``jax.make_array_from_process_local_data`` (multi-host: each process
     contributes its local InputSplit shard; single host: plain sharded put).
 """
 from __future__ import annotations
 
+import ctypes
 import queue
 import threading
 from dataclasses import dataclass
@@ -29,7 +32,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .rowblock import Parser, RowBlock
+from .._native import check, lib
+from .rowblock import Parser  # noqa: F401  (re-exported convenience)
 
 
 @dataclass
@@ -59,65 +63,37 @@ jax.tree_util.register_dataclass(
     meta_fields=[])
 
 
-def _round_up(n: int, mult: int) -> int:
-    return ((max(n, 1) + mult - 1) // mult) * mult
+class _StagedBatchC(ctypes.Structure):
+    _fields_ = [
+        ("num_rows", ctypes.c_uint32),
+        ("batch_size", ctypes.c_uint64),
+        ("nnz_pad", ctypes.c_uint64),
+        ("max_index", ctypes.c_int64),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
+        ("index", ctypes.POINTER(ctypes.c_int32)),
+        ("value", ctypes.POINTER(ctypes.c_float)),
+        ("row_id", ctypes.POINTER(ctypes.c_int32)),
+        ("field", ctypes.POINTER(ctypes.c_int32)),
+    ]
 
 
-class _Packer:
-    """Accumulates RowBlocks and emits fixed-size numpy batches."""
-
-    def __init__(self, batch_size: int, nnz_bucket: int, with_field: bool):
-        self.batch_size = batch_size
-        self.nnz_bucket = nnz_bucket
-        self.with_field = with_field
-        self._rows: list = []  # per-row tuples (label, weight, index, value, field)
-        self.max_index = 0
-
-    def push_block(self, block: RowBlock) -> None:
-        values = block.values_or_ones()
-        offsets = block.offset
-        if block.num_nonzero:
-            self.max_index = max(self.max_index, int(block.index.max()))
-        for r in range(block.size):
-            lo, hi = int(offsets[r]), int(offsets[r + 1])
-            self._rows.append((
-                float(block.label[r]),
-                float(block.weight[r]) if block.weight is not None else 1.0,
-                block.index[lo:hi],
-                values[lo:hi],
-                block.field[lo:hi] if (self.with_field and block.field is not None) else None,
-            ))
-
-    def ready(self) -> bool:
-        return len(self._rows) >= self.batch_size
-
-    def pop_batch(self, allow_partial: bool) -> Optional[dict]:
-        n = min(len(self._rows), self.batch_size)
-        if n == 0 or (n < self.batch_size and not allow_partial):
-            return None
-        rows, self._rows = self._rows[:n], self._rows[n:]
-        B = self.batch_size
-        label = np.zeros(B, np.float32)
-        weight = np.zeros(B, np.float32)  # padding rows stay weight 0
-        nnz = sum(len(r[2]) for r in rows)
-        nnz_pad = _round_up(nnz, self.nnz_bucket)
-        index = np.zeros(nnz_pad, np.int32)
-        value = np.zeros(nnz_pad, np.float32)
-        row_id = np.full(nnz_pad, B - 1, np.int32)  # inert padding target
-        field = np.zeros(nnz_pad, np.int32) if self.with_field else None
-        k = 0
-        for r, (lab, wgt, idx, val, fld) in enumerate(rows):
-            label[r] = lab
-            weight[r] = wgt
-            m = len(idx)
-            index[k:k + m] = idx.astype(np.int32)
-            value[k:k + m] = val
-            row_id[k:k + m] = r
-            if field is not None and fld is not None:
-                field[k:k + m] = fld.astype(np.int32)
-            k += m
-        return dict(label=label, weight=weight, index=index, value=value,
-                    row_id=row_id, num_rows=np.int32(n), field=field)
+def _declare_batcher_sig():
+    L = lib()
+    if getattr(L, "_staged_batcher_declared", False):
+        return L
+    L.DmlcTpuStagedBatcherCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p)]
+    L.DmlcTpuStagedBatcherNext.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(_StagedBatchC)]
+    L.DmlcTpuStagedBatcherBeforeFirst.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuStagedBatcherBytesRead.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuStagedBatcherBytesRead.restype = ctypes.c_int64
+    L.DmlcTpuStagedBatcherFree.argtypes = [ctypes.c_void_p]
+    L._staged_batcher_declared = True
+    return L
 
 
 class DeviceStagingIter:
@@ -125,84 +101,99 @@ class DeviceStagingIter:
 
     Parameters
     ----------
-    parser : Parser | str
-        a Parser, or a URI (then part/num_parts/format apply).
+    uri : dataset URI (same sugar as Parser).
     batch_size : rows per emitted batch (global batch when sharded).
     nnz_bucket : pad nonzeros to a multiple of this (shape-bucketing).
     sharding : optional ``jax.sharding.Sharding`` for the staged arrays
         (e.g. NamedSharding(mesh, P('data')) on the leading axis).  Scalars
         and ``num_rows`` are replicated.
-    prefetch : how many staged batches the background thread keeps in flight.
+    prefetch : staged batches the background thread keeps in flight.
     """
 
-    def __init__(self, parser, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
+    def __init__(self, uri: str, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
                  part: int = 0, num_parts: int = 1, format: str = "auto",  # noqa: A002
-                 sharding=None, with_field: bool = False, prefetch: int = 2,
-                 drop_remainder: bool = False):
-        if isinstance(parser, str):
-            parser = Parser(parser, part, num_parts, format)
-        self._parser = parser
-        self._packer = _Packer(batch_size, nnz_bucket, with_field)
+                 sharding=None, with_field: bool = False, prefetch: int = 2):
+        self._lib = _declare_batcher_sig()
+        self._handle = ctypes.c_void_p()
+        check(self._lib.DmlcTpuStagedBatcherCreate(
+            uri.encode(), part, num_parts, format.encode(),
+            batch_size, nnz_bucket, int(with_field), ctypes.byref(self._handle)))
         self._sharding = sharding
         self._prefetch = max(prefetch, 1)
-        self._drop_remainder = drop_remainder
+        self._with_field = with_field
+        self._max_index = -1
         self.batches_staged = 0
+        self._lock = threading.Lock()  # one native cursor per handle
 
     @property
     def bytes_read(self) -> int:
-        return self._parser.bytes_read
+        return self._lib.DmlcTpuStagedBatcherBytesRead(self._handle)
 
     @property
     def max_index(self) -> int:
-        """Largest column id seen so far (after at least one epoch: the dim)."""
-        return self._packer.max_index
+        """Largest column id seen so far (after a full epoch: num_features-1)."""
+        return self._max_index
+
+    def close(self) -> None:
+        handle, self._handle = self._handle, ctypes.c_void_p()
+        if handle:
+            try:
+                self._lib.DmlcTpuStagedBatcherFree(handle)
+            except (AttributeError, TypeError):
+                pass  # interpreter shutdown already tore down ctypes
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ---- staging ------------------------------------------------------------
-    def _stage(self, host: dict) -> PaddedBatch:
-        def put(x, shard_rows: bool):
-            if x is None:
-                return None
-            if self._sharding is not None and shard_rows:
+    def _stage(self, c: _StagedBatchC) -> PaddedBatch:
+        B = c.batch_size
+        nnz = c.nnz_pad
+
+        def view(ptr, n):
+            # snapshot into an owned array: the native buffer is recycled on
+            # the next cursor advance, and jax's CPU backend zero-copy-aliases
+            # well-aligned numpy buffers (a dangling alias otherwise)
+            return np.ctypeslib.as_array(ptr, shape=(int(n),)).copy()
+
+        def put(arr):
+            if self._sharding is not None:
                 if jax.process_count() > 1:
-                    return jax.make_array_from_process_local_data(self._sharding, x)
-                return jax.device_put(x, self._sharding)
-            return jnp.asarray(x)
+                    return jax.make_array_from_process_local_data(self._sharding, arr)
+                return jax.device_put(arr, self._sharding)
+            return jax.device_put(arr)
 
         batch = PaddedBatch(
-            label=put(host["label"], True),
-            weight=put(host["weight"], True),
-            index=put(host["index"], True),
-            value=put(host["value"], True),
-            row_id=put(host["row_id"], True),
-            num_rows=jnp.asarray(host["num_rows"]),
-            field=put(host["field"], True),
+            label=put(view(c.label, B)),
+            weight=put(view(c.weight, B)),
+            index=put(view(c.index, nnz)),
+            value=put(view(c.value, nnz)),
+            row_id=put(view(c.row_id, nnz)),
+            num_rows=jnp.asarray(np.int32(c.num_rows)),
+            field=put(view(c.field, nnz)) if (self._with_field and c.field) else None,
         )
+        self._max_index = max(self._max_index, int(c.max_index))
         self.batches_staged += 1
         return batch
 
-    def _host_batches(self) -> Iterator[dict]:
-        self._parser.before_first()
-        for block in self._parser:
-            self._packer.push_block(block)
-            while self._packer.ready():
-                yield self._packer.pop_batch(allow_partial=False)
-        if not self._drop_remainder:
-            tail = self._packer.pop_batch(allow_partial=True)
-            if tail is not None:
-                yield tail
-
     def __iter__(self) -> Iterator[PaddedBatch]:
-        """Yield device-resident batches; parse+pack+transfer runs one ahead."""
+        """Yield device-resident batches; parse/pack (C++) and device_put
+        (this background thread) run ahead of the consumer."""
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         sentinel = object()
         error: list = []
 
         def producer():
             try:
-                for host in self._host_batches():
-                    # device_put here (producer thread): the DMA is issued
-                    # while the consumer is still computing on batch N-1
-                    q.put(self._stage(host))
+                with self._lock:
+                    check(self._lib.DmlcTpuStagedBatcherBeforeFirst(self._handle))
+                    c = _StagedBatchC()
+                    while check(self._lib.DmlcTpuStagedBatcherNext(
+                            self._handle, ctypes.byref(c))) == 1:
+                        q.put(self._stage(c))
             except BaseException as e:  # relayed to consumer
                 error.append(e)
             finally:
@@ -219,4 +210,4 @@ class DeviceStagingIter:
             if error:
                 raise error[0]
         finally:
-            t.join(timeout=5.0)
+            t.join(timeout=10.0)
