@@ -1,0 +1,71 @@
+// dmlctpu/adapters.h — standard-library fulfilment of reference components
+// that pre-date C++17/20.  The reference backfilled these by hand
+// (include/dmlc/{any.h,optional.h,array_view.h,thread_local.h}); on the
+// C++20 baseline the idiomatic TPU-build answer is the standard library,
+// re-exported here with the small extensions the dmlc surface relies on
+// (stream parse of optional<T> incl. "None", ThreadLocalStore).
+#ifndef DMLCTPU_ADAPTERS_H_
+#define DMLCTPU_ADAPTERS_H_
+
+#include <any>
+#include <istream>
+#include <optional>
+#include <ostream>
+#include <span>
+#include <sstream>
+#include <string>
+
+namespace dmlctpu {
+
+using std::any;
+using std::any_cast;
+using std::bad_any_cast;
+using std::nullopt;
+using std::optional;
+
+/*! \brief non-owning contiguous view (reference array_view parity) */
+template <typename T>
+using array_view = std::span<T>;
+
+/*! \brief stream-print an optional as its value or "None" */
+template <typename T>
+std::ostream& operator<<(std::ostream& os, const optional<T>& v) {
+  if (v.has_value()) return os << *v;
+  return os << "None";
+}
+
+/*! \brief stream-parse an optional: "None"/"null" → nullopt */
+template <typename T>
+std::istream& operator>>(std::istream& is, optional<T>& v) {
+  std::string tok;
+  is >> tok;
+  if (tok == "None" || tok == "none" || tok == "null") {
+    v.reset();
+    return is;
+  }
+  std::istringstream sub(tok);
+  T tmp{};
+  sub >> tmp;
+  if (sub.fail()) {
+    is.setstate(std::ios::failbit);
+  } else {
+    v = tmp;
+  }
+  return is;
+}
+
+/*!
+ * \brief per-thread singleton store (reference ThreadLocalStore parity);
+ *        objects are default-constructed per thread on first Get().
+ */
+template <typename T>
+class ThreadLocalStore {
+ public:
+  static T* Get() {
+    static thread_local T inst;
+    return &inst;
+  }
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_ADAPTERS_H_

@@ -1,0 +1,58 @@
+// dmlctpu/config.h — key=value config-file parser (legacy interface).
+// Parity: reference include/dmlc/config.h (:40-175) + src/config.cc:
+// whitespace/newline-separated `key = value` pairs, '#' comments, quoted
+// values with escapes, optional multi-value keys, proto-string output.
+#ifndef DMLCTPU_CONFIG_H_
+#define DMLCTPU_CONFIG_H_
+
+#include <istream>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace dmlctpu {
+
+class Config {
+ public:
+  using ConfigEntry = std::pair<std::string, std::string>;
+
+  /*! \param multi_value when true, repeated keys accumulate instead of overwrite */
+  explicit Config(bool multi_value = false) : multi_value_(multi_value) {}
+  Config(std::istream& is, bool multi_value = false)  // NOLINT(runtime/references)
+      : multi_value_(multi_value) {
+    LoadFromStream(is);
+  }
+
+  void Clear() {
+    entries_.clear();
+    by_key_.clear();
+  }
+  /*! \brief parse `key = value` lines from a stream (appends) */
+  void LoadFromStream(std::istream& is);  // NOLINT(runtime/references)
+  /*! \brief set (or append, in multi-value mode) a parameter */
+  void SetParam(const std::string& key, const std::string& value);
+  template <typename T>
+  void SetParam(const std::string& key, const T& value) {
+    SetParam(key, ToString(value));
+  }
+  /*! \brief latest value for key; throws dmlctpu::Error if absent */
+  const std::string& GetParam(const std::string& key) const;
+  bool Contains(const std::string& key) const { return by_key_.count(key) != 0; }
+  /*! \brief render as `key : "value"` proto-text lines */
+  std::string ToProtoString() const;
+
+  std::vector<ConfigEntry>::const_iterator begin() const { return entries_.begin(); }
+  std::vector<ConfigEntry>::const_iterator end() const { return entries_.end(); }
+
+ private:
+  template <typename T>
+  static std::string ToString(const T& v);
+
+  bool multi_value_;
+  std::vector<ConfigEntry> entries_;
+  std::map<std::string, size_t> by_key_;  // key → index of latest entry
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_CONFIG_H_

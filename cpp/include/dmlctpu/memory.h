@@ -1,0 +1,110 @@
+// dmlctpu/memory.h — object pools.
+// Parity: reference include/dmlc/memory.h (MemoryPool:24,
+// ThreadlocalAllocator:87, ThreadlocalSharedPtr:134).  Fresh design: a
+// fixed-size-object arena pool with free list, a thread-local caching
+// allocator facade, and pooled shared pointers.
+#ifndef DMLCTPU_MEMORY_H_
+#define DMLCTPU_MEMORY_H_
+
+#include <cstddef>
+#include <memory>
+#include <new>
+#include <utility>
+#include <vector>
+
+#include "./logging.h"
+
+namespace dmlctpu {
+
+/*!
+ * \brief arena pool of fixed-size objects: allocate() pops the free list or
+ *        carves from 4KB-aligned pages; deallocate() pushes back.  Not
+ *        thread-safe by design (wrap per thread — see ThreadlocalAllocator).
+ */
+template <typename T>
+class MemoryPool {
+ public:
+  static_assert(sizeof(T) >= sizeof(void*), "objects must hold a free-list link");
+
+  ~MemoryPool() {
+    for (void* page : pages_) ::operator delete(page, std::align_val_t{alignof(T)});
+  }
+
+  T* allocate() {
+    if (free_head_ == nullptr) GrowPage();
+    FreeNode* node = free_head_;
+    free_head_ = node->next;
+    ++live_;
+    return reinterpret_cast<T*>(node);
+  }
+  void deallocate(T* ptr) {
+    auto* node = reinterpret_cast<FreeNode*>(ptr);
+    node->next = free_head_;
+    free_head_ = node;
+    --live_;
+  }
+  template <typename... Args>
+  T* create(Args&&... args) {
+    return new (allocate()) T(std::forward<Args>(args)...);
+  }
+  void destroy(T* ptr) {
+    ptr->~T();
+    deallocate(ptr);
+  }
+  size_t live() const { return live_; }
+
+ private:
+  struct FreeNode {
+    FreeNode* next;
+  };
+  static constexpr size_t kObjectsPerPage = (4096 + sizeof(T) - 1) / sizeof(T);
+
+  void GrowPage() {
+    void* page = ::operator new(kObjectsPerPage * sizeof(T), std::align_val_t{alignof(T)});
+    pages_.push_back(page);
+    char* base = static_cast<char*>(page);
+    for (size_t i = kObjectsPerPage; i-- > 0;) {
+      auto* node = reinterpret_cast<FreeNode*>(base + i * sizeof(T));
+      node->next = free_head_;
+      free_head_ = node;
+    }
+  }
+
+  FreeNode* free_head_ = nullptr;
+  std::vector<void*> pages_;
+  size_t live_ = 0;
+};
+
+/*! \brief per-thread pool: allocation without synchronization */
+template <typename T>
+class ThreadlocalAllocator {
+ public:
+  template <typename... Args>
+  T* create(Args&&... args) {
+    return Pool().create(std::forward<Args>(args)...);
+  }
+  void destroy(T* ptr) { Pool().destroy(ptr); }
+
+ private:
+  static MemoryPool<T>& Pool() {
+    static thread_local MemoryPool<T> pool;
+    return pool;
+  }
+};
+
+/*!
+ * \brief shared_ptr whose object comes from (and returns to) the calling
+ *        thread's pool.  The deleter captures the owning pool, so release on
+ *        another thread is fatal by contract (parity with the reference's
+ *        thread-local pooled pointer semantics).
+ */
+template <typename T, typename... Args>
+std::shared_ptr<T> MakeThreadlocalShared(Args&&... args) {
+  static thread_local MemoryPool<T> pool;
+  MemoryPool<T>* owner = &pool;
+  T* obj = owner->create(std::forward<Args>(args)...);
+  return std::shared_ptr<T>(obj, [owner](T* p) { owner->destroy(p); });
+}
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_MEMORY_H_

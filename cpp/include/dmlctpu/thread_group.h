@@ -1,0 +1,188 @@
+// dmlctpu/thread_group.h — named-thread lifecycle management.
+// Parity: reference include/dmlc/thread_group.h (ThreadGroup::Thread:101,
+// ManualEvent:34, BlockingQueueThread:528, TimerThread:643).  Fresh design
+// on std::jthread-style cooperative stop tokens (explicit here, as libstdc++
+// jthread interacts poorly with shared handles): threads register by name,
+// request_shutdown flips their stop flag and wakes them, join is by name or
+// all.
+#ifndef DMLCTPU_THREAD_GROUP_H_
+#define DMLCTPU_THREAD_GROUP_H_
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "./concurrency.h"
+#include "./logging.h"
+
+namespace dmlctpu {
+
+/*! \brief manually-reset event (set/wait/reset) */
+class ManualEvent {
+ public:
+  void set() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      signaled_ = true;
+    }
+    cv_.notify_all();
+  }
+  void reset() {
+    std::lock_guard<std::mutex> lk(mu_);
+    signaled_ = false;
+  }
+  void wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return signaled_; });
+  }
+  template <class Rep, class Period>
+  bool wait_for(const std::chrono::duration<Rep, Period>& dur) {
+    std::unique_lock<std::mutex> lk(mu_);
+    return cv_.wait_for(lk, dur, [this] { return signaled_; });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool signaled_ = false;
+};
+
+/*!
+ * \brief owns a set of named worker threads with cooperative shutdown.
+ *        Worker bodies receive a stop-flag query callable.
+ */
+class ThreadGroup {
+ public:
+  class Thread {
+   public:
+    /*! \brief body receives the Thread for stop_requested()/event access */
+    Thread(std::string name, std::function<void(Thread&)> body)
+        : name_(std::move(name)) {
+      thread_ = std::thread([this, body = std::move(body)] { body(*this); });
+    }
+    ~Thread() { JoinNow(); }
+
+    const std::string& name() const { return name_; }
+    void request_shutdown() {
+      stop_.store(true, std::memory_order_release);
+      event.set();
+    }
+    bool stop_requested() const { return stop_.load(std::memory_order_acquire); }
+    void JoinNow() {
+      request_shutdown();
+      if (thread_.joinable()) thread_.join();
+    }
+
+    /*! \brief event workers may sleep on; set on shutdown request */
+    ManualEvent event;
+
+   private:
+    std::string name_;
+    std::atomic<bool> stop_{false};
+    std::thread thread_;
+  };
+
+  ~ThreadGroup() { JoinAll(); }
+
+  /*! \brief create and register a named thread; name must be unique */
+  std::shared_ptr<Thread> Create(const std::string& name,
+                                 std::function<void(Thread&)> body) {
+    std::lock_guard<std::mutex> lk(mu_);
+    TCHECK_EQ(threads_.count(name), 0u) << "thread '" << name << "' already exists";
+    auto t = std::make_shared<Thread>(name, std::move(body));
+    threads_[name] = t;
+    return t;
+  }
+  std::shared_ptr<Thread> Find(const std::string& name) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = threads_.find(name);
+    return it == threads_.end() ? nullptr : it->second;
+  }
+  /*! \brief request shutdown + join + deregister one thread */
+  bool Join(const std::string& name) {
+    std::shared_ptr<Thread> t;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = threads_.find(name);
+      if (it == threads_.end()) return false;
+      t = it->second;
+      threads_.erase(it);
+    }
+    t->JoinNow();
+    return true;
+  }
+  void JoinAll() {
+    std::map<std::string, std::shared_ptr<Thread>> local;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      local.swap(threads_);
+    }
+    for (auto& [name, t] : local) t->JoinNow();
+  }
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return threads_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::map<std::string, std::shared_ptr<Thread>> threads_;
+};
+
+/*!
+ * \brief worker that drains a ConcurrentBlockingQueue<ItemType> with a
+ *        handler; shutdown via the queue's SignalForKill.
+ */
+template <typename ItemType>
+class BlockingQueueThread {
+ public:
+  BlockingQueueThread(ThreadGroup* group, const std::string& name,
+                      std::function<void(ItemType)> handler)
+      : queue_(std::make_shared<ConcurrentBlockingQueue<ItemType>>()) {
+    auto queue = queue_;
+    thread_ = group->Create(
+        name, [queue, handler = std::move(handler)](ThreadGroup::Thread& self) {
+          ItemType item;
+          while (!self.stop_requested() && queue->Pop(&item)) handler(std::move(item));
+        });
+  }
+  void Enqueue(ItemType item) { queue_->Push(std::move(item)); }
+  void SignalForKill() { queue_->SignalForKill(); }
+
+ private:
+  std::shared_ptr<ConcurrentBlockingQueue<ItemType>> queue_;
+  std::shared_ptr<ThreadGroup::Thread> thread_;
+};
+
+/*! \brief fires a callback every `period` until shutdown */
+class TimerThread {
+ public:
+  TimerThread(ThreadGroup* group, const std::string& name,
+              std::chrono::milliseconds period, std::function<void()> on_tick) {
+    thread_ = group->Create(
+        name, [period, on_tick = std::move(on_tick)](ThreadGroup::Thread& self) {
+          while (!self.stop_requested()) {
+            if (self.event.wait_for(period)) break;  // woken = shutdown request
+            if (self.stop_requested()) break;
+            on_tick();
+          }
+        });
+  }
+  void Stop() {
+    if (thread_) thread_->request_shutdown();
+  }
+
+ private:
+  std::shared_ptr<ThreadGroup::Thread> thread_;
+};
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_THREAD_GROUP_H_

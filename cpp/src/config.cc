@@ -1,0 +1,128 @@
+#include "dmlctpu/config.h"
+
+#include <cctype>
+#include <sstream>
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace {
+
+/*! \brief token scanner: words, '=', quoted strings with \" \n escapes, # comments */
+class Scanner {
+ public:
+  explicit Scanner(std::istream& is) : is_(is) {}
+
+  /*! \brief next token; false at end of stream */
+  bool Next(std::string* tok, bool* quoted) {
+    *quoted = false;
+    int ch;
+    // skip whitespace and comments
+    while ((ch = is_.get()) != EOF) {
+      if (ch == '#') {
+        while ((ch = is_.get()) != EOF && ch != '\n') {
+        }
+        continue;
+      }
+      if (!std::isspace(ch)) break;
+    }
+    if (ch == EOF) return false;
+    tok->clear();
+    if (ch == '=') {
+      *tok = "=";
+      return true;
+    }
+    if (ch == '"') {
+      *quoted = true;
+      while ((ch = is_.get()) != EOF) {
+        if (ch == '\\') {
+          int e = is_.get();
+          switch (e) {
+            case 'n': tok->push_back('\n'); break;
+            case 't': tok->push_back('\t'); break;
+            case '"': tok->push_back('"'); break;
+            case '\\': tok->push_back('\\'); break;
+            default:
+              TLOG(Fatal) << "Config: unknown escape '\\" << static_cast<char>(e) << "'";
+          }
+        } else if (ch == '"') {
+          return true;
+        } else {
+          tok->push_back(static_cast<char>(ch));
+        }
+      }
+      TLOG(Fatal) << "Config: unterminated quoted string";
+    }
+    tok->push_back(static_cast<char>(ch));
+    while ((ch = is_.peek()) != EOF && !std::isspace(ch) && ch != '=' && ch != '#') {
+      tok->push_back(static_cast<char>(is_.get()));
+    }
+    return true;
+  }
+
+ private:
+  std::istream& is_;
+};
+
+}  // namespace
+
+void Config::LoadFromStream(std::istream& is) {
+  Scanner scan(is);
+  std::string key, eq, value;
+  bool q1, q2, q3;
+  while (scan.Next(&key, &q1)) {
+    TCHECK(!q1 && key != "=") << "Config: expected a key, got '" << key << "'";
+    TCHECK(scan.Next(&eq, &q2) && !q2 && eq == "=")
+        << "Config: expected '=' after key '" << key << "'";
+    TCHECK(scan.Next(&value, &q3)) << "Config: missing value for key '" << key << "'";
+    TCHECK(value != "=" || q3) << "Config: missing value for key '" << key << "'";
+    SetParam(key, value);
+  }
+}
+
+void Config::SetParam(const std::string& key, const std::string& value) {
+  auto it = by_key_.find(key);
+  if (it != by_key_.end() && !multi_value_) {
+    entries_[it->second].second = value;
+    return;
+  }
+  entries_.emplace_back(key, value);
+  by_key_[key] = entries_.size() - 1;
+}
+
+const std::string& Config::GetParam(const std::string& key) const {
+  auto it = by_key_.find(key);
+  if (it == by_key_.end()) {
+    TLOG(Fatal) << "Config: key '" << key << "' not found";
+  }
+  return entries_[it->second].second;
+}
+
+std::string Config::ToProtoString() const {
+  std::ostringstream os;
+  for (const auto& [key, value] : entries_) {
+    std::string escaped;
+    for (char c : value) {
+      switch (c) {
+        case '\n': escaped += "\\n"; break;
+        case '\t': escaped += "\\t"; break;
+        case '"': escaped += "\\\""; break;
+        case '\\': escaped += "\\\\"; break;
+        default: escaped += c;
+      }
+    }
+    os << key << " : \"" << escaped << "\"\n";
+  }
+  return os.str();
+}
+
+template <typename T>
+std::string Config::ToString(const T& v) {
+  std::ostringstream os;
+  os << v;
+  return os.str();
+}
+template std::string Config::ToString<int>(const int&);
+template std::string Config::ToString<double>(const double&);
+
+}  // namespace dmlctpu

@@ -1,0 +1,221 @@
+// Tests for the runtime utilities: Config parser, ThreadGroup/Timer/
+// BlockingQueueThread, lock-free MPMC queue, memory pools, adapters.
+// Mirrors reference unittest_{config,thread_group,lockfree,...}.cc coverage.
+#include <atomic>
+#include <chrono>
+#include <set>
+#include <sstream>
+#include <thread>
+#include <vector>
+
+#include "dmlctpu/adapters.h"
+#include "dmlctpu/config.h"
+#include "dmlctpu/lockfree_queue.h"
+#include "dmlctpu/memory.h"
+#include "dmlctpu/thread_group.h"
+#include "testing.h"
+
+using namespace dmlctpu;  // NOLINT
+
+TESTCASE(config_parse_basic) {
+  std::istringstream is(R"(
+# a comment
+booster = gbtree
+eta = 0.3
+max_depth=6   # trailing comment
+msg = "hello \"quoted\"\nworld"
+)");
+  Config cfg(is);
+  EXPECT_EQV(cfg.GetParam("booster"), "gbtree");
+  EXPECT_EQV(cfg.GetParam("eta"), "0.3");
+  EXPECT_EQV(cfg.GetParam("max_depth"), "6");
+  EXPECT_EQV(cfg.GetParam("msg"), "hello \"quoted\"\nworld");
+  EXPECT_TRUE(!cfg.Contains("nope"));
+  EXPECT_THROWS(cfg.GetParam("nope"));
+  std::string proto = cfg.ToProtoString();
+  EXPECT_TRUE(proto.find("booster : \"gbtree\"") != std::string::npos);
+  EXPECT_TRUE(proto.find("\\n") != std::string::npos);
+}
+
+TESTCASE(config_multi_value_and_overwrite) {
+  std::istringstream is("k = 1\nk = 2\n");
+  Config single(is);
+  EXPECT_EQV(single.GetParam("k"), "2");
+  size_t n = 0;
+  for (auto it = single.begin(); it != single.end(); ++it) ++n;
+  EXPECT_EQV(n, 1u);
+
+  std::istringstream is2("k = 1\nk = 2\n");
+  Config multi(is2, /*multi_value=*/true);
+  EXPECT_EQV(multi.GetParam("k"), "2");
+  n = 0;
+  for (auto it = multi.begin(); it != multi.end(); ++it) ++n;
+  EXPECT_EQV(n, 2u);
+  multi.SetParam("j", 42);
+  EXPECT_EQV(multi.GetParam("j"), "42");
+}
+
+TESTCASE(thread_group_lifecycle) {
+  ThreadGroup group;
+  std::atomic<int> done{0};
+  auto t = group.Create("worker", [&done](ThreadGroup::Thread& self) {
+    while (!self.stop_requested()) {
+      self.event.wait_for(std::chrono::milliseconds(5));
+    }
+    ++done;
+  });
+  EXPECT_EQV(group.Size(), 1u);
+  EXPECT_TRUE(group.Find("worker") != nullptr);
+  EXPECT_TRUE(group.Find("nope") == nullptr);
+  EXPECT_TRUE(group.Join("worker"));
+  EXPECT_EQV(done.load(), 1);
+  EXPECT_EQV(group.Size(), 0u);
+  EXPECT_TRUE(!group.Join("worker"));
+  // duplicate-name guard
+  group.Create("x", [](ThreadGroup::Thread&) {});
+  EXPECT_THROWS(group.Create("x", [](ThreadGroup::Thread&) {}));
+}
+
+TESTCASE(timer_thread_ticks) {
+  ThreadGroup group;
+  std::atomic<int> ticks{0};
+  TimerThread timer(&group, "timer", std::chrono::milliseconds(5),
+                    [&ticks] { ++ticks; });
+  std::this_thread::sleep_for(std::chrono::milliseconds(80));
+  timer.Stop();
+  group.JoinAll();
+  int got = ticks.load();
+  EXPECT_TRUE(got >= 3);
+}
+
+TESTCASE(blocking_queue_thread_drains) {
+  ThreadGroup group;
+  std::atomic<int> sum{0};
+  BlockingQueueThread<int> worker(&group, "drainer", [&sum](int v) { sum += v; });
+  for (int i = 1; i <= 100; ++i) worker.Enqueue(i);
+  while (sum.load() != 5050) std::this_thread::yield();
+  worker.SignalForKill();
+  group.JoinAll();
+  EXPECT_EQV(sum.load(), 5050);
+}
+
+TESTCASE(lockfree_queue_spsc_order) {
+  LockFreeQueue<int> q(64);
+  for (int i = 0; i < 64; ++i) EXPECT_TRUE(q.TryPush(i));
+  EXPECT_TRUE(!q.TryPush(999));  // full
+  int v;
+  for (int i = 0; i < 64; ++i) {
+    EXPECT_TRUE(q.TryPop(&v));
+    EXPECT_EQV(v, i);
+  }
+  EXPECT_TRUE(!q.TryPop(&v));  // empty
+}
+
+TESTCASE(lockfree_queue_mpmc_stress) {
+  LockFreeQueue<int> q(1024);
+  constexpr int kProducers = 4, kConsumers = 4, kPerProducer = 20000;
+  std::atomic<long> sum{0};
+  std::atomic<int> popped{0};
+  std::vector<std::thread> threads;
+  for (int p = 0; p < kProducers; ++p) {
+    threads.emplace_back([&q, p] {
+      for (int i = 0; i < kPerProducer; ++i) {
+        while (!q.TryPush(p * kPerProducer + i)) std::this_thread::yield();
+      }
+    });
+  }
+  for (int c = 0; c < kConsumers; ++c) {
+    threads.emplace_back([&] {
+      int v;
+      while (popped.load() < kProducers * kPerProducer) {
+        if (q.TryPop(&v)) {
+          sum += v;
+          ++popped;
+        } else {
+          std::this_thread::yield();
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  long n = static_cast<long>(kProducers) * kPerProducer;
+  EXPECT_EQV(sum.load(), n * (n - 1) / 2);
+}
+
+TESTCASE(blocking_lockfree_queue_kill) {
+  BlockingLockFreeQueue<int> q(16);
+  std::atomic<int> got{0};
+  std::thread consumer([&] {
+    int v;
+    while (q.Pop(&v)) ++got;
+  });
+  for (int i = 0; i < 100; ++i) q.Push(i);
+  while (got.load() < 100) std::this_thread::yield();
+  q.SignalForKill();
+  consumer.join();
+  EXPECT_EQV(got.load(), 100);
+}
+
+TESTCASE(memory_pool_reuse) {
+  struct Obj {
+    double payload[4];
+  };
+  MemoryPool<Obj> pool;
+  Obj* a = pool.allocate();
+  Obj* b = pool.allocate();
+  EXPECT_TRUE(a != b);
+  EXPECT_EQV(pool.live(), 2u);
+  pool.deallocate(b);
+  Obj* c = pool.allocate();
+  EXPECT_TRUE(c == b);  // LIFO reuse
+  pool.deallocate(a);
+  pool.deallocate(c);
+  EXPECT_EQV(pool.live(), 0u);
+  // churn across page boundaries
+  std::vector<Obj*> objs;
+  for (int i = 0; i < 1000; ++i) objs.push_back(pool.create());
+  std::set<Obj*> uniq(objs.begin(), objs.end());
+  EXPECT_EQV(uniq.size(), objs.size());
+  for (Obj* o : objs) pool.destroy(o);
+}
+
+TESTCASE(threadlocal_shared_ptr) {
+  auto p = MakeThreadlocalShared<std::pair<int, int>>(3, 4);
+  EXPECT_EQV(p->first, 3);
+  auto q = MakeThreadlocalShared<std::pair<int, int>>(5, 6);
+  p.reset();
+  auto r = MakeThreadlocalShared<std::pair<int, int>>(7, 8);
+  EXPECT_EQV(r->second, 8);
+  EXPECT_EQV(q->first, 5);
+}
+
+TESTCASE(adapters_optional_stream_and_span) {
+  optional<int> v;
+  std::istringstream is("None 42 x");
+  is >> v;
+  EXPECT_TRUE(!v.has_value());
+  is >> v;
+  EXPECT_TRUE(v.has_value());
+  EXPECT_EQV(*v, 42);
+  is >> v;
+  EXPECT_TRUE(is.fail());
+  std::ostringstream os;
+  os << optional<int>(9) << "," << optional<int>();
+  EXPECT_EQV(os.str(), "9,None");
+  std::vector<int> data{1, 2, 3};
+  array_view<int> view(data);
+  EXPECT_EQV(view.size(), 3u);
+  EXPECT_EQV(view[1], 2);
+  // thread-local store: same pointer within a thread, distinct across threads
+  int* mine = ThreadLocalStore<int>::Get();
+  *mine = 5;
+  int* theirs = nullptr;
+  std::thread t([&theirs] { theirs = ThreadLocalStore<int>::Get(); });
+  t.join();
+  EXPECT_TRUE(mine == ThreadLocalStore<int>::Get());
+  EXPECT_TRUE(mine != theirs);
+  any a = std::string("boxed");
+  EXPECT_EQV(any_cast<std::string>(a), "boxed");
+}
+
+TESTMAIN()
