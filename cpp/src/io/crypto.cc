@@ -1,0 +1,118 @@
+// SHA-256 per FIPS 180-4; HMAC per RFC 2104.
+#include "./crypto.h"
+
+#include <cstring>
+
+namespace dmlctpu {
+namespace crypto {
+namespace {
+
+constexpr uint32_t kInit[8] = {
+    0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+    0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+
+constexpr uint32_t kRound[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu, 0x59f111f1u,
+    0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u, 0x243185beu, 0x550c7dc3u,
+    0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u, 0xc19bf174u, 0xe49b69c1u, 0xefbe4786u,
+    0x0fc19dc6u, 0x240ca1ccu, 0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau,
+    0x983e5152u, 0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+    0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu, 0x53380d13u,
+    0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u, 0xa2bfe8a1u, 0xa81a664bu,
+    0xc24b8b70u, 0xc76c51a3u, 0xd192e819u, 0xd6990624u, 0xf40e3585u, 0x106aa070u,
+    0x19a4c116u, 0x1e376c08u, 0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au,
+    0x5b9cca4fu, 0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+inline uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+void Compress(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; ++i) {
+    w[i] = (uint32_t(block[4 * i]) << 24) | (uint32_t(block[4 * i + 1]) << 16) |
+           (uint32_t(block[4 * i + 2]) << 8) | uint32_t(block[4 * i + 3]);
+  }
+  for (int i = 16; i < 64; ++i) {
+    uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t S1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + kRound[i] + w[i];
+    uint32_t S0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+}  // namespace
+
+Digest SHA256(const void* data, size_t len) {
+  uint32_t state[8];
+  std::memcpy(state, kInit, sizeof(state));
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  size_t full = len / 64;
+  for (size_t i = 0; i < full; ++i) Compress(state, p + 64 * i);
+  // final padded block(s)
+  uint8_t tail[128] = {0};
+  size_t rem = len - full * 64;
+  std::memcpy(tail, p + full * 64, rem);
+  tail[rem] = 0x80;
+  size_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+  uint64_t bits = static_cast<uint64_t>(len) * 8;
+  for (int i = 0; i < 8; ++i) {
+    tail[tail_len - 1 - i] = static_cast<uint8_t>(bits >> (8 * i));
+  }
+  Compress(state, tail);
+  if (tail_len == 128) Compress(state, tail + 64);
+  Digest out;
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = static_cast<uint8_t>(state[i] >> 24);
+    out[4 * i + 1] = static_cast<uint8_t>(state[i] >> 16);
+    out[4 * i + 2] = static_cast<uint8_t>(state[i] >> 8);
+    out[4 * i + 3] = static_cast<uint8_t>(state[i]);
+  }
+  return out;
+}
+
+Digest HmacSHA256(const void* key, size_t key_len, const void* msg, size_t msg_len) {
+  uint8_t k[64] = {0};
+  if (key_len > 64) {
+    Digest kd = SHA256(key, key_len);
+    std::memcpy(k, kd.data(), kd.size());
+  } else {
+    std::memcpy(k, key, key_len);
+  }
+  std::string inner;
+  inner.resize(64 + msg_len);
+  for (int i = 0; i < 64; ++i) inner[i] = static_cast<char>(k[i] ^ 0x36);
+  std::memcpy(inner.data() + 64, msg, msg_len);
+  Digest ih = SHA256(inner.data(), inner.size());
+  uint8_t outer[64 + 32];
+  for (int i = 0; i < 64; ++i) outer[i] = k[i] ^ 0x5c;
+  std::memcpy(outer + 64, ih.data(), 32);
+  return SHA256(outer, sizeof(outer));
+}
+
+std::string Hex(const void* data, size_t len) {
+  static const char* digits = "0123456789abcdef";
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  std::string out(len * 2, '0');
+  for (size_t i = 0; i < len; ++i) {
+    out[2 * i] = digits[p[i] >> 4];
+    out[2 * i + 1] = digits[p[i] & 0xf];
+  }
+  return out;
+}
+std::string Hex(const Digest& d) { return Hex(d.data(), d.size()); }
+
+}  // namespace crypto
+}  // namespace dmlctpu

@@ -1,0 +1,35 @@
+// crypto.h — SHA-256 / HMAC-SHA256 / hex, implemented from FIPS 180-4 and
+// RFC 2104 (no OpenSSL headers in this image).  Used by the S3 SigV4 signer;
+// verified in tests against NIST and RFC 4231 vectors.
+#ifndef DMLCTPU_SRC_IO_CRYPTO_H_
+#define DMLCTPU_SRC_IO_CRYPTO_H_
+
+#include <array>
+#include <cstdint>
+#include <string>
+
+namespace dmlctpu {
+namespace crypto {
+
+using Digest = std::array<uint8_t, 32>;
+
+/*! \brief SHA-256 of a byte string */
+Digest SHA256(const void* data, size_t len);
+inline Digest SHA256(const std::string& s) { return SHA256(s.data(), s.size()); }
+
+/*! \brief HMAC-SHA256(key, message) */
+Digest HmacSHA256(const void* key, size_t key_len, const void* msg, size_t msg_len);
+inline Digest HmacSHA256(const std::string& key, const std::string& msg) {
+  return HmacSHA256(key.data(), key.size(), msg.data(), msg.size());
+}
+inline Digest HmacSHA256(const Digest& key, const std::string& msg) {
+  return HmacSHA256(key.data(), key.size(), msg.data(), msg.size());
+}
+
+/*! \brief lowercase hex encoding */
+std::string Hex(const Digest& d);
+std::string Hex(const void* data, size_t len);
+
+}  // namespace crypto
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_CRYPTO_H_

@@ -1,0 +1,212 @@
+#include "./http.h"
+
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstring>
+#include <sstream>
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace http {
+namespace {
+
+class Socket {
+ public:
+  Socket(const std::string& host, int port) {
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    int rc = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
+    TCHECK_EQ(rc, 0) << "http: cannot resolve " << host << ": " << gai_strerror(rc);
+    for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      ::close(fd_);
+      fd_ = -1;
+    }
+    ::freeaddrinfo(res);
+    TCHECK_GE(fd_, 0) << "http: cannot connect to " << host << ":" << port;
+  }
+  ~Socket() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  void SendAll(const char* data, size_t len) {
+    while (len != 0) {
+      ssize_t n = ::send(fd_, data, len, MSG_NOSIGNAL);
+      TCHECK_GT(n, 0) << "http: send failed";
+      data += n;
+      len -= static_cast<size_t>(n);
+    }
+  }
+  size_t Recv(void* buf, size_t len) {
+    ssize_t n = ::recv(fd_, buf, len, 0);
+    TCHECK_GE(n, 0) << "http: recv failed";
+    return static_cast<size_t>(n);
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+std::string BuildRequest(const std::string& host, const std::string& method,
+                         const std::string& path,
+                         const std::map<std::string, std::string>& headers,
+                         const std::string& body) {
+  std::ostringstream os;
+  os << method << " " << path << " HTTP/1.1\r\n";
+  if (headers.find("host") == headers.end() && headers.find("Host") == headers.end()) {
+    os << "Host: " << host << "\r\n";
+  }
+  for (const auto& [k, v] : headers) os << k << ": " << v << "\r\n";
+  os << "Content-Length: " << body.size() << "\r\n";
+  os << "Connection: close\r\n\r\n";
+  os << body;
+  return os.str();
+}
+
+class BodyStreamImpl : public BodyStream {
+ public:
+  BodyStreamImpl(const std::string& host, int port, const std::string& method,
+                 const std::string& path,
+                 const std::map<std::string, std::string>& headers,
+                 const std::string& body)
+      : sock_(host, port) {
+    std::string req = BuildRequest(host, method, path, headers, body);
+    sock_.SendAll(req.data(), req.size());
+    ParseHead();
+  }
+
+  int status() const override { return status_; }
+  const std::map<std::string, std::string>& headers() const override { return headers_; }
+
+  size_t Read(void* buf, size_t size) override {
+    if (chunked_) return ReadChunked(buf, size);
+    if (content_length_ >= 0 &&
+        body_read_ >= static_cast<size_t>(content_length_)) {
+      return 0;
+    }
+    // serve buffered bytes first
+    size_t n = 0;
+    if (buf_pos_ < buffer_.size()) {
+      n = std::min(size, buffer_.size() - buf_pos_);
+      std::memcpy(buf, buffer_.data() + buf_pos_, n);
+      buf_pos_ += n;
+    } else {
+      n = sock_.Recv(buf, size);
+    }
+    body_read_ += n;
+    return n;
+  }
+
+ private:
+  void ParseHead() {
+    // read until the blank line
+    std::string head;
+    char c;
+    while (head.find("\r\n\r\n") == std::string::npos) {
+      size_t n = sock_.Recv(&c, 1);
+      TCHECK_GT(n, 0u) << "http: connection closed in headers";
+      head.push_back(c);
+      TCHECK_LT(head.size(), 1u << 20u) << "http: oversized header block";
+    }
+    std::istringstream is(head);
+    std::string line;
+    std::getline(is, line);
+    // "HTTP/1.1 200 OK"
+    size_t sp = line.find(' ');
+    TCHECK_NE(sp, std::string::npos) << "http: bad status line '" << line << "'";
+    status_ = std::atoi(line.c_str() + sp + 1);
+    while (std::getline(is, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (line.empty()) continue;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      std::transform(key.begin(), key.end(), key.begin(), ::tolower);
+      size_t vstart = line.find_first_not_of(' ', colon + 1);
+      headers_[key] = vstart == std::string::npos ? "" : line.substr(vstart);
+    }
+    auto it = headers_.find("content-length");
+    if (it != headers_.end()) content_length_ = std::atoll(it->second.c_str());
+    auto te = headers_.find("transfer-encoding");
+    chunked_ = te != headers_.end() && te->second.find("chunked") != std::string::npos;
+  }
+
+  size_t ReadChunked(void* buf, size_t size) {
+    if (chunk_remaining_ == 0) {
+      if (chunks_done_) return 0;
+      std::string line = ReadLine();
+      chunk_remaining_ = std::strtoul(line.c_str(), nullptr, 16);
+      if (chunk_remaining_ == 0) {
+        chunks_done_ = true;
+        return 0;
+      }
+    }
+    size_t n = RawRead(buf, std::min(size, chunk_remaining_));
+    chunk_remaining_ -= n;
+    if (chunk_remaining_ == 0) ReadLine();  // trailing CRLF
+    return n;
+  }
+  std::string ReadLine() {
+    std::string line;
+    char c;
+    while (RawRead(&c, 1) == 1) {
+      if (c == '\n') break;
+      if (c != '\r') line.push_back(c);
+    }
+    return line;
+  }
+  size_t RawRead(void* buf, size_t size) {
+    if (buf_pos_ < buffer_.size()) {
+      size_t n = std::min(size, buffer_.size() - buf_pos_);
+      std::memcpy(buf, buffer_.data() + buf_pos_, n);
+      buf_pos_ += n;
+      return n;
+    }
+    return sock_.Recv(buf, size);
+  }
+
+  Socket sock_;
+  int status_ = 0;
+  std::map<std::string, std::string> headers_;
+  std::string buffer_;  // any body bytes read while splitting headers (none: we read 1-by-1)
+  size_t buf_pos_ = 0;
+  int64_t content_length_ = -1;
+  size_t body_read_ = 0;
+  bool chunked_ = false;
+  size_t chunk_remaining_ = 0;
+  bool chunks_done_ = false;
+};
+
+}  // namespace
+
+std::unique_ptr<BodyStream> RequestStream(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path, const std::map<std::string, std::string>& headers,
+    const std::string& body) {
+  return std::make_unique<BodyStreamImpl>(host, port, method, path, headers, body);
+}
+
+Response Request(const std::string& host, int port, const std::string& method,
+                 const std::string& path,
+                 const std::map<std::string, std::string>& headers,
+                 const std::string& body) {
+  auto stream = RequestStream(host, port, method, path, headers, body);
+  Response resp;
+  resp.status = stream->status();
+  resp.headers = stream->headers();
+  char buf[1 << 14];
+  size_t n;
+  while ((n = stream->Read(buf, sizeof(buf))) != 0) resp.body.append(buf, n);
+  return resp;
+}
+
+}  // namespace http
+}  // namespace dmlctpu

@@ -1,0 +1,45 @@
+// http.h — minimal HTTP/1.1 client over POSIX sockets (no libcurl headers in
+// this image).  One request per connection (Connection: close), streaming
+// body reads; plain TCP only — TLS endpoints need an https-terminating proxy
+// (S3_ENDPOINT), which is also how zero-egress test rigs stub S3.
+#ifndef DMLCTPU_SRC_IO_HTTP_H_
+#define DMLCTPU_SRC_IO_HTTP_H_
+
+#include <map>
+#include <memory>
+#include <string>
+
+namespace dmlctpu {
+namespace http {
+
+struct Response {
+  int status = 0;
+  std::map<std::string, std::string> headers;  // lowercased keys
+  std::string body;
+};
+
+/*! \brief open connection streaming the response body */
+class BodyStream {
+ public:
+  virtual ~BodyStream() = default;
+  virtual int status() const = 0;
+  virtual const std::map<std::string, std::string>& headers() const = 0;
+  /*! \brief read up to size body bytes; 0 at end */
+  virtual size_t Read(void* buf, size_t size) = 0;
+};
+
+/*! \brief blocking request; throws dmlctpu::Error on transport failure */
+Response Request(const std::string& host, int port, const std::string& method,
+                 const std::string& path_and_query,
+                 const std::map<std::string, std::string>& headers,
+                 const std::string& body = "");
+
+/*! \brief as Request but hands back a stream over the response body */
+std::unique_ptr<BodyStream> RequestStream(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path_and_query,
+    const std::map<std::string, std::string>& headers, const std::string& body = "");
+
+}  // namespace http
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_HTTP_H_

@@ -1,0 +1,497 @@
+#include "./s3_filesys.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <sstream>
+
+#include "./crypto.h"
+#include "./http.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/parameter.h"
+
+namespace dmlctpu {
+namespace io {
+
+// ---- SigV4 ------------------------------------------------------------------
+
+std::string SigV4::UriEncode(const std::string& s, bool encode_slash) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  for (unsigned char c : s) {
+    if ((c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') ||
+        c == '-' || c == '_' || c == '.' || c == '~' || (c == '/' && !encode_slash)) {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 0xf]);
+    }
+  }
+  return out;
+}
+
+std::string SigV4::CanonicalQuery(const std::map<std::string, std::string>& query) {
+  std::string out;
+  for (const auto& [k, v] : query) {  // std::map is already sorted
+    if (!out.empty()) out.push_back('&');
+    out += UriEncode(k, true) + "=" + UriEncode(v, true);
+  }
+  return out;
+}
+
+SigV4::Signed SigV4::Sign(const std::string& method, const std::string& host,
+                          const std::string& path,
+                          const std::map<std::string, std::string>& query,
+                          std::map<std::string, std::string> headers,
+                          const std::string& payload_hash,
+                          const std::string& amz_date) const {
+  headers["host"] = host;
+  headers["x-amz-date"] = amz_date;
+  headers["x-amz-content-sha256"] = payload_hash;
+  if (!session_token.empty()) headers["x-amz-security-token"] = session_token;
+
+  // canonical headers: lowercase keys, sorted, trimmed values
+  std::map<std::string, std::string> canon;
+  for (const auto& [k, v] : headers) {
+    std::string key = k;
+    std::transform(key.begin(), key.end(), key.begin(), ::tolower);
+    std::string val = v;
+    while (!val.empty() && val.front() == ' ') val.erase(val.begin());
+    while (!val.empty() && val.back() == ' ') val.pop_back();
+    canon[key] = val;
+  }
+  std::string canonical_headers, signed_headers;
+  for (const auto& [k, v] : canon) {
+    canonical_headers += k + ":" + v + "\n";
+    if (!signed_headers.empty()) signed_headers += ";";
+    signed_headers += k;
+  }
+
+  Signed result;
+  result.canonical_request = method + "\n" + UriEncode(path, false) + "\n" +
+                             CanonicalQuery(query) + "\n" + canonical_headers + "\n" +
+                             signed_headers + "\n" + payload_hash;
+  std::string date = amz_date.substr(0, 8);
+  std::string scope = date + "/" + region + "/" + service + "/aws4_request";
+  result.string_to_sign = "AWS4-HMAC-SHA256\n" + amz_date + "\n" + scope + "\n" +
+                          crypto::Hex(crypto::SHA256(result.canonical_request));
+  auto k_date = crypto::HmacSHA256("AWS4" + secret_key, date);
+  auto k_region = crypto::HmacSHA256(k_date, region);
+  auto k_service = crypto::HmacSHA256(k_region, service);
+  auto k_signing = crypto::HmacSHA256(k_service, "aws4_request");
+  result.signature = crypto::Hex(crypto::HmacSHA256(k_signing, result.string_to_sign));
+  headers["Authorization"] =
+      "AWS4-HMAC-SHA256 Credential=" + access_key + "/" + scope +
+      ", SignedHeaders=" + signed_headers + ", Signature=" + result.signature;
+  result.headers = std::move(headers);
+  return result;
+}
+
+// ---- S3FileSystem -----------------------------------------------------------
+
+namespace {
+
+std::string NowAmzDate() {
+  std::time_t now = std::time(nullptr);
+  std::tm tm_buf;
+  gmtime_r(&now, &tm_buf);
+  char buf[20];
+  std::strftime(buf, sizeof(buf), "%Y%m%dT%H%M%SZ", &tm_buf);
+  return buf;
+}
+
+constexpr const char* kUnsignedPayload = "UNSIGNED-PAYLOAD";
+
+/*! \brief tiny forward-only XML scanner: finds <tag>text</tag> spans */
+class XMLScan {
+ public:
+  explicit XMLScan(const std::string& text) : text_(text) {}
+  /*! \brief next occurrence of <tag>..</tag> after the cursor */
+  bool Next(const std::string& tag, std::string* content) {
+    std::string open = "<" + tag + ">";
+    std::string close = "</" + tag + ">";
+    size_t b = text_.find(open, pos_);
+    if (b == std::string::npos) return false;
+    b += open.size();
+    size_t e = text_.find(close, b);
+    if (e == std::string::npos) return false;
+    *content = text_.substr(b, e - b);
+    pos_ = e + close.size();
+    return true;
+  }
+  /*! \brief bounds of the next <tag>...</tag> block without consuming inner tags */
+  bool NextBlock(const std::string& tag, std::string* content) { return Next(tag, content); }
+  void Rewind() { pos_ = 0; }
+
+ private:
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+std::string XmlUnescape(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size();) {
+    if (s[i] == '&') {
+      if (s.compare(i, 5, "&amp;") == 0) { out += '&'; i += 5; continue; }
+      if (s.compare(i, 4, "&lt;") == 0) { out += '<'; i += 4; continue; }
+      if (s.compare(i, 4, "&gt;") == 0) { out += '>'; i += 4; continue; }
+      if (s.compare(i, 6, "&quot;") == 0) { out += '"'; i += 6; continue; }
+      if (s.compare(i, 6, "&apos;") == 0) { out += '\''; i += 6; continue; }
+    }
+    out += s[i++];
+  }
+  return out;
+}
+
+}  // namespace
+
+S3FileSystem::S3FileSystem() {
+  signer_.access_key = GetEnv("S3_ACCESS_KEY_ID",
+                              GetEnv("AWS_ACCESS_KEY_ID", "").c_str());
+  signer_.secret_key = GetEnv("S3_SECRET_ACCESS_KEY",
+                              GetEnv("AWS_SECRET_ACCESS_KEY", "").c_str());
+  signer_.session_token = GetEnv("AWS_SESSION_TOKEN", "");
+  signer_.region = GetEnv("S3_REGION", GetEnv("AWS_REGION", "us-east-1").c_str());
+  endpoint_env_ = GetEnv("S3_ENDPOINT", "");
+}
+
+S3FileSystem* S3FileSystem::GetInstance() {
+  static S3FileSystem inst;
+  return &inst;
+}
+
+S3FileSystem::Endpoint S3FileSystem::ResolveEndpoint(const std::string& bucket) const {
+  Endpoint ep;
+  std::string raw = endpoint_env_;
+  if (raw.empty()) {
+    TLOG(Fatal) << "S3: this build speaks plain http only (no TLS library in the "
+                   "image); set S3_ENDPOINT=http://host[:port] (minio/localstack/"
+                   "TLS-terminating proxy) — bucket " << bucket;
+  }
+  if (raw.rfind("https://", 0) == 0) {
+    TLOG(Fatal) << "S3: https endpoints are not supported in this build; "
+                   "use an http:// S3_ENDPOINT or a TLS-terminating proxy";
+  }
+  if (raw.rfind("http://", 0) == 0) raw = raw.substr(7);
+  size_t colon = raw.find(':');
+  if (colon == std::string::npos) {
+    ep.host = raw;
+  } else {
+    ep.host = raw.substr(0, colon);
+    ep.port = std::atoi(raw.c_str() + colon + 1);
+  }
+  ep.path_style = true;
+  return ep;
+}
+
+void S3FileSystem::ParseListObjects(const std::string& xml,
+                                    const std::string& bucket_proto,
+                                    std::vector<FileInfo>* files,
+                                    std::vector<std::string>* common_prefixes) {
+  // <Contents><Key>..</Key>..<Size>..</Size></Contents> and
+  // <CommonPrefixes><Prefix>..</Prefix></CommonPrefixes>
+  XMLScan scan(xml);
+  std::string block;
+  while (scan.Next("Contents", &block)) {
+    XMLScan inner(block);
+    std::string key, size_str;
+    if (!inner.Next("Key", &key)) continue;
+    inner.Rewind();
+    inner.Next("Size", &size_str);
+    FileInfo info;
+    info.path = URI(bucket_proto + XmlUnescape(key));
+    info.size = static_cast<size_t>(std::atoll(size_str.c_str()));
+    info.type = (!key.empty() && key.back() == '/') ? FileType::kDirectory
+                                                    : FileType::kFile;
+    files->push_back(info);
+  }
+  scan.Rewind();
+  while (scan.Next("CommonPrefixes", &block)) {
+    XMLScan inner(block);
+    std::string prefix;
+    if (inner.Next("Prefix", &prefix)) {
+      common_prefixes->push_back(XmlUnescape(prefix));
+    }
+  }
+}
+
+void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
+  Endpoint ep = ResolveEndpoint(path.host);
+  std::string prefix = path.name.empty() ? "" : path.name.substr(1);  // drop leading /
+  if (!prefix.empty() && prefix.back() != '/') prefix += '/';
+  std::map<std::string, std::string> query{{"prefix", prefix}, {"delimiter", "/"}};
+  std::string req_path = "/" + path.host;  // path-style: /bucket
+  auto signed_req = signer_.Sign("GET", ep.host, req_path, query, {},
+                                 kUnsignedPayload, NowAmzDate());
+  std::string full = req_path + "?" + SigV4::CanonicalQuery(query);
+  http::Response resp = http::Request(ep.host, ep.port, "GET", full, signed_req.headers);
+  TCHECK_EQ(resp.status, 200) << "S3 ListObjects failed (" << resp.status << "): "
+                              << resp.body.substr(0, 256);
+  std::vector<std::string> prefixes;
+  std::string proto = path.protocol + path.host + "/";
+  ParseListObjects(resp.body, proto, out, &prefixes);
+  for (const std::string& p : prefixes) {
+    FileInfo info;
+    info.path = URI(proto + p);
+    info.type = FileType::kDirectory;
+    out->push_back(info);
+  }
+}
+
+FileInfo S3FileSystem::GetPathInfo(const URI& path) {
+  // exact-key lookup via a prefix list (the reference does the same)
+  Endpoint ep = ResolveEndpoint(path.host);
+  std::string key = path.name.empty() ? "" : path.name.substr(1);
+  std::map<std::string, std::string> query{{"prefix", key}, {"max-keys", "2"}};
+  std::string req_path = "/" + path.host;
+  auto signed_req = signer_.Sign("GET", ep.host, req_path, query, {},
+                                 kUnsignedPayload, NowAmzDate());
+  std::string full = req_path + "?" + SigV4::CanonicalQuery(query);
+  http::Response resp = http::Request(ep.host, ep.port, "GET", full, signed_req.headers);
+  TCHECK_EQ(resp.status, 200) << "S3 list failed (" << resp.status << ")";
+  std::vector<FileInfo> files;
+  std::vector<std::string> prefixes;
+  ParseListObjects(resp.body, path.protocol + path.host + "/", &files, &prefixes);
+  for (const FileInfo& f : files) {
+    if (f.path.name == path.name) return f;
+    if (f.path.name == path.name + "/") {
+      FileInfo dir = f;
+      dir.type = FileType::kDirectory;
+      return dir;
+    }
+  }
+  TLOG(Fatal) << "S3: no such object " << path.str();
+  return {};
+}
+
+namespace {
+
+/*! \brief ranged-GET seekable read stream with per-request retry */
+class S3ReadStream : public SeekStream {
+ public:
+  S3ReadStream(S3FileSystem::Endpoint ep, const SigV4* signer, std::string req_path,
+               size_t total_size)
+      : ep_(std::move(ep)), signer_(signer), req_path_(std::move(req_path)),
+        size_(total_size) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    if (pos_ >= size_) return 0;
+    if (body_ == nullptr) OpenAt(pos_);
+    size_t n = body_->Read(ptr, size);
+    if (n == 0 && pos_ < size_) {
+      // connection dropped mid-range: reopen at the current position
+      OpenAt(pos_);
+      n = body_->Read(ptr, size);
+    }
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void*, size_t) override {
+    TLOG(Fatal) << "S3ReadStream is read-only";
+    return 0;
+  }
+  void Seek(size_t pos) override {
+    if (pos != pos_) {
+      pos_ = pos;
+      body_.reset();
+    }
+  }
+  size_t Tell() override { return pos_; }
+  bool AtEnd() override { return pos_ >= size_; }
+
+ private:
+  void OpenAt(size_t offset) {
+    std::map<std::string, std::string> headers{
+        {"range", "bytes=" + std::to_string(offset) + "-"}};
+    auto signed_req = signer_->Sign("GET", ep_.host, req_path_, {}, headers,
+                                    kUnsignedPayload, NowAmzDate());
+    body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
+                                signed_req.headers);
+    TCHECK(body_->status() == 200 || body_->status() == 206)
+        << "S3 GET " << req_path_ << " failed (" << body_->status() << ")";
+  }
+
+  S3FileSystem::Endpoint ep_;
+  const SigV4* signer_;
+  std::string req_path_;
+  size_t size_;
+  size_t pos_ = 0;
+  std::unique_ptr<http::BodyStream> body_;
+};
+
+/*! \brief buffered write stream: multipart upload above the part threshold */
+class S3WriteStream : public Stream {
+ public:
+  S3WriteStream(S3FileSystem::Endpoint ep, const SigV4* signer, std::string req_path)
+      : ep_(std::move(ep)), signer_(signer), req_path_(std::move(req_path)) {
+    part_bytes_ = static_cast<size_t>(
+        GetEnv("DMLC_S3_WRITE_BUFFER_MB", 64)) << 20;
+  }
+  ~S3WriteStream() override { Finish(); }
+
+  size_t Read(void*, size_t) override {
+    TLOG(Fatal) << "S3WriteStream is write-only";
+    return 0;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    buffer_.append(static_cast<const char*>(ptr), size);
+    if (buffer_.size() >= part_bytes_) FlushPart();
+    return size;
+  }
+
+ private:
+  void FlushPart() {
+    if (upload_id_.empty()) InitiateMultipart();
+    ++part_number_;
+    std::map<std::string, std::string> query{
+        {"partNumber", std::to_string(part_number_)}, {"uploadId", upload_id_}};
+    std::string payload_hash = crypto::Hex(crypto::SHA256(buffer_));
+    auto signed_req = signer_->Sign("PUT", ep_.host, req_path_, query, {},
+                                    payload_hash, NowAmzDate());
+    std::string full = req_path_ + "?" + SigV4::CanonicalQuery(query);
+    http::Response resp =
+        http::Request(ep_.host, ep_.port, "PUT", full, signed_req.headers, buffer_);
+    TCHECK_EQ(resp.status, 200) << "S3 UploadPart failed (" << resp.status << ")";
+    auto it = resp.headers.find("etag");
+    etags_.push_back(it == resp.headers.end() ? "" : it->second);
+    buffer_.clear();
+  }
+  void InitiateMultipart() {
+    std::map<std::string, std::string> query{{"uploads", ""}};
+    auto signed_req = signer_->Sign("POST", ep_.host, req_path_, query, {},
+                                    kUnsignedPayload, NowAmzDate());
+    http::Response resp = http::Request(ep_.host, ep_.port, "POST",
+                                        req_path_ + "?uploads=", signed_req.headers);
+    TCHECK_EQ(resp.status, 200) << "S3 InitiateMultipartUpload failed ("
+                                << resp.status << ")";
+    XMLScan scan(resp.body);
+    TCHECK(scan.Next("UploadId", &upload_id_)) << "S3: no UploadId in response";
+  }
+  void Finish() {
+    if (finished_) return;
+    finished_ = true;
+    if (upload_id_.empty()) {
+      // small object: single PUT
+      std::string payload_hash = crypto::Hex(crypto::SHA256(buffer_));
+      auto signed_req = signer_->Sign("PUT", ep_.host, req_path_, {}, {},
+                                      payload_hash, NowAmzDate());
+      http::Response resp = http::Request(ep_.host, ep_.port, "PUT", req_path_,
+                                          signed_req.headers, buffer_);
+      TCHECK(resp.status == 200) << "S3 PUT failed (" << resp.status << ")";
+      return;
+    }
+    if (!buffer_.empty()) FlushPart();
+    std::ostringstream xml;
+    xml << "<CompleteMultipartUpload>";
+    for (size_t i = 0; i < etags_.size(); ++i) {
+      xml << "<Part><PartNumber>" << (i + 1) << "</PartNumber><ETag>" << etags_[i]
+          << "</ETag></Part>";
+    }
+    xml << "</CompleteMultipartUpload>";
+    std::map<std::string, std::string> query{{"uploadId", upload_id_}};
+    std::string body = xml.str();
+    auto signed_req = signer_->Sign("POST", ep_.host, req_path_, query, {},
+                                    crypto::Hex(crypto::SHA256(body)), NowAmzDate());
+    std::string full = req_path_ + "?" + SigV4::CanonicalQuery(query);
+    http::Response resp =
+        http::Request(ep_.host, ep_.port, "POST", full, signed_req.headers, body);
+    TCHECK_EQ(resp.status, 200) << "S3 CompleteMultipartUpload failed ("
+                                << resp.status << ")";
+  }
+
+  S3FileSystem::Endpoint ep_;
+  const SigV4* signer_;
+  std::string req_path_;
+  std::string buffer_;
+  size_t part_bytes_;
+  std::string upload_id_;
+  int part_number_ = 0;
+  std::vector<std::string> etags_;
+  bool finished_ = false;
+};
+
+}  // namespace
+
+std::unique_ptr<SeekStream> S3FileSystem::OpenForRead(const URI& path, bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    Endpoint ep = ResolveEndpoint(path.host);
+    return std::make_unique<S3ReadStream>(ep, &signer_, "/" + path.host + path.name,
+                                          info.size);
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+std::unique_ptr<Stream> S3FileSystem::Open(const URI& path, const char* mode,
+                                           bool allow_null) {
+  std::string m(mode);
+  if (m.find('r') != std::string::npos) return OpenForRead(path, allow_null);
+  TCHECK(m.find('w') != std::string::npos) << "S3: unsupported mode " << mode;
+  Endpoint ep = ResolveEndpoint(path.host);
+  return std::make_unique<S3WriteStream>(ep, &signer_, "/" + path.host + path.name);
+}
+
+// ---- plain-http read-only backend ------------------------------------------
+
+HttpFileSystem* HttpFileSystem::GetInstance() {
+  static HttpFileSystem inst;
+  return &inst;
+}
+
+FileInfo HttpFileSystem::GetPathInfo(const URI& path) {
+  http::Response resp = http::Request(path.host, 80, "HEAD", path.name, {});
+  TCHECK_LT(resp.status, 400) << "HTTP HEAD " << path.str() << " -> " << resp.status;
+  FileInfo info;
+  info.path = path;
+  auto it = resp.headers.find("content-length");
+  info.size = it == resp.headers.end() ? 0 : std::atoll(it->second.c_str());
+  return info;
+}
+
+void HttpFileSystem::ListDirectory(const URI&, std::vector<FileInfo>*) {
+  TLOG(Fatal) << "http:// URIs cannot be listed";
+}
+
+std::unique_ptr<SeekStream> HttpFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    // reuse the S3 read stream machinery without signing via a null signer
+    static SigV4 anonymous;  // empty credentials → unsigned headers still fine for GET
+    S3FileSystem::Endpoint ep;
+    ep.host = path.host;
+    ep.port = 80;
+    return std::make_unique<S3ReadStream>(ep, &anonymous, path.name, info.size);
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+std::unique_ptr<Stream> HttpFileSystem::Open(const URI& path, const char* mode,
+                                             bool allow_null) {
+  TCHECK_EQ(std::string(mode).find('w'), std::string::npos)
+      << "http:// URIs are read-only";
+  return OpenForRead(path, allow_null);
+}
+
+// ---- backend registration ---------------------------------------------------
+namespace {
+struct RegisterRemoteBackends {
+  RegisterRemoteBackends() {
+    FileSystem::RegisterBackend("s3://", [] {
+      return static_cast<FileSystem*>(S3FileSystem::GetInstance());
+    });
+    FileSystem::RegisterBackend("http://", [] {
+      return static_cast<FileSystem*>(HttpFileSystem::GetInstance());
+    });
+  }
+};
+RegisterRemoteBackends register_remote_backends_;
+}  // namespace
+
+}  // namespace io
+}  // namespace dmlctpu
