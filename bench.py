@@ -89,15 +89,31 @@ def run_reference(exe: Path, data: Path) -> float | None:
 
 
 def pick_backend():
-    """Prefer the TPU backend; fall back to CPU if its init fails."""
+    """Prefer the TPU backend; fall back to CPU if init fails or stalls.
+
+    The TPU plugin can hang for minutes when the hardware tunnel is down, so
+    availability is probed in a killable subprocess first.
+    """
     import jax
-    try:
-        devs = jax.devices()
-        return jax, devs[0].platform
-    except RuntimeError as e:
-        log(f"[bench] TPU backend unavailable ({e}); falling back to CPU")
+
+    probe_timeout = int(os.environ.get("DMLCTPU_TPU_PROBE_TIMEOUT", "240"))
+    want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
+    tpu_ok = False
+    if want_tpu:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout)
+            tpu_ok = probe.returncode == 0 and "cpu" not in probe.stdout
+            if not tpu_ok:
+                log(f"[bench] TPU probe failed: {probe.stderr.strip()[-200:]}")
+        except subprocess.TimeoutExpired:
+            log(f"[bench] TPU probe timed out after {probe_timeout}s")
+    if not tpu_ok:
+        log("[bench] falling back to CPU backend")
         jax.config.update("jax_platforms", "cpu")
-        return jax, jax.devices()[0].platform
+    return jax, jax.devices()[0].platform
 
 
 def run_ours(data: Path) -> dict:

@@ -15,6 +15,12 @@
 
 namespace dmlctpu {
 
+namespace io {
+/*! \brief whether background pipeline threads are enabled on this host
+ *  (false on single-core boxes; override via DMLCTPU_PIPELINE_THREADS) */
+bool UsePipelineThreads();
+}  // namespace io
+
 class InputSplit {
  public:
   /*! \brief a view into memory owned by the split */

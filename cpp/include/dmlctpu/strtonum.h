@@ -25,6 +25,55 @@ inline bool IsBlankOrEnd(const char* p, const char* end) {
   return p == end || *p == '\0' || IsSpaceChar(*p);
 }
 
+namespace detail {
+/*!
+ * \brief fast float path for the short decimal forms that dominate ML text
+ *        data ("1", "0.5", "-3.25"): accumulate into a double (exact for
+ *        <= 15 significant digits) and scale by a table power of ten.
+ *        Long mantissas / exponent forms / inf / nan fall back to the
+ *        correctly-rounded std::from_chars.
+ */
+template <typename T>
+inline bool FastParseFloat(const char** p, const char* end, T* out) {
+  const char* s = *p;
+  bool neg = false;
+  if (s != end && (*s == '-' || *s == '+')) {
+    neg = (*s == '-');
+    ++s;
+  }
+  uint64_t mantissa = 0;
+  int digits = 0;
+  const char* int_start = s;
+  while (s != end && IsDigitChar(*s)) {
+    mantissa = mantissa * 10 + static_cast<uint64_t>(*s - '0');
+    ++digits;
+    ++s;
+  }
+  int frac_digits = 0;
+  if (s != end && *s == '.') {
+    ++s;
+    while (s != end && IsDigitChar(*s)) {
+      mantissa = mantissa * 10 + static_cast<uint64_t>(*s - '0');
+      ++digits;
+      ++frac_digits;
+      ++s;
+    }
+  }
+  if (digits == 0 || digits > 15 ||
+      (s != end && (*s == 'e' || *s == 'E' || *s == 'i' || *s == 'I' ||
+                    *s == 'n' || *s == 'N' || *s == 'x'))) {
+    (void)int_start;
+    return false;  // defer to from_chars
+  }
+  static constexpr double kPow10[16] = {1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7,
+                                        1e8, 1e9, 1e10, 1e11, 1e12, 1e13, 1e14, 1e15};
+  double v = static_cast<double>(mantissa) / kPow10[frac_digits];
+  *out = static_cast<T>(neg ? -v : v);
+  *p = s;
+  return true;
+}
+}  // namespace detail
+
 /*!
  * \brief parse one number of type T from [p, end), skipping leading spaces.
  * \param p     cursor; advanced past the parsed token on success.
@@ -39,16 +88,44 @@ inline bool TryParseNum(const char** p, const char* end, T* out) {
   if (s == end) return false;
   std::from_chars_result r;
   if constexpr (std::is_floating_point_v<T>) {
+    const char* fast = s;
+    if (detail::FastParseFloat(&fast, end, out)) {
+      *p = fast;
+      return true;
+    }
     // from_chars does not accept a leading '+'
     if (*s == '+') ++s;
     r = std::from_chars(s, end, *out);
     if (r.ec == std::errc()) {
-      // accept "inf"/"nan" handled by from_chars already
+      // "inf"/"nan" handled by from_chars
       *p = r.ptr;
       return true;
     }
     return false;
   } else {
+    // fast digit-loop path for short integers (feature ids, counts)
+    const char* q = s;
+    bool neg = false;
+    if constexpr (std::is_signed_v<T>) {
+      if (q != end && (*q == '-' || *q == '+')) {
+        neg = (*q == '-');
+        ++q;
+      }
+    } else {
+      if (q != end && *q == '+') ++q;
+    }
+    uint64_t acc = 0;
+    int digits = 0;
+    while (q != end && IsDigitChar(*q) && digits < 18) {
+      acc = acc * 10 + static_cast<uint64_t>(*q - '0');
+      ++digits;
+      ++q;
+    }
+    if (digits > 0 && (q == end || !IsDigitChar(*q))) {
+      *out = neg ? static_cast<T>(-static_cast<int64_t>(acc)) : static_cast<T>(acc);
+      *p = q;
+      return true;
+    }
     if (*s == '+') ++s;
     r = std::from_chars(s, end, *out);
     if (r.ec != std::errc()) return false;

@@ -35,7 +35,19 @@ Parser<IndexType, DType>* CreateTextParser(const std::string& path,
                                            const std::map<std::string, std::string>& args,
                                            unsigned part, unsigned num_parts) {
   auto source = InputSplit::Create(path.c_str(), part, num_parts, "text");
-  auto base = std::make_unique<ParserCls<IndexType, DType>>(std::move(source), args, 2);
+  // parse threads from the ?nthread= URI arg; default 2 like the reference
+  int nthread = 2;
+  auto it = args.find("nthread");
+  std::map<std::string, std::string> parser_args = args;
+  if (it != args.end()) {
+    nthread = std::atoi(it->second.c_str());
+    parser_args.erase("nthread");
+  }
+  auto base = std::make_unique<ParserCls<IndexType, DType>>(std::move(source),
+                                                            parser_args, nthread);
+  if (!io::UsePipelineThreads()) {
+    return base.release();  // single-core: skip the parse-ahead stage too
+  }
   return new ThreadedParser<IndexType, DType>(std::move(base));
 }
 

@@ -5,6 +5,8 @@
 #include <cstring>
 #include <memory>
 
+#include <thread>
+
 #include "./cached_split.h"
 #include "./indexed_recordio_split.h"
 #include "./line_split.h"
@@ -13,8 +15,23 @@
 #include "./threaded_split.h"
 #include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
+#include "dmlctpu/parameter.h"
 
 namespace dmlctpu {
+
+namespace io {
+/*! \brief whether background pipeline threads help on this host: on a
+ *  single-core box the prefetch thread only adds handoff latency
+ *  (override with DMLCTPU_PIPELINE_THREADS=0/1) */
+bool UsePipelineThreads() {
+  static const bool use = [] {
+    int v = GetEnv("DMLCTPU_PIPELINE_THREADS", -1);
+    if (v >= 0) return v != 0;
+    return std::thread::hardware_concurrency() > 1;
+  }();
+  return use;
+}
+}  // namespace io
 
 std::unique_ptr<InputSplit> InputSplit::Create(const char* uri, unsigned part,
                                                unsigned num_parts, const char* type) {
@@ -50,10 +67,13 @@ std::unique_ptr<InputSplit> InputSplit::Create(const char* uri, const char* inde
     TLOG(Fatal) << "unknown input split type '" << type
                 << "' (expected text|recordio|indexed_recordio)";
   }
-  if (spec.cache_file.empty()) {
+  if (!spec.cache_file.empty()) {
+    return std::make_unique<io::CachedInputSplit>(std::move(split), spec.cache_file.c_str());
+  }
+  if (io::UsePipelineThreads()) {
     return std::make_unique<io::ThreadedInputSplit>(std::move(split), batch_size);
   }
-  return std::make_unique<io::CachedInputSplit>(std::move(split), spec.cache_file.c_str());
+  return split;  // single-core: the prefetch thread would only add handoffs
 }
 
 }  // namespace dmlctpu

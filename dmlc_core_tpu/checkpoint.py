@@ -1,0 +1,69 @@
+"""Checkpoint / resume over the dmlc stream substrate.
+
+Parity: the reference's checkpoint story is the `Serializable` interface +
+endian-stable Stream::Write/Read over any filesystem (SURVEY.md §5).  Here
+the same substrate carries JAX pytrees: leaves are serialized as a RecordIO
+container (one record of JSON metadata, then one record per leaf's raw
+bytes) written through the native Stream — so `save(params, "s3://...")`
+works against any registered filesystem backend, and the format is
+splittable/seekable like every other .rec artifact.
+
+For sharded arrays this gathers to host (process 0) — fine for the model
+sizes this framework targets (sparse linear/FM); orbax remains the right
+tool for giant sharded checkpoints.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+import jax
+
+from .io import RecordIOReader, RecordIOWriter
+
+_FORMAT_VERSION = 1
+
+
+def save(pytree: Any, uri: str) -> int:
+    """Write a pytree checkpoint; returns the number of array leaves."""
+    leaves, treedef = jax.tree.flatten(pytree)
+    host_leaves = [np.asarray(leaf) for leaf in leaves]
+    meta = {
+        "version": _FORMAT_VERSION,
+        "treedef": str(treedef),
+        "leaves": [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                   for a in host_leaves],
+    }
+    with RecordIOWriter(uri) as writer:
+        writer.write(json.dumps(meta).encode())
+        for arr in host_leaves:
+            writer.write(np.ascontiguousarray(arr).tobytes())
+    return len(host_leaves)
+
+
+def load(uri: str, like: Any = None):
+    """Read a checkpoint; `like` (an example pytree) restores the structure.
+
+    Without `like`, returns the flat list of numpy arrays plus the metadata
+    dict (the treedef string is informational only).
+    """
+    with RecordIOReader(uri) as reader:
+        records = iter(reader)
+        meta = json.loads(next(records).decode())
+        if meta.get("version") != _FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {meta.get('version')}")
+        arrays = []
+        for spec, payload in zip(meta["leaves"], records):
+            arr = np.frombuffer(payload, dtype=np.dtype(spec["dtype"]))
+            arrays.append(arr.reshape(spec["shape"]).copy())
+    if len(arrays) != len(meta["leaves"]):
+        raise ValueError("checkpoint truncated: leaf count mismatch")
+    if like is None:
+        return arrays, meta
+    leaves, treedef = jax.tree.flatten(like)
+    if len(leaves) != len(arrays):
+        raise ValueError(
+            f"checkpoint has {len(arrays)} leaves, template has {len(leaves)}")
+    return jax.tree.unflatten(treedef, [jax.numpy.asarray(a) for a in arrays])

@@ -10,6 +10,9 @@ _BACKENDS = {
     "mpi": ".mpi",
     "sge": ".sge",
     "slurm": ".slurm",
+    "yarn": ".yarn",
+    "mesos": ".mesos",
+    "kubernetes": ".kubernetes",
 }
 
 

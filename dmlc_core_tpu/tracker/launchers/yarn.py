@@ -1,0 +1,52 @@
+"""YARN launcher.
+
+Parity: reference tracker/dmlc_tracker/yarn.py + the Java ApplicationMaster
+(tracker/yarn/).  This build keeps the Python control flow — tracker start,
+env contract, `yarn jar` submission — but does not ship a Java AM; it drives
+YARN's distributed-shell AM with the DMLC_* env exported per container,
+which covers the rank bootstrap (workers rendezvous through the tracker, so
+container placement does not need a custom AM).  Requires `yarn` on PATH.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import subprocess
+
+from ..submit import submit
+
+LOGGER = logging.getLogger("dmlc_tpu.yarn")
+
+
+def run(args) -> None:
+    if shutil.which("yarn") is None:
+        raise SystemExit("--cluster=yarn requires the yarn CLI on PATH")
+
+    def spawn_all(num_workers: int, num_servers: int, envs: dict) -> None:
+        def launch(role: str, n: int) -> None:
+            if n == 0:
+                return
+            pairs = dict(envs)
+            pairs.update(args.extra_env)
+            pairs.update({"DMLC_ROLE": role, "DMLC_JOB_CLUSTER": "yarn"})
+            shell_env = ",".join(f"{k}={v}" for k, v in pairs.items())
+            cmd = [
+                "yarn", "jar",
+                os.environ.get("HADOOP_YARN_DS_JAR", "distributedshell.jar"),
+                "-jar", os.environ.get("HADOOP_YARN_DS_JAR", "distributedshell.jar"),
+                "-num_containers", str(n),
+                "-container_memory", str(args.worker_memory_mb),
+                "-container_vcores", str(args.worker_cores),
+                "-shell_env", shell_env,
+                "-shell_command", " ".join(args.command),
+            ]
+            LOGGER.info("yarn submit: %s", " ".join(cmd))
+            subprocess.Popen(cmd)
+
+        launch("server", num_servers)
+        launch("worker", num_workers)
+
+    tracker = submit(args.num_workers, args.num_servers, spawn_all,
+                     host_ip=args.host_ip, extra_envs=args.extra_env)
+    tracker.join()

@@ -11,7 +11,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="Submit a distributed DMLC job (TPU-native build).")
     parser.add_argument(
         "--cluster", "-c",
-        choices=["local", "ssh", "tpu", "mpi", "sge", "slurm"],
+        choices=["local", "ssh", "tpu", "mpi", "sge", "slurm", "yarn", "mesos",
+                 "kubernetes"],
         default=os.environ.get("DMLC_SUBMIT_CLUSTER", "local"),
         help="cluster backend (env fallback DMLC_SUBMIT_CLUSTER)")
     parser.add_argument("--num-workers", "-n", type=int, required=True,
