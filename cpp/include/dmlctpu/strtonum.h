@@ -75,16 +75,18 @@ inline bool FastParseFloat(const char** p, const char* end, T* out) {
 }  // namespace detail
 
 /*!
- * \brief parse one number of type T from [p, end), skipping leading spaces.
+ * \brief parse one number of type T starting exactly at *p (no whitespace
+ *        skipping) — the single-pass parser hot path, where the caller has
+ *        already positioned the cursor and newlines are line terminators
+ *        that must NOT be consumed.
  * \param p     cursor; advanced past the parsed token on success.
- * \param end   exclusive end of the buffer (use p + strlen(p) for C strings).
+ * \param end   exclusive end of the buffer.
  * \param out   parsed value.
  * \return true on success.
  */
 template <typename T>
-inline bool TryParseNum(const char** p, const char* end, T* out) {
+inline bool TryParseNumToken(const char** p, const char* end, T* out) {
   const char* s = *p;
-  while (s != end && IsSpaceChar(*s)) ++s;
   if (s == end) return false;
   std::from_chars_result r;
   if constexpr (std::is_floating_point_v<T>) {
@@ -122,7 +124,18 @@ inline bool TryParseNum(const char** p, const char* end, T* out) {
       ++q;
     }
     if (digits > 0 && (q == end || !IsDigitChar(*q))) {
-      *out = neg ? static_cast<T>(-static_cast<int64_t>(acc)) : static_cast<T>(acc);
+      // range check: out-of-range must fail (like from_chars), not wrap
+      if constexpr (std::is_signed_v<T>) {
+        const uint64_t lim = neg
+            ? static_cast<uint64_t>(std::numeric_limits<T>::max()) + 1
+            : static_cast<uint64_t>(std::numeric_limits<T>::max());
+        if (acc > lim) return false;
+        *out = neg ? static_cast<T>(-static_cast<int64_t>(acc)) : static_cast<T>(acc);
+      } else {
+        if (neg && acc != 0) return false;
+        if (acc > static_cast<uint64_t>(std::numeric_limits<T>::max())) return false;
+        *out = static_cast<T>(acc);
+      }
       *p = q;
       return true;
     }
@@ -132,6 +145,20 @@ inline bool TryParseNum(const char** p, const char* end, T* out) {
     *p = r.ptr;
     return true;
   }
+}
+
+/*!
+ * \brief parse one number of type T from [p, end), skipping leading
+ *        whitespace (including newlines) first.
+ */
+template <typename T>
+inline bool TryParseNum(const char** p, const char* end, T* out) {
+  const char* s = *p;
+  while (s != end && IsSpaceChar(*s)) ++s;
+  if (s == end) return false;
+  if (!TryParseNumToken(&s, end, out)) return false;  // *p unmoved on failure
+  *p = s;
+  return true;
 }
 
 /*! \brief parse a number, FATAL on malformed input (parser hot-path helper). */

@@ -43,19 +43,81 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
   }
 
  protected:
+  // Single-pass hot loop: no line-end pre-scan (that would touch every byte
+  // twice); newline/'\r'/'\0' act as line terminators during tokenization.
   void ParseBlock(const char* begin, const char* end,
                   RowBlockContainer<IndexType, DType>* out) override {
     out->Clear();
     IndexType min_index = std::numeric_limits<IndexType>::max();
     const char* p = begin;
     while (p != end) {
-      const char* line_end = p;
-      while (line_end != end && *line_end != '\n' && *line_end != '\r' && *line_end != '\0') {
-        ++line_end;
+      // skip blank space between rows (covers blank lines and terminators)
+      while (p != end && IsSpaceChar(*p)) ++p;
+      if (p == end) break;
+      if (*p == '#' || *p == '\0') {  // comment-only line / NUL padding
+        DiscardLine(&p, end);
+        continue;
       }
-      ParseLine(p, line_end, out, &min_index);
-      p = line_end;
-      while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+      // ---- label[:weight]
+      real_t label, weight = 1.0f;
+      bool has_weight = false;
+      if (!TryParseNumToken(&p, end, &label)) {
+        DiscardLine(&p, end);  // malformed line: discard
+        continue;
+      }
+      if (p != end && *p == ':') {
+        ++p;
+        has_weight = TryParseNumToken(&p, end, &weight);
+      }
+      out->label.push_back(label);
+      if (has_weight) {
+        if (out->weight.size() + 1 < out->label.size()) {
+          out->weight.resize(out->label.size() - 1, 1.0f);
+        }
+        out->weight.push_back(weight);
+      }
+      // ---- optional qid:n, then features idx[:val] until end of line
+      bool at_qid_slot = true;
+      while (true) {
+        while (p != end && (*p == ' ' || *p == '\t')) ++p;
+        if (p == end || *p == '\n' || *p == '\r' || *p == '\0') break;
+        if (*p == '#') {  // trailing comment: discard rest of line
+          DiscardLine(&p, end);
+          break;
+        }
+        if (at_qid_slot) {
+          at_qid_slot = false;
+          if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
+            p += 4;
+            uint64_t qid = ParseNum<uint64_t>(&p, end);
+            if (out->qid.size() + 1 < out->label.size()) {
+              out->qid.resize(out->label.size() - 1, 0);
+            }
+            out->qid.push_back(qid);
+            continue;
+          }
+        }
+        IndexType idx;
+        DType val;
+        bool has_val = false;
+        if (!TryParseNumToken(&p, end, &idx)) {
+          DiscardLine(&p, end);  // malformed token: drop rest of line
+          break;
+        }
+        if (p != end && *p == ':') {
+          ++p;
+          if (!TryParseNumToken(&p, end, &val)) {
+            DiscardLine(&p, end);  // malformed value: drop token AND line,
+            break;                 // keeping index[] and value[] aligned
+          }
+          has_val = true;
+        }
+        out->index.push_back(idx);
+        out->max_index = std::max(out->max_index, idx);
+        min_index = std::min(min_index, idx);
+        if (has_val) out->value.push_back(val);
+      }
+      out->offset.push_back(out->index.size());
     }
     // indexing-mode resolution
     if (param_.indexing_mode > 0 ||
@@ -66,50 +128,9 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
   }
 
  private:
-  void ParseLine(const char* p, const char* end, RowBlockContainer<IndexType, DType>* out,
-                 IndexType* min_index) {
-    SkipSpaceAndComment(&p, end);
-    real_t label, weight = 1.0f;
-    bool has_weight = false;
-    if (!ParsePair<real_t, real_t>(&p, end, ':', &label, &weight, &has_weight)) {
-      return;  // blank / comment-only line
-    }
-    out->label.push_back(label);
-    if (has_weight) {
-      if (out->weight.size() + 1 < out->label.size()) {
-        out->weight.resize(out->label.size() - 1, 1.0f);
-      }
-      out->weight.push_back(weight);
-    }
-    // optional qid:n
-    SkipSpaceAndComment(&p, end);
-    if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
-      p += 4;
-      uint64_t qid = ParseNum<uint64_t>(&p, end);
-      if (out->qid.size() + 1 < out->label.size()) {
-        out->qid.resize(out->label.size() - 1, 0);
-      }
-      out->qid.push_back(qid);
-    }
-    // features idx[:val]
-    while (true) {
-      SkipSpaceAndComment(&p, end);
-      if (p == end) break;
-      IndexType idx;
-      DType val;
-      bool has_val = false;
-      if (!ParsePair<IndexType, DType>(&p, end, ':', &idx, &val, &has_val)) break;
-      out->index.push_back(idx);
-      out->max_index = std::max(out->max_index, idx);
-      *min_index = std::min(*min_index, idx);
-      if (has_val) out->value.push_back(val);
-    }
-    out->offset.push_back(out->index.size());
-  }
-
-  static void SkipSpaceAndComment(const char** p, const char* end) {
-    while (*p != end && IsSpaceChar(**p)) ++*p;
-    if (*p != end && **p == '#') *p = end;  // rest of line is a comment
+  /*! \brief advance to the current line's terminator ('\n', bare '\r', or NUL) */
+  static void DiscardLine(const char** p, const char* end) {
+    while (*p != end && **p != '\n' && **p != '\r' && **p != '\0') ++*p;
   }
 
   LibSVMParserParam param_;

@@ -45,7 +45,9 @@ class StagedBatcher {
     iter_.Init([this](StagedBatch** cell) { return Produce(cell); },
                [this] {
                  parser_->BeforeFirst();
-                 pend_ = Pending{};
+                 have_block_ = false;
+                 cur_row_ = 0;
+                 max_index_ = -1;
                  source_end_ = false;
                });
   }
@@ -58,122 +60,123 @@ class StagedBatcher {
   size_t BytesRead() const { return parser_->BytesRead(); }
 
  private:
-  /*! \brief rows accumulated but not yet emitted, in flat COO layout */
-  struct Pending {
-    std::vector<float> label, weight, value;
-    std::vector<uint64_t> index, field;
-    std::vector<size_t> row_nnz_end;  // prefix end of each row's nonzeros
-    int64_t max_index = -1;
-    size_t rows() const { return label.size(); }
-  };
-
-  void Absorb(const RowBlock<uint64_t, float>& b) {
-    size_t base_nnz = pend_.value.size();
-    size_t nnz = b.offset[b.size] - b.offset[0];
-    // bulk copies: parser offsets may not start at 0 inside a shared buffer
-    const uint64_t* idx = b.index + b.offset[0];
-    pend_.index.insert(pend_.index.end(), idx, idx + nnz);
-    if (b.value != nullptr) {
-      const float* val = b.value + b.offset[0];
-      pend_.value.insert(pend_.value.end(), val, val + nnz);
-    } else {
-      pend_.value.insert(pend_.value.end(), nnz, 1.0f);
-    }
-    if (with_field_) {
-      if (b.field != nullptr) {
-        const uint64_t* fld = b.field + b.offset[0];
-        pend_.field.insert(pend_.field.end(), fld, fld + nnz);
-      } else {
-        pend_.field.insert(pend_.field.end(), nnz, 0);
-      }
-    }
-    pend_.label.insert(pend_.label.end(), b.label, b.label + b.size);
-    if (b.weight != nullptr) {
-      pend_.weight.insert(pend_.weight.end(), b.weight, b.weight + b.size);
-    } else {
-      pend_.weight.insert(pend_.weight.end(), b.size, 1.0f);
-    }
-    for (size_t r = 0; r < b.size; ++r) {
-      pend_.row_nnz_end.push_back(base_nnz + (b.offset[r + 1] - b.offset[0]));
-    }
-    for (size_t k = 0; k < nnz; ++k) {
-      pend_.max_index = std::max<int64_t>(pend_.max_index,
-                                          static_cast<int64_t>(idx[k]));
-    }
-  }
-
+  // Single-copy pipeline: rows stream straight from the parser's RowBlock
+  // view into the staged output arrays (no intermediate pool).  A cursor
+  // tracks partial consumption of the current block across batch
+  // boundaries; the view stays valid until the next parser_->Next().
   bool Produce(StagedBatch** cell) {
-    while (pend_.rows() < batch_size_ && !source_end_) {
-      if (parser_->Next()) {
-        Absorb(parser_->Value());
-      } else {
-        source_end_ = true;
-      }
-    }
-    size_t take = std::min(pend_.rows(), batch_size_);
-    if (take == 0) return false;
     if (*cell == nullptr) *cell = new StagedBatch();
-    Emit(*cell, take);
+    StagedBatch* out = *cell;
+    const size_t B = batch_size_;
+    out->label.resize(B);
+    out->weight.resize(B);
+    out->index.clear();
+    out->value.clear();
+    out->field.clear();
+    row_nnz_end_.clear();
+
+    size_t rows = 0;
+    while (rows < B) {
+      if (!have_block_) {
+        if (source_end_ || !parser_->Next()) {
+          source_end_ = true;
+          break;
+        }
+        block_ = parser_->Value();
+        cur_row_ = 0;
+        have_block_ = (block_.size != 0);
+        continue;
+      }
+      size_t take = std::min(B - rows, block_.size - cur_row_);
+      AppendRows(out, rows, take);
+      rows += take;
+      cur_row_ += take;
+      if (cur_row_ == block_.size) have_block_ = false;
+    }
+    if (rows == 0) return false;
+    Finalize(out, rows);
     return true;
   }
 
-  void Emit(StagedBatch* out, size_t take) {
-    const size_t B = batch_size_;
-    size_t nnz = pend_.row_nnz_end[take - 1];
-    size_t nnz_pad = ((nnz + nnz_bucket_ - 1) / nnz_bucket_) * nnz_bucket_;
-    out->num_rows = static_cast<uint32_t>(take);
-    out->max_index = pend_.max_index;
-    out->label.assign(B, 0.0f);
-    out->weight.assign(B, 0.0f);
-    std::memcpy(out->label.data(), pend_.label.data(), take * sizeof(float));
-    std::memcpy(out->weight.data(), pend_.weight.data(), take * sizeof(float));
-    out->index.assign(nnz_pad, 0);
-    out->value.assign(nnz_pad, 0.0f);
-    out->row_id.assign(nnz_pad, static_cast<int32_t>(B - 1));
-    for (size_t k = 0; k < nnz; ++k) {
-      out->index[k] = static_cast<int32_t>(pend_.index[k]);
-    }
-    std::memcpy(out->value.data(), pend_.value.data(), nnz * sizeof(float));
-    if (with_field_) {
-      out->field.assign(nnz_pad, 0);
-      for (size_t k = 0; k < nnz; ++k) {
-        out->field[k] = static_cast<int32_t>(pend_.field[k]);
-      }
+  /*! \brief copy rows [cur_row_, cur_row_+take) of block_ into out at row base */
+  void AppendRows(StagedBatch* out, size_t base, size_t take) {
+    const RowBlock<uint64_t, float>& b = block_;
+    size_t lo = b.offset[cur_row_] - b.offset[0];
+    size_t hi = b.offset[cur_row_ + take] - b.offset[0];
+    size_t nnz = hi - lo;
+    size_t out_nnz = out->index.size();
+    std::memcpy(out->label.data() + base, b.label + cur_row_, take * sizeof(float));
+    if (b.weight != nullptr) {
+      std::memcpy(out->weight.data() + base, b.weight + cur_row_, take * sizeof(float));
     } else {
-      out->field.clear();
+      std::fill(out->weight.data() + base, out->weight.data() + base + take, 1.0f);
     }
-    size_t prev_end = 0;
+    const uint64_t* idx = b.index + b.offset[0] + lo;
+    out->index.resize(out_nnz + nnz);
+    int64_t mx = max_index_;
+    for (size_t k = 0; k < nnz; ++k) {
+      uint64_t v = idx[k];
+      out->index[out_nnz + k] = static_cast<int32_t>(v);
+      mx = std::max(mx, static_cast<int64_t>(v));
+    }
+    max_index_ = mx;
+    out->value.resize(out_nnz + nnz);
+    if (b.value != nullptr) {
+      std::memcpy(out->value.data() + out_nnz, b.value + b.offset[0] + lo,
+                  nnz * sizeof(float));
+    } else {
+      std::fill(out->value.begin() + out_nnz, out->value.end(), 1.0f);
+    }
+    if (with_field_) {
+      out->field.resize(out_nnz + nnz);
+      if (b.field != nullptr) {
+        const uint64_t* fld = b.field + b.offset[0] + lo;
+        for (size_t k = 0; k < nnz; ++k) {
+          out->field[out_nnz + k] = static_cast<int32_t>(fld[k]);
+        }
+      } else {
+        std::fill(out->field.begin() + out_nnz, out->field.end(), 0);
+      }
+    }
     for (size_t r = 0; r < take; ++r) {
-      size_t end = pend_.row_nnz_end[r];
+      row_nnz_end_.push_back(out_nnz + (b.offset[cur_row_ + r + 1] - b.offset[0] - lo));
+    }
+  }
+
+  /*! \brief zero-pad rows to batch_size and nonzeros to the bucket multiple */
+  void Finalize(StagedBatch* out, size_t rows) {
+    const size_t B = batch_size_;
+    size_t nnz = out->index.size();
+    size_t nnz_pad = ((nnz + nnz_bucket_ - 1) / nnz_bucket_) * nnz_bucket_;
+    if (nnz_pad == 0) nnz_pad = nnz_bucket_;
+    out->num_rows = static_cast<uint32_t>(rows);
+    out->max_index = max_index_;
+    std::fill(out->label.begin() + rows, out->label.end(), 0.0f);
+    std::fill(out->weight.begin() + rows, out->weight.end(), 0.0f);
+    out->index.resize(nnz_pad, 0);
+    out->value.resize(nnz_pad, 0.0f);
+    out->row_id.resize(nnz_pad);
+    size_t prev_end = 0;
+    for (size_t r = 0; r < rows; ++r) {
+      size_t end = row_nnz_end_[r];
       std::fill(out->row_id.begin() + prev_end, out->row_id.begin() + end,
                 static_cast<int32_t>(r));
       prev_end = end;
     }
-    // drop the emitted prefix from the pending pool
-    Pending next;
-    size_t rem_rows = pend_.rows() - take;
-    if (rem_rows != 0) {
-      next.label.assign(pend_.label.begin() + take, pend_.label.end());
-      next.weight.assign(pend_.weight.begin() + take, pend_.weight.end());
-      next.index.assign(pend_.index.begin() + nnz, pend_.index.end());
-      next.value.assign(pend_.value.begin() + nnz, pend_.value.end());
-      if (with_field_) {
-        next.field.assign(pend_.field.begin() + nnz, pend_.field.end());
-      }
-      next.row_nnz_end.reserve(rem_rows);
-      for (size_t r = take; r < pend_.rows(); ++r) {
-        next.row_nnz_end.push_back(pend_.row_nnz_end[r] - nnz);
-      }
-    }
-    next.max_index = pend_.max_index;
-    pend_ = std::move(next);
+    std::fill(out->row_id.begin() + nnz, out->row_id.end(),
+              static_cast<int32_t>(B - 1));
+    if (with_field_) out->field.resize(nnz_pad, 0);
   }
 
   std::unique_ptr<Parser<uint64_t, float>> parser_;
   size_t batch_size_;
   size_t nnz_bucket_;
   bool with_field_;
-  Pending pend_;
+  RowBlock<uint64_t, float> block_{};
+  size_t cur_row_ = 0;
+  bool have_block_ = false;
+  int64_t max_index_ = -1;
+  std::vector<size_t> row_nnz_end_;
   bool source_end_ = false;
   ThreadedIter<StagedBatch> iter_;
 };

@@ -47,6 +47,32 @@ TESTCASE(strtonum_basic) {
   EXPECT_TRUE(!TryParseNum(&p, end, &bad));
 }
 
+TESTCASE(strtonum_out_of_range_rejected) {
+  // out-of-range integers must fail (from_chars semantics), never wrap
+  auto reject = [](const char* text, auto proto) {
+    const char* p = text;
+    const char* end = text + std::strlen(text);
+    decltype(proto) v;
+    EXPECT_TRUE(!TryParseNum(&p, end, &v));
+    EXPECT_TRUE(p == text);  // cursor unmoved on failure
+  };
+  reject("4294967296", uint32_t{});    // 2^32
+  reject("3000000000", int32_t{});     // > INT32_MAX
+  reject("-3000000000", int32_t{});    // < INT32_MIN
+  reject("70000", int16_t{});
+  // boundaries parse exactly
+  auto accept = [](const char* text, auto want) {
+    const char* p = text;
+    const char* end = text + std::strlen(text);
+    decltype(want) v;
+    EXPECT_TRUE(TryParseNum(&p, end, &v));
+    EXPECT_EQV(v, want);
+  };
+  accept("4294967295", uint32_t{4294967295u});
+  accept("2147483647", int32_t{2147483647});
+  accept("-2147483648", int32_t{-2147483647 - 1});
+}
+
 TESTCASE(serializer_roundtrip) {
   std::string buf;
   MemoryStringStream ms(&buf);

@@ -109,6 +109,22 @@ TESTCASE(libsvm_implicit_value_and_crlf_bom) {
   EXPECT_TRUE(std::abs(all.value[0] - 0.5f) < kEps);
 }
 
+TESTCASE(libsvm_malformed_token_keeps_alignment) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/m.libsvm";
+  // middle row has a malformed value: its index must NOT be pushed, and
+  // rows after it must survive (also with bare-\r line endings)
+  WriteFile(f, "1 2:3.0\r0 5:xx 6:9\r1 7:4.0\r");
+  auto all = DrainParser(Parser<uint64_t>::Create(f.c_str(), 0, 1, "libsvm").get());
+  EXPECT_EQV(all.Size(), 3u);
+  EXPECT_EQV(all.offset[1] - all.offset[0], 1u);  // row 0: feature 2
+  EXPECT_EQV(all.offset[2] - all.offset[1], 0u);  // row 1: dropped after 5:xx
+  EXPECT_EQV(all.offset[3] - all.offset[2], 1u);  // row 2: feature 7
+  EXPECT_EQV(all.index.size(), all.value.size());  // arrays stay aligned
+  EXPECT_TRUE(std::abs(all.value[0] - 3.0f) < kEps);
+  EXPECT_TRUE(std::abs(all.value[1] - 4.0f) < kEps);
+}
+
 TESTCASE(csv_basic_label_weight_missing) {
   TemporaryDirectory tmp;
   std::string f = tmp.path + "/a.csv";

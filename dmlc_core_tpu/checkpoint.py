@@ -26,6 +26,16 @@ from .io import RecordIOReader, RecordIOWriter
 _FORMAT_VERSION = 1
 
 
+def _resolve_dtype(name: str) -> np.dtype:
+    """np.dtype(...) plus the ml_dtypes names numpy does not know
+    (bfloat16, float8_*, ... — the default training dtypes on TPU)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def save(pytree: Any, uri: str) -> int:
     """Write a pytree checkpoint; returns the number of array leaves."""
     leaves, treedef = jax.tree.flatten(pytree)
@@ -56,7 +66,7 @@ def load(uri: str, like: Any = None):
             raise ValueError(f"unsupported checkpoint version {meta.get('version')}")
         arrays = []
         for spec, payload in zip(meta["leaves"], records):
-            arr = np.frombuffer(payload, dtype=np.dtype(spec["dtype"]))
+            arr = np.frombuffer(payload, dtype=_resolve_dtype(spec["dtype"]))
             arrays.append(arr.reshape(spec["shape"]).copy())
     if len(arrays) != len(meta["leaves"]):
         raise ValueError("checkpoint truncated: leaf count mismatch")

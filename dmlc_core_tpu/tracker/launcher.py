@@ -23,7 +23,8 @@ def unzip_archives(workdir: str = ".") -> None:
 def derive_task_id(env: dict) -> None:
     if "DMLC_TASK_ID" in env:
         return
-    if "SGE_TASK_ID" in env:
+    if env.get("SGE_TASK_ID", "").isdigit():
+        # non-array SGE jobs export the literal string "undefined"
         env["DMLC_TASK_ID"] = str(int(env["SGE_TASK_ID"]) - 1)
     elif "SLURM_PROCID" in env:
         env["DMLC_TASK_ID"] = env["SLURM_PROCID"]

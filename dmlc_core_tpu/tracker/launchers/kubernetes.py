@@ -51,6 +51,8 @@ def run(args) -> None:
     image = args.extra_env.get("DMLC_K8S_IMAGE", "python:3.12")
     jobname = args.jobname or "dmlc-job"
 
+    dry_run = shutil.which("kubectl") is None
+
     def spawn_all(num_workers: int, num_servers: int, envs: dict) -> None:
         def launch(role: str, n: int) -> None:
             if n == 0:
@@ -62,7 +64,7 @@ def run(args) -> None:
                                      args.command, args.worker_cores,
                                      args.worker_memory_mb)
             text = json.dumps(manifest)
-            if shutil.which("kubectl") is None:
+            if dry_run:
                 sys.stdout.write(text + "\n")
                 return
             subprocess.run(["kubectl", "apply", "-f", "-"], input=text,
@@ -73,4 +75,11 @@ def run(args) -> None:
 
     tracker = submit(args.num_workers, args.num_servers, spawn_all,
                      host_ip=args.host_ip, extra_envs=args.extra_env)
+    if dry_run:
+        # manifests were only printed — no pods will ever phone home, so
+        # joining the tracker would block forever
+        sys.stderr.write("kubectl not found: manifests emitted to stdout, "
+                         "not submitted; skipping tracker join\n")
+        tracker.stop()
+        return
     tracker.join()

@@ -329,6 +329,14 @@ class RabitTracker:
     def alive(self) -> bool:
         return self.thread is not None and self.thread.is_alive()
 
+    def stop(self) -> None:
+        """Tear down the rendezvous socket without waiting for workers
+        (used by dry-run launchers that never started any)."""
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
 
 class PSTracker:
     """Parameter-server scheduler launcher (reference tracker.py:336-386)."""
@@ -371,6 +379,10 @@ class PSTracker:
 
     def alive(self) -> bool:
         return self.cmd is not None and self.thread.is_alive()
+
+    def stop(self) -> None:
+        """No-op (scheduler subprocess is a daemon thread); dry-run symmetry
+        with RabitTracker.stop()."""
 
 
 # ---- worker-side client -----------------------------------------------------
