@@ -149,6 +149,33 @@ class JSONReader {
   template <typename T>
   void Read(T* out);
 
+  /*! \brief consume and discard the next value (any JSON type) — lets
+   *         callers walk objects with unknown/uninteresting fields */
+  void SkipValue() {
+    int ch = NextNonSpace();
+    if (ch == '"') {
+      while ((ch = NextChar()) != EOF && ch != '"') {
+        if (ch == '\\') NextChar();
+      }
+      Expect(ch == '"', "unterminated string");
+    } else if (ch == '{') {
+      scope_counts_.push_back(0);
+      std::string key;
+      while (NextObjectItem(&key)) SkipValue();
+    } else if (ch == '[') {
+      scope_counts_.push_back(0);
+      while (NextArrayItem()) SkipValue();
+    } else {
+      // number / true / false / null: consume the bare token
+      Expect(ch != EOF, "unexpected end of input");
+      int pk;
+      while ((pk = is_->peek()) != EOF &&
+             (std::isalnum(pk) || pk == '-' || pk == '+' || pk == '.')) {
+        NextChar();
+      }
+    }
+  }
+
   int line() const { return line_; }
 
  private:

@@ -1,0 +1,423 @@
+// Azure Blob REST backend (see azure_filesys.h).  SharedKey signing per the
+// Azure "Authorize with Shared Key" specification, service version
+// 2021-08-06; List Blobs XML per the container REST API.
+#include "./azure_filesys.h"
+
+#include <cstdlib>
+#include <ctime>
+#include <sstream>
+#include <utility>
+
+#include "./crypto.h"
+#include "./http.h"
+#include "./xml_scan.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/parameter.h"
+
+namespace dmlctpu {
+namespace io {
+namespace {
+
+std::string NowRfc1123() {
+  std::time_t now = std::time(nullptr);
+  std::tm tm_buf{};
+  gmtime_r(&now, &tm_buf);
+  char buf[40];
+  std::strftime(buf, sizeof(buf), "%a, %d %b %Y %H:%M:%S GMT", &tm_buf);
+  return buf;
+}
+
+constexpr const char* kMsVersion = "2021-08-06";
+
+std::string BuildQuery(const std::map<std::string, std::string>& query) {
+  std::string out;
+  for (const auto& [k, v] : query) {
+    out += out.empty() ? "?" : "&";
+    out += k;
+    if (!v.empty()) out += "=" + http::PercentEncodeQuery(v);
+  }
+  return out;
+}
+
+/*! \brief wire path: emulator prefix + percent-encoded resource */
+std::string WirePath(const AzureFileSystem::Endpoint& ep, const std::string& resource) {
+  return ep.path_prefix + http::PercentEncodePath(resource);
+}
+
+}  // namespace
+
+std::string AzureSharedKey::CanonicalResource(
+    const std::string& account, const std::string& path,
+    const std::map<std::string, std::string>& query) {
+  std::string out = "/" + account + path;
+  for (const auto& [k, v] : query) {  // std::map is already name-sorted
+    out += "\n" + k + ":" + v;
+  }
+  return out;
+}
+
+AzureSharedKey::Signed AzureSharedKey::Sign(
+    const std::string& method, const std::string& resource_path,
+    const std::map<std::string, std::string>& query,
+    std::map<std::string, std::string> headers, size_t content_length,
+    const std::string& ms_date) const {
+  headers["x-ms-date"] = ms_date;
+  headers["x-ms-version"] = kMsVersion;
+  // canonicalized x-ms-* headers: lowercase names, sorted (map order)
+  std::map<std::string, std::string> ms_headers;
+  for (const auto& [k, v] : headers) {
+    std::string lk = k;
+    for (char& c : lk) c = static_cast<char>(::tolower(c));
+    if (lk.rfind("x-ms-", 0) == 0) ms_headers[lk] = v;
+  }
+  std::string canonical_headers;
+  for (const auto& [k, v] : ms_headers) canonical_headers += k + ":" + v + "\n";
+
+  auto hdr = [&headers](const char* name) -> std::string {
+    auto it = headers.find(name);
+    return it == headers.end() ? "" : it->second;
+  };
+  // 2015-02-21+ rule: zero Content-Length signs as the empty string
+  std::string length_str =
+      content_length == 0 ? "" : std::to_string(content_length);
+  std::string string_to_sign =
+      method + "\n" +
+      hdr("Content-Encoding") + "\n" +
+      hdr("Content-Language") + "\n" +
+      length_str + "\n" +
+      hdr("Content-MD5") + "\n" +
+      hdr("Content-Type") + "\n" +
+      /* Date (empty: x-ms-date wins) */ "\n" +
+      hdr("If-Modified-Since") + "\n" +
+      hdr("If-Match") + "\n" +
+      hdr("If-None-Match") + "\n" +
+      hdr("If-Unmodified-Since") + "\n" +
+      hdr("Range") + "\n" +
+      canonical_headers +
+      CanonicalResource(account, resource_path, query);
+
+  std::string raw_key;
+  TCHECK(crypto::Base64Decode(key_base64, &raw_key))
+      << "azure: AZURE_STORAGE_ACCESS_KEY is not valid base64";
+  std::string signature = crypto::Base64Encode(
+      crypto::HmacSHA256(raw_key, string_to_sign));
+
+  Signed out;
+  out.headers = std::move(headers);
+  out.headers["Authorization"] = "SharedKey " + account + ":" + signature;
+  out.string_to_sign = std::move(string_to_sign);
+  return out;
+}
+
+AzureFileSystem::AzureFileSystem() {
+  signer_.account = GetEnv("AZURE_STORAGE_ACCOUNT", "");
+  signer_.key_base64 = GetEnv("AZURE_STORAGE_ACCESS_KEY", "");
+  endpoint_env_ = GetEnv("DMLCTPU_AZURE_ENDPOINT", "");
+}
+
+AzureFileSystem* AzureFileSystem::GetInstance() {
+  static AzureFileSystem inst;
+  return &inst;
+}
+
+AzureFileSystem::Endpoint AzureFileSystem::ResolveEndpoint() const {
+  TCHECK(!signer_.account.empty() && !signer_.key_base64.empty())
+      << "azure: set AZURE_STORAGE_ACCOUNT and AZURE_STORAGE_ACCESS_KEY";
+  Endpoint ep;
+  std::string raw = endpoint_env_;
+  if (raw.empty()) {
+    TLOG(Fatal) << "azure: this build speaks plain http only (no TLS library "
+                   "in the image); set DMLCTPU_AZURE_ENDPOINT=http://host[:port] "
+                   "(Azurite or a TLS-terminating proxy)";
+  }
+  TCHECK(raw.rfind("https://", 0) != 0)
+      << "azure: https endpoints unsupported; use http:// (see header docs)";
+  if (raw.rfind("http://", 0) == 0) raw = raw.substr(7);
+  size_t colon = raw.find(':');
+  if (colon == std::string::npos) {
+    ep.host = raw;
+  } else {
+    ep.host = raw.substr(0, colon);
+    ep.port = std::atoi(raw.c_str() + colon + 1);
+  }
+  ep.path_prefix = "/" + signer_.account;  // emulator path-style
+  return ep;
+}
+
+void AzureFileSystem::ParseListBlobs(const std::string& xml,
+                                     const std::string& container_proto,
+                                     std::vector<FileInfo>* files,
+                                     std::vector<std::string>* prefixes) {
+  XMLScan scan(xml);
+  std::string block;
+  while (scan.Next("Blob", &block)) {
+    XMLScan inner(block);
+    std::string name, len;
+    if (!inner.Next("Name", &name)) continue;
+    inner.Rewind();
+    inner.Next("Content-Length", &len);
+    FileInfo info;
+    info.path = URI(container_proto + XmlUnescape(name));
+    info.size = static_cast<size_t>(std::atoll(len.c_str()));
+    info.type = (!name.empty() && name.back() == '/') ? FileType::kDirectory
+                                                      : FileType::kFile;
+    files->push_back(info);
+  }
+  scan.Rewind();
+  while (scan.Next("BlobPrefix", &block)) {
+    XMLScan inner(block);
+    std::string prefix;
+    if (inner.Next("Name", &prefix)) prefixes->push_back(XmlUnescape(prefix));
+  }
+}
+
+void AzureFileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
+  Endpoint ep = ResolveEndpoint();
+  std::string prefix = path.name.empty() ? "" : path.name.substr(1);
+  if (!prefix.empty() && prefix.back() != '/') prefix += '/';
+  std::string resource = "/" + path.host;  // container
+  std::string proto = path.protocol + path.host + "/";
+  std::string marker;
+  do {  // List Blobs pages via NextMarker until the listing is complete
+    std::map<std::string, std::string> query{{"comp", "list"},
+                                             {"delimiter", "/"},
+                                             {"prefix", prefix},
+                                             {"restype", "container"}};
+    if (!marker.empty()) query["marker"] = marker;
+    auto signed_req = signer_.Sign("GET", resource, query, {}, 0, NowRfc1123());
+    http::Response resp = http::Request(ep.host, ep.port, "GET",
+                                        WirePath(ep, resource) + BuildQuery(query),
+                                        signed_req.headers);
+    TCHECK_EQ(resp.status, 200) << "azure List Blobs failed (" << resp.status
+                                << "): " << resp.body.substr(0, 256);
+    std::vector<std::string> prefixes;
+    ParseListBlobs(resp.body, proto, out, &prefixes);
+    for (const std::string& p : prefixes) {
+      FileInfo info;
+      info.path = URI(proto + p);
+      info.type = FileType::kDirectory;
+      out->push_back(info);
+    }
+    XMLScan scan(resp.body);
+    marker.clear();
+    scan.Next("NextMarker", &marker);
+  } while (!marker.empty());
+}
+
+FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
+  Endpoint ep = ResolveEndpoint();
+  std::string resource = "/" + path.host + path.name;
+  auto signed_req = signer_.Sign("HEAD", resource, {}, {}, 0, NowRfc1123());
+  http::Response resp = http::Request(ep.host, ep.port, "HEAD",
+                                      WirePath(ep, resource), signed_req.headers);
+  FileInfo info;
+  info.path = path;
+  if (resp.status == 404) {
+    // virtual directory: report kDirectory iff any blob lives under the prefix
+    std::string container_res = "/" + path.host;
+    std::string prefix = path.name.empty() ? "" : path.name.substr(1);
+    if (!prefix.empty() && prefix.back() != '/') prefix += '/';
+    std::map<std::string, std::string> query{{"comp", "list"},
+                                             {"maxresults", "1"},
+                                             {"prefix", prefix},
+                                             {"restype", "container"}};
+    auto list_req = signer_.Sign("GET", container_res, query, {}, 0, NowRfc1123());
+    http::Response list = http::Request(ep.host, ep.port, "GET",
+                                        WirePath(ep, container_res) + BuildQuery(query),
+                                        list_req.headers);
+    XMLScan scan(list.body);
+    std::string any;
+    TCHECK(list.status == 200 && scan.Next("Name", &any))
+        << "azure: no such blob or prefix " << path.str();
+    info.type = FileType::kDirectory;
+    info.size = 0;
+    return info;
+  }
+  TCHECK_LT(resp.status, 400) << "azure HEAD " << path.str() << " -> "
+                              << resp.status;
+  auto it = resp.headers.find("content-length");
+  info.size = it == resp.headers.end()
+                  ? 0 : static_cast<size_t>(std::atoll(it->second.c_str()));
+  // a zero-length name ending in '/' is a directory marker blob
+  info.type = (!path.name.empty() && path.name.back() == '/')
+                  ? FileType::kDirectory : FileType::kFile;
+  return info;
+}
+
+namespace {
+
+/*! \brief ranged-GET seekable blob read stream (resumes on drop) */
+class AzureReadStream : public SeekStream {
+ public:
+  AzureReadStream(AzureFileSystem::Endpoint ep, const AzureSharedKey* signer,
+                  std::string resource, size_t total_size)
+      : ep_(std::move(ep)), signer_(signer), resource_(std::move(resource)),
+        req_path_(WirePath(ep_, resource_)), size_(total_size) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    if (pos_ >= size_) return 0;
+    if (body_ == nullptr) OpenAt(pos_);
+    size_t n = body_->Read(ptr, size);
+    if (n == 0 && pos_ < size_) {
+      OpenAt(pos_);
+      n = body_->Read(ptr, size);
+    }
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void*, size_t) override {
+    TLOG(Fatal) << "AzureReadStream is read-only";
+    return 0;
+  }
+  void Seek(size_t pos) override {
+    if (pos != pos_) {
+      pos_ = pos;
+      body_.reset();
+    }
+  }
+  size_t Tell() override { return pos_; }
+  bool AtEnd() override { return pos_ >= size_; }
+
+ private:
+  void OpenAt(size_t offset) {
+    std::map<std::string, std::string> headers{
+        {"Range", "bytes=" + std::to_string(offset) + "-"}};
+    auto signed_req = signer_->Sign("GET", resource_, {}, headers, 0,
+                                    NowRfc1123());
+    body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
+                                signed_req.headers);
+    TCHECK(body_->status() == 200 || body_->status() == 206)
+        << "azure GET " << req_path_ << " failed (" << body_->status() << ")";
+  }
+
+  AzureFileSystem::Endpoint ep_;
+  const AzureSharedKey* signer_;
+  std::string resource_;
+  std::string req_path_;
+  size_t size_;
+  size_t pos_ = 0;
+  std::unique_ptr<http::BodyStream> body_;
+};
+
+/*! \brief block-blob write stream: small objects go as one Put Blob; larger
+ *         ones stage Put Block chunks and commit with Put Block List */
+class AzureWriteStream : public Stream {
+ public:
+  AzureWriteStream(AzureFileSystem::Endpoint ep, const AzureSharedKey* signer,
+                   std::string resource)
+      : ep_(std::move(ep)), signer_(signer), resource_(std::move(resource)),
+        req_path_(WirePath(ep_, resource_)) {
+    block_bytes_ = static_cast<size_t>(
+        GetEnv("DMLCTPU_AZURE_WRITE_BUFFER_MB", 64)) << 20;
+  }
+  ~AzureWriteStream() override { Finish(); }
+
+  size_t Read(void*, size_t) override {
+    TLOG(Fatal) << "AzureWriteStream is write-only";
+    return 0;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    buffer_.append(static_cast<const char*>(ptr), size);
+    if (buffer_.size() >= block_bytes_) FlushBlock();
+    return size;
+  }
+
+ private:
+  std::string NextBlockId() {
+    // fixed-width ids (Azure requires equal-length base64 block ids)
+    char raw[16];
+    std::snprintf(raw, sizeof(raw), "block-%08d", static_cast<int>(block_ids_.size()));
+    return crypto::Base64Encode(raw, 14);
+  }
+  void FlushBlock() {
+    std::string id = NextBlockId();
+    std::map<std::string, std::string> query{{"blockid", id}, {"comp", "block"}};
+    auto signed_req = signer_->Sign("PUT", resource_, query, {}, buffer_.size(),
+                                    NowRfc1123());
+    http::Response resp = http::Request(ep_.host, ep_.port, "PUT",
+                                        req_path_ + BuildQuery(query),
+                                        signed_req.headers, buffer_);
+    TCHECK(resp.status == 201 || resp.status == 200)
+        << "azure Put Block failed (" << resp.status << "): "
+        << resp.body.substr(0, 256);
+    block_ids_.push_back(id);
+    buffer_.clear();
+  }
+  void Finish() {
+    if (finished_) return;
+    finished_ = true;
+    if (block_ids_.empty()) {
+      // small object: single Put Blob
+      std::map<std::string, std::string> headers{{"x-ms-blob-type", "BlockBlob"}};
+      auto signed_req = signer_->Sign("PUT", resource_, {}, headers,
+                                      buffer_.size(), NowRfc1123());
+      http::Response resp = http::Request(ep_.host, ep_.port, "PUT", req_path_,
+                                          signed_req.headers, buffer_);
+      TCHECK(resp.status == 201 || resp.status == 200)
+          << "azure Put Blob failed (" << resp.status << "): "
+          << resp.body.substr(0, 256);
+      return;
+    }
+    if (!buffer_.empty()) FlushBlock();
+    std::string body = "<?xml version=\"1.0\" encoding=\"utf-8\"?><BlockList>";
+    for (const std::string& id : block_ids_) body += "<Latest>" + id + "</Latest>";
+    body += "</BlockList>";
+    std::map<std::string, std::string> query{{"comp", "blocklist"}};
+    auto signed_req = signer_->Sign("PUT", resource_, query, {}, body.size(),
+                                    NowRfc1123());
+    http::Response resp = http::Request(ep_.host, ep_.port, "PUT",
+                                        req_path_ + BuildQuery(query),
+                                        signed_req.headers, body);
+    TCHECK(resp.status == 201 || resp.status == 200)
+        << "azure Put Block List failed (" << resp.status << "): "
+        << resp.body.substr(0, 256);
+  }
+
+  AzureFileSystem::Endpoint ep_;
+  const AzureSharedKey* signer_;
+  std::string resource_;
+  std::string req_path_;
+  std::string buffer_;
+  size_t block_bytes_;
+  std::vector<std::string> block_ids_;
+  bool finished_ = false;
+};
+
+}  // namespace
+
+std::unique_ptr<SeekStream> AzureFileSystem::OpenForRead(const URI& path,
+                                                         bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    Endpoint ep = ResolveEndpoint();
+    return std::make_unique<AzureReadStream>(
+        ep, &signer_, "/" + path.host + path.name, info.size);
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+std::unique_ptr<Stream> AzureFileSystem::Open(const URI& path, const char* mode,
+                                              bool allow_null) {
+  std::string m(mode);
+  if (m.find('r') != std::string::npos) return OpenForRead(path, allow_null);
+  TCHECK(m.find('w') != std::string::npos) << "azure: unsupported mode " << mode;
+  Endpoint ep = ResolveEndpoint();
+  return std::make_unique<AzureWriteStream>(
+      ep, &signer_, "/" + path.host + path.name);
+}
+
+namespace {
+struct RegisterAzureBackend {
+  RegisterAzureBackend() {
+    FileSystem::RegisterBackend("azure://", [] {
+      return static_cast<FileSystem*>(AzureFileSystem::GetInstance());
+    });
+  }
+};
+RegisterAzureBackend register_azure_backend_;
+}  // namespace
+
+}  // namespace io
+}  // namespace dmlctpu

@@ -114,5 +114,66 @@ std::string Hex(const void* data, size_t len) {
 }
 std::string Hex(const Digest& d) { return Hex(d.data(), d.size()); }
 
+static const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string Base64Encode(const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  std::string out;
+  out.reserve((len + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= len; i += 3) {
+    uint32_t v = (p[i] << 16) | (p[i + 1] << 8) | p[i + 2];
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(kB64[(v >> 6) & 63]);
+    out.push_back(kB64[v & 63]);
+  }
+  if (i + 1 == len) {
+    uint32_t v = p[i] << 16;
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out += "==";
+  } else if (i + 2 == len) {
+    uint32_t v = (p[i] << 16) | (p[i + 1] << 8);
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(kB64[(v >> 6) & 63]);
+    out.push_back('=');
+  }
+  return out;
+}
+
+bool Base64Decode(const std::string& text, std::string* out) {
+  int8_t rev[256];
+  std::memset(rev, -1, sizeof(rev));
+  for (int i = 0; i < 64; ++i) rev[static_cast<uint8_t>(kB64[i])] = static_cast<int8_t>(i);
+  out->clear();
+  uint32_t acc = 0;
+  int bits = 0;
+  size_t chars = 0, pad = 0;
+  for (char c : text) {
+    if (c == '\n' || c == '\r') continue;
+    if (c == '=') {  // padding must be terminal, at most 2
+      if (++pad > 2) return false;
+      continue;
+    }
+    if (pad != 0) return false;  // data after '='
+    int8_t v = rev[static_cast<uint8_t>(c)];
+    if (v < 0) return false;
+    ++chars;
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out->push_back(static_cast<char>((acc >> bits) & 0xff));
+    }
+  }
+  // strict RFC 4648: length (incl padding) a multiple of 4, no leftover bits
+  if ((chars + pad) % 4 != 0) return false;
+  if (bits != 0 && (acc & ((1u << bits) - 1)) != 0) return false;
+  return true;
+}
+
 }  // namespace crypto
 }  // namespace dmlctpu

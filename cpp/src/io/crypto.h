@@ -30,6 +30,17 @@ inline Digest HmacSHA256(const Digest& key, const std::string& msg) {
 std::string Hex(const Digest& d);
 std::string Hex(const void* data, size_t len);
 
+/*! \brief RFC 4648 base64 (used by the Azure SharedKey signer) */
+std::string Base64Encode(const void* data, size_t len);
+inline std::string Base64Encode(const std::string& s) {
+  return Base64Encode(s.data(), s.size());
+}
+inline std::string Base64Encode(const Digest& d) {
+  return Base64Encode(d.data(), d.size());
+}
+/*! \brief decode; returns false on malformed input */
+bool Base64Decode(const std::string& text, std::string* out);
+
 }  // namespace crypto
 }  // namespace dmlctpu
 #endif  // DMLCTPU_SRC_IO_CRYPTO_H_

@@ -1,0 +1,370 @@
+// WebHDFS REST backend (see hdfs_filesys.h for the design rationale).
+// Wire shapes handled (Hadoop WebHDFS API):
+//   GETFILESTATUS -> {"FileStatus":{"length":N,"type":"FILE"|"DIRECTORY",...}}
+//   LISTSTATUS    -> {"FileStatuses":{"FileStatus":[{...,"pathSuffix":"x"},...]}}
+//   OPEN/CREATE/APPEND with noredirect=true -> {"Location":"http://dn:port/..."}
+//   (plus the older 307 + Location-header form)
+#include "./hdfs_filesys.h"
+
+#include <cstdlib>
+#include <sstream>
+#include <utility>
+
+#include "./http.h"
+#include "dmlctpu/json.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/parameter.h"
+
+namespace dmlctpu {
+namespace io {
+namespace {
+
+/*! \brief one WebHDFS FileStatus entry (only the fields we use) */
+struct HdfsStatus {
+  size_t length = 0;
+  bool is_dir = false;
+  std::string path_suffix;
+};
+
+void ReadStatusObject(JSONReader* r, HdfsStatus* out) {
+  r->BeginObject();
+  std::string key;
+  while (r->NextObjectItem(&key)) {
+    if (key == "length") {
+      uint64_t v = 0;
+      r->ReadNumber(&v);
+      out->length = static_cast<size_t>(v);
+    } else if (key == "type") {
+      std::string t;
+      r->ReadString(&t);
+      out->is_dir = (t == "DIRECTORY");
+    } else if (key == "pathSuffix") {
+      r->ReadString(&out->path_suffix);
+    } else {
+      r->SkipValue();
+    }
+  }
+}
+
+/*! \brief parse {"FileStatus": {...}} */
+HdfsStatus ParseFileStatus(const std::string& body) {
+  std::istringstream is(body);
+  JSONReader r(&is);
+  HdfsStatus st;
+  r.BeginObject();
+  std::string key;
+  bool found = false;
+  while (r.NextObjectItem(&key)) {
+    if (key == "FileStatus") {
+      ReadStatusObject(&r, &st);
+      found = true;
+    } else {
+      r.SkipValue();
+    }
+  }
+  TCHECK(found) << "WebHDFS: no FileStatus in response: " << body.substr(0, 200);
+  return st;
+}
+
+/*! \brief parse {"FileStatuses": {"FileStatus": [...]}} */
+std::vector<HdfsStatus> ParseListStatus(const std::string& body) {
+  std::istringstream is(body);
+  JSONReader r(&is);
+  std::vector<HdfsStatus> out;
+  r.BeginObject();
+  std::string key;
+  while (r.NextObjectItem(&key)) {
+    if (key != "FileStatuses") {
+      r.SkipValue();
+      continue;
+    }
+    r.BeginObject();
+    while (r.NextObjectItem(&key)) {
+      if (key != "FileStatus") {
+        r.SkipValue();
+        continue;
+      }
+      r.BeginArray();
+      while (r.NextArrayItem()) {
+        HdfsStatus st;
+        ReadStatusObject(&r, &st);
+        out.push_back(std::move(st));
+      }
+    }
+  }
+  return out;
+}
+
+/*! \brief parse {"Location": "..."} (noredirect responses) */
+std::string ParseLocation(const std::string& body) {
+  std::istringstream is(body);
+  JSONReader r(&is);
+  std::string loc, key;
+  r.BeginObject();
+  while (r.NextObjectItem(&key)) {
+    if (key == "Location") {
+      r.ReadString(&loc);
+    } else {
+      r.SkipValue();
+    }
+  }
+  return loc;
+}
+
+/*! \brief split "http://host:port/path?query" into pieces */
+struct ParsedUrl {
+  std::string host;
+  int port = 80;
+  std::string path_and_query;  // begins with '/'
+};
+ParsedUrl ParseUrl(const std::string& url) {
+  ParsedUrl out;
+  std::string rest = url;
+  if (rest.rfind("http://", 0) == 0) rest = rest.substr(7);
+  TCHECK(rest.rfind("https://", 0) != 0)
+      << "WebHDFS: https datanode URLs unsupported in this build (no TLS); "
+         "configure dfs.http.policy=HTTP_ONLY or front with a proxy";
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  out.path_and_query = slash == std::string::npos ? "/" : rest.substr(slash);
+  size_t colon = hostport.find(':');
+  if (colon == std::string::npos) {
+    out.host = hostport;
+  } else {
+    out.host = hostport.substr(0, colon);
+    out.port = std::atoi(hostport.c_str() + colon + 1);
+  }
+  return out;
+}
+
+/*! \brief build "/webhdfs/v1<path>?op=X[&user.name=u][&extra]" */
+std::string OpPath(const HdfsFileSystem::Endpoint& ep, const std::string& path,
+                   const std::string& op, const std::string& extra = "") {
+  std::string full = "/webhdfs/v1" + http::PercentEncodePath(path.empty() ? "/" : path) +
+                     "?op=" + op;
+  if (!ep.user.empty()) full += "&user.name=" + ep.user;
+  if (!extra.empty()) full += "&" + extra;
+  return full;
+}
+
+/*! \brief namenode request; follows one noredirect/307 hop when asked */
+http::Response NamenodeRequest(const HdfsFileSystem::Endpoint& ep,
+                               const std::string& method, const std::string& path) {
+  return http::Request(ep.host, ep.port, method, path, {});
+}
+
+/*! \brief ranged-OPEN seekable read stream (reopens on seek / drop) */
+class WebHdfsReadStream : public SeekStream {
+ public:
+  WebHdfsReadStream(HdfsFileSystem::Endpoint ep, std::string path, size_t size)
+      : ep_(std::move(ep)), path_(std::move(path)), size_(size) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    if (pos_ >= size_) return 0;
+    if (body_ == nullptr) OpenAt(pos_);
+    size_t n = body_->Read(ptr, size);
+    if (n == 0 && pos_ < size_) {
+      OpenAt(pos_);  // connection dropped mid-stream: resume at cursor
+      n = body_->Read(ptr, size);
+    }
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void*, size_t) override {
+    TLOG(Fatal) << "WebHdfsReadStream is read-only";
+    return 0;
+  }
+  void Seek(size_t pos) override {
+    if (pos != pos_) {
+      pos_ = pos;
+      body_.reset();
+    }
+  }
+  size_t Tell() override { return pos_; }
+  bool AtEnd() override { return pos_ >= size_; }
+
+ private:
+  void OpenAt(size_t offset) {
+    std::string nn_path = OpPath(ep_, path_, "OPEN",
+                                 "offset=" + std::to_string(offset) +
+                                 "&noredirect=true");
+    http::Response hop = NamenodeRequest(ep_, "GET", nn_path);
+    std::string location;
+    if (hop.status == 200) {
+      location = ParseLocation(hop.body);
+    } else if (hop.status == 307) {
+      auto it = hop.headers.find("location");
+      if (it != hop.headers.end()) location = it->second;
+    }
+    TCHECK(!location.empty()) << "WebHDFS OPEN " << path_ << " failed ("
+                              << hop.status << "): " << hop.body.substr(0, 200);
+    ParsedUrl dn = ParseUrl(location);
+    body_ = http::RequestStream(dn.host, dn.port, "GET", dn.path_and_query, {});
+    TCHECK(body_->status() == 200 || body_->status() == 206)
+        << "WebHDFS datanode GET failed (" << body_->status() << ")";
+  }
+
+  HdfsFileSystem::Endpoint ep_;
+  std::string path_;
+  size_t size_;
+  size_t pos_ = 0;
+  std::unique_ptr<http::BodyStream> body_;
+};
+
+/*! \brief buffered write stream: CREATE on first flush, APPEND after */
+class WebHdfsWriteStream : public Stream {
+ public:
+  WebHdfsWriteStream(HdfsFileSystem::Endpoint ep, std::string path, bool append)
+      : ep_(std::move(ep)), path_(std::move(path)), created_(append) {
+    flush_bytes_ = static_cast<size_t>(GetEnv("DMLCTPU_HDFS_WRITE_BUFFER_MB", 64))
+                   << 20;
+  }
+  ~WebHdfsWriteStream() override {
+    // a never-written "w" stream must still create an empty file
+    if (!created_ || !buffer_.empty()) Flush();
+  }
+
+  size_t Read(void*, size_t) override {
+    TLOG(Fatal) << "WebHdfsWriteStream is write-only";
+    return 0;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    buffer_.append(static_cast<const char*>(ptr), size);
+    if (buffer_.size() >= flush_bytes_) Flush();
+    return size;
+  }
+
+ private:
+  void Flush() {
+    const bool creating = !created_;
+    const std::string method = creating ? "PUT" : "POST";
+    std::string nn_path = creating
+        ? OpPath(ep_, path_, "CREATE", "overwrite=true&noredirect=true")
+        : OpPath(ep_, path_, "APPEND", "noredirect=true");
+    http::Response hop = NamenodeRequest(ep_, method, nn_path);
+    std::string location;
+    if (hop.status == 200 || hop.status == 201) {
+      location = ParseLocation(hop.body);
+    } else if (hop.status == 307) {
+      auto it = hop.headers.find("location");
+      if (it != hop.headers.end()) location = it->second;
+    }
+    TCHECK(!location.empty())
+        << "WebHDFS " << (creating ? "CREATE " : "APPEND ") << path_
+        << " failed (" << hop.status << "): " << hop.body.substr(0, 200);
+    ParsedUrl dn = ParseUrl(location);
+    http::Response resp = http::Request(
+        dn.host, dn.port, method, dn.path_and_query,
+        {{"Content-Type", "application/octet-stream"}}, buffer_);
+    TCHECK(resp.status == 200 || resp.status == 201)
+        << "WebHDFS datanode write failed (" << resp.status << ")";
+    created_ = true;
+    buffer_.clear();
+  }
+
+  HdfsFileSystem::Endpoint ep_;
+  std::string path_;
+  bool created_;
+  std::string buffer_;
+  size_t flush_bytes_;
+};
+
+}  // namespace
+
+HdfsFileSystem* HdfsFileSystem::GetInstance() {
+  static HdfsFileSystem inst;
+  return &inst;
+}
+
+HdfsFileSystem::Endpoint HdfsFileSystem::ResolveEndpoint(const URI& uri) {
+  Endpoint ep;
+  std::string addr = GetEnv("DMLCTPU_WEBHDFS_ADDR", std::string());
+  if (addr.empty()) addr = uri.host;
+  size_t colon = addr.find(':');
+  if (colon == std::string::npos) {
+    ep.host = addr;
+  } else {
+    ep.host = addr.substr(0, colon);
+    ep.port = std::atoi(addr.c_str() + colon + 1);
+  }
+  TCHECK(!ep.host.empty())
+      << "hdfs: no namenode address — use hdfs://host[:port]/path or set "
+         "DMLCTPU_WEBHDFS_ADDR=host:port";
+  ep.user = GetEnv("HADOOP_USER_NAME", std::string());
+  return ep;
+}
+
+FileInfo HdfsFileSystem::GetPathInfo(const URI& path) {
+  Endpoint ep = ResolveEndpoint(path);
+  http::Response resp =
+      NamenodeRequest(ep, "GET", OpPath(ep, path.name, "GETFILESTATUS"));
+  TCHECK_EQ(resp.status, 200) << "WebHDFS GETFILESTATUS " << path.str()
+                              << " failed (" << resp.status << "): "
+                              << resp.body.substr(0, 200);
+  HdfsStatus st = ParseFileStatus(resp.body);
+  FileInfo info;
+  info.path = path;
+  info.size = st.length;
+  info.type = st.is_dir ? FileType::kDirectory : FileType::kFile;
+  return info;
+}
+
+void HdfsFileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
+  Endpoint ep = ResolveEndpoint(path);
+  http::Response resp =
+      NamenodeRequest(ep, "GET", OpPath(ep, path.name, "LISTSTATUS"));
+  TCHECK_EQ(resp.status, 200) << "WebHDFS LISTSTATUS " << path.str()
+                              << " failed (" << resp.status << "): "
+                              << resp.body.substr(0, 200);
+  std::string base = path.name;
+  if (base.empty() || base.back() != '/') base += '/';
+  for (const HdfsStatus& st : ParseListStatus(resp.body)) {
+    FileInfo info;
+    URI sub = path;
+    // LISTSTATUS on a file returns one entry with empty pathSuffix
+    sub.name = st.path_suffix.empty() ? path.name : base + st.path_suffix;
+    info.path = sub;
+    info.size = st.length;
+    info.type = st.is_dir ? FileType::kDirectory : FileType::kFile;
+    out->push_back(info);
+  }
+}
+
+std::unique_ptr<SeekStream> HdfsFileSystem::OpenForRead(const URI& path,
+                                                        bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    TCHECK(info.type == FileType::kFile) << "hdfs: not a file: " << path.str();
+    return std::make_unique<WebHdfsReadStream>(ResolveEndpoint(path), path.name,
+                                               info.size);
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+std::unique_ptr<Stream> HdfsFileSystem::Open(const URI& path, const char* mode,
+                                             bool allow_null) {
+  std::string m(mode);
+  if (m.find('r') != std::string::npos) return OpenForRead(path, allow_null);
+  TCHECK(m.find('w') != std::string::npos || m.find('a') != std::string::npos)
+      << "hdfs: unsupported mode " << mode;
+  return std::make_unique<WebHdfsWriteStream>(ResolveEndpoint(path), path.name,
+                                              /*append=*/m.find('a') != std::string::npos);
+}
+
+namespace {
+struct RegisterHdfsBackend {
+  RegisterHdfsBackend() {
+    auto factory = [] {
+      return static_cast<FileSystem*>(HdfsFileSystem::GetInstance());
+    };
+    FileSystem::RegisterBackend("hdfs://", factory);
+    FileSystem::RegisterBackend("viewfs://", factory);
+  }
+};
+RegisterHdfsBackend register_hdfs_backend_;
+}  // namespace
+
+}  // namespace io
+}  // namespace dmlctpu

@@ -1,0 +1,50 @@
+// hdfs_filesys.h — HDFS filesystem backend over the WebHDFS REST API.
+// Parity: reference src/io/hdfs_filesys.{h,cc} (libhdfs/JNI wrapper — namenode
+// from URI host or fs.defaultFS, stream open/list/stat).  Fresh design for
+// this build: no JVM dependency — WebHDFS (the namenode's HTTP gateway)
+// spoken over the raw-socket HTTP client, with `noredirect=true` two-step
+// transfers so the client controls every connection.  Handles hdfs:// and
+// viewfs:// URIs.
+//
+// Addressing: the URI host[:port] is taken as the WebHDFS HTTP address
+// (default port 9870); `DMLCTPU_WEBHDFS_ADDR=host:port` overrides (useful
+// when URIs carry the RPC port).  `HADOOP_USER_NAME` sets `user.name` for
+// simple auth.  Kerberos/TLS clusters need an authenticating proxy.
+#ifndef DMLCTPU_SRC_IO_HDFS_FILESYS_H_
+#define DMLCTPU_SRC_IO_HDFS_FILESYS_H_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "dmlctpu/io/filesystem.h"
+
+namespace dmlctpu {
+namespace io {
+
+class HdfsFileSystem : public FileSystem {
+ public:
+  static HdfsFileSystem* GetInstance();
+
+  FileInfo GetPathInfo(const URI& path) override;
+  void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
+  std::unique_ptr<Stream> Open(const URI& path, const char* mode,
+                               bool allow_null = false) override;
+  std::unique_ptr<SeekStream> OpenForRead(const URI& path,
+                                          bool allow_null = false) override;
+
+  struct Endpoint {
+    std::string host;
+    int port = 9870;  // Hadoop 3 WebHDFS default
+    std::string user;  // empty → no user.name param
+  };
+  /*! \brief resolve the WebHDFS address for a URI (exposed for tests) */
+  static Endpoint ResolveEndpoint(const URI& uri);
+
+ private:
+  HdfsFileSystem() = default;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_HDFS_FILESYS_H_

@@ -208,5 +208,32 @@ Response Request(const std::string& host, int port, const std::string& method,
   return resp;
 }
 
+namespace {
+std::string PercentEncode(const std::string& s, bool keep_slash) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    bool safe = std::isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~' ||
+                (keep_slash && c == '/');
+    if (safe) {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 15]);
+    }
+  }
+  return out;
+}
+}  // namespace
+
+std::string PercentEncodePath(const std::string& path) {
+  return PercentEncode(path, /*keep_slash=*/true);
+}
+std::string PercentEncodeQuery(const std::string& value) {
+  return PercentEncode(value, /*keep_slash=*/false);
+}
+
 }  // namespace http
 }  // namespace dmlctpu

@@ -40,6 +40,11 @@ std::unique_ptr<BodyStream> RequestStream(
     const std::string& path_and_query,
     const std::map<std::string, std::string>& headers, const std::string& body = "");
 
+/*! \brief percent-encode a URL path, keeping '/' separators */
+std::string PercentEncodePath(const std::string& path);
+/*! \brief percent-encode a query name or value (encodes '/', '&', '=', ...) */
+std::string PercentEncodeQuery(const std::string& value);
+
 }  // namespace http
 }  // namespace dmlctpu
 #endif  // DMLCTPU_SRC_IO_HTTP_H_

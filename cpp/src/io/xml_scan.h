@@ -1,0 +1,52 @@
+// xml_scan.h — tiny forward-only XML scanner + entity unescape, shared by
+// the S3 (ListObjects) and Azure (List Blobs) response parsers.
+#ifndef DMLCTPU_SRC_IO_XML_SCAN_H_
+#define DMLCTPU_SRC_IO_XML_SCAN_H_
+
+#include <string>
+
+namespace dmlctpu {
+namespace io {
+
+/*! \brief finds <tag>text</tag> spans in document order */
+class XMLScan {
+ public:
+  explicit XMLScan(const std::string& text) : text_(text) {}
+  /*! \brief next occurrence of <tag>..</tag> after the cursor */
+  bool Next(const std::string& tag, std::string* content) {
+    std::string open = "<" + tag + ">";
+    std::string close = "</" + tag + ">";
+    size_t b = text_.find(open, pos_);
+    if (b == std::string::npos) return false;
+    b += open.size();
+    size_t e = text_.find(close, b);
+    if (e == std::string::npos) return false;
+    *content = text_.substr(b, e - b);
+    pos_ = e + close.size();
+    return true;
+  }
+  void Rewind() { pos_ = 0; }
+
+ private:
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+inline std::string XmlUnescape(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size();) {
+    if (s[i] == '&') {
+      if (s.compare(i, 5, "&amp;") == 0) { out += '&'; i += 5; continue; }
+      if (s.compare(i, 4, "&lt;") == 0) { out += '<'; i += 4; continue; }
+      if (s.compare(i, 4, "&gt;") == 0) { out += '>'; i += 4; continue; }
+      if (s.compare(i, 6, "&quot;") == 0) { out += '"'; i += 6; continue; }
+      if (s.compare(i, 6, "&apos;") == 0) { out += '\''; i += 6; continue; }
+    }
+    out += s[i++];
+  }
+  return out;
+}
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_XML_SCAN_H_

@@ -19,7 +19,9 @@
 #include <thread>
 #include <vector>
 
+#include "../src/io/azure_filesys.h"
 #include "../src/io/crypto.h"
+#include "../src/io/hdfs_filesys.h"
 #include "../src/io/http.h"
 #include "../src/io/s3_filesys.h"
 #include "dmlctpu/stream.h"
@@ -104,12 +106,23 @@ TESTCASE(list_objects_xml_parse) {
   EXPECT_EQV(prefixes[0], "data/nested/");
 }
 
-// ---- mini in-process S3-ish server -----------------------------------------
+// ---- shared mini in-process HTTP server (socket + request parse) ----------
 namespace {
 
-class MiniS3Server {
+struct HttpRequest {
+  std::string method, path, query, body;
+  std::map<std::string, std::string> headers;  // lowercased keys
+};
+struct HttpReply {
+  std::string status = "200 OK";
+  std::string body;
+  std::string extra_headers;   // raw "K: v\r\n" lines
+  bool head_no_body = false;   // HEAD: extra_headers carry the size
+};
+
+class MiniHttpServer {
  public:
-  MiniS3Server() {
+  MiniHttpServer() {
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int on = 1;
     ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &on, sizeof(on));
@@ -124,14 +137,18 @@ class MiniS3Server {
     ::listen(fd_, 16);
     thread_ = std::thread([this] { Serve(); });
   }
-  ~MiniS3Server() {
-    stop_ = true;
+  virtual ~MiniHttpServer() { Shutdown(); }
+  int port() const { return port_; }
+
+ protected:
+  /*! \brief derived destructors MUST call this before their members die */
+  void Shutdown() {
+    if (stop_.exchange(true)) return;
     ::shutdown(fd_, SHUT_RDWR);
     ::close(fd_);
     if (thread_.joinable()) thread_.join();
   }
-  int port() const { return port_; }
-  std::map<std::string, std::string> objects;  // key → bytes (set before use)
+  virtual void Handle(const HttpRequest& req, HttpReply* reply) = 0;
 
  private:
   void Serve() {
@@ -143,20 +160,18 @@ class MiniS3Server {
     }
   }
   void HandleClient(int client) {
-    std::string req;
+    std::string raw;
     char buf[4096];
-    // read headers
-    while (req.find("\r\n\r\n") == std::string::npos) {
+    while (raw.find("\r\n\r\n") == std::string::npos) {
       ssize_t n = ::recv(client, buf, sizeof(buf), 0);
       if (n <= 0) return;
-      req.append(buf, n);
+      raw.append(buf, n);
     }
-    size_t hdr_end = req.find("\r\n\r\n") + 4;
-    std::istringstream head(req.substr(0, hdr_end));
-    std::string method, target;
-    head >> method >> target;
-    // collect headers (lowercased)
-    std::map<std::string, std::string> headers;
+    size_t hdr_end = raw.find("\r\n\r\n") + 4;
+    std::istringstream head(raw.substr(0, hdr_end));
+    HttpRequest req;
+    std::string target;
+    head >> req.method >> target;
     std::string line;
     std::getline(head, line);
     while (std::getline(head, line)) {
@@ -165,64 +180,30 @@ class MiniS3Server {
       if (colon == std::string::npos) continue;
       std::string k = line.substr(0, colon);
       for (auto& ch : k) ch = static_cast<char>(::tolower(ch));
-      headers[k] = line.substr(line.find_first_not_of(' ', colon + 1));
+      req.headers[k] = line.substr(line.find_first_not_of(' ', colon + 1));
     }
-    // read body if any
-    std::string body = req.substr(hdr_end);
-    size_t content_length = headers.count("content-length")
-                                ? std::stoul(headers["content-length"]) : 0;
-    while (body.size() < content_length) {
+    req.body = raw.substr(hdr_end);
+    size_t content_length = req.headers.count("content-length")
+                                ? std::stoul(req.headers["content-length"]) : 0;
+    while (req.body.size() < content_length) {
       ssize_t n = ::recv(client, buf, sizeof(buf), 0);
       if (n <= 0) break;
-      body.append(buf, n);
+      req.body.append(buf, n);
     }
-    // requests must be SigV4-signed (presence check: full verification would
-    // duplicate the signer under test)
-    bool signed_ok = headers.count("authorization") &&
-                     headers["authorization"].find("AWS4-HMAC-SHA256") == 0;
-    std::string path = target.substr(0, target.find('?'));
-    std::string query = target.find('?') == std::string::npos
-                            ? "" : target.substr(target.find('?') + 1);
-    std::string resp_body;
-    std::string status = "200 OK";
-    std::string extra_headers;
-    if (!signed_ok) {
-      status = "403 Forbidden";
-      resp_body = "<Error>missing sigv4</Error>";
-    } else if (method == "GET" && query.find("prefix=") != std::string::npos) {
-      std::ostringstream xml;
-      xml << "<ListBucketResult>";
-      for (const auto& [key, bytes] : objects) {
-        xml << "<Contents><Key>" << key << "</Key><Size>" << bytes.size()
-            << "</Size></Contents>";
-      }
-      xml << "</ListBucketResult>";
-      resp_body = xml.str();
-    } else if (method == "GET") {
-      std::string key = path.substr(path.find('/', 1) + 1);  // /bucket/key
-      auto it = objects.find(key);
-      if (it == objects.end()) {
-        status = "404 Not Found";
-      } else {
-        size_t begin = 0;
-        if (headers.count("range")) {
-          ::sscanf(headers["range"].c_str(), "bytes=%zu-", &begin);
-          status = "206 Partial Content";
-        }
-        resp_body = it->second.substr(std::min(begin, it->second.size()));
-      }
-    } else if (method == "PUT") {
-      std::string key = path.substr(path.find('/', 1) + 1);
-      objects[key] = body;
-      extra_headers = "ETag: \"fake-etag\"\r\n";
-    } else {
-      status = "400 Bad Request";
-    }
+    req.path = target.substr(0, target.find('?'));
+    req.query = target.find('?') == std::string::npos
+                    ? "" : target.substr(target.find('?') + 1);
+    HttpReply reply;
+    Handle(req, &reply);
     std::ostringstream resp;
-    resp << "HTTP/1.1 " << status << "\r\n"
-         << extra_headers
-         << "Content-Length: " << resp_body.size() << "\r\nConnection: close\r\n\r\n"
-         << resp_body;
+    if (reply.head_no_body) {
+      resp << "HTTP/1.1 " << reply.status << "\r\n" << reply.extra_headers
+           << "Connection: close\r\n\r\n";
+    } else {
+      resp << "HTTP/1.1 " << reply.status << "\r\n" << reply.extra_headers
+           << "Content-Length: " << reply.body.size()
+           << "\r\nConnection: close\r\n\r\n" << reply.body;
+    }
     std::string out = resp.str();
     ::send(client, out.data(), out.size(), MSG_NOSIGNAL);
   }
@@ -233,7 +214,409 @@ class MiniS3Server {
   std::thread thread_;
 };
 
+/*! \brief %XX decode (mini servers decode like the real services do) */
+inline std::string UrlDecode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out.push_back(static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(s[i]);
+    }
+  }
+  return out;
+}
+
+/*! \brief "k1=v1&k2=v2" value lookup */
+inline std::string QueryParam(const std::string& query, const std::string& key) {
+  size_t at = 0;
+  while (at != std::string::npos) {
+    size_t eq = query.find('=', at);
+    if (eq == std::string::npos) break;
+    std::string k = query.substr(at, eq - at);
+    size_t end = query.find('&', eq);
+    if (k == key) {
+      return query.substr(eq + 1, end == std::string::npos ? std::string::npos
+                                                           : end - eq - 1);
+    }
+    at = end == std::string::npos ? std::string::npos : end + 1;
+  }
+  return "";
+}
+
+class MiniS3Server : public MiniHttpServer {
+ public:
+  ~MiniS3Server() override { Shutdown(); }
+  std::map<std::string, std::string> objects;  // key -> bytes (set before use)
+
+ protected:
+  void Handle(const HttpRequest& req, HttpReply* reply) override {
+    // requests must be SigV4-signed (presence check: full verification would
+    // duplicate the signer under test)
+    auto auth = req.headers.find("authorization");
+    if (auth == req.headers.end() ||
+        auth->second.find("AWS4-HMAC-SHA256") != 0) {
+      reply->status = "403 Forbidden";
+      reply->body = "<Error>missing sigv4</Error>";
+      return;
+    }
+    if (req.method == "GET" && req.query.find("prefix=") != std::string::npos) {
+      std::ostringstream xml;
+      xml << "<ListBucketResult>";
+      for (const auto& [key, bytes] : objects) {
+        xml << "<Contents><Key>" << key << "</Key><Size>" << bytes.size()
+            << "</Size></Contents>";
+      }
+      xml << "</ListBucketResult>";
+      reply->body = xml.str();
+    } else if (req.method == "GET") {
+      std::string key = req.path.substr(req.path.find('/', 1) + 1);  // /bkt/key
+      auto it = objects.find(key);
+      if (it == objects.end()) {
+        reply->status = "404 Not Found";
+      } else {
+        size_t begin = 0;
+        auto range = req.headers.find("range");
+        if (range != req.headers.end()) {
+          ::sscanf(range->second.c_str(), "bytes=%zu-", &begin);
+          reply->status = "206 Partial Content";
+        }
+        reply->body = it->second.substr(std::min(begin, it->second.size()));
+      }
+    } else if (req.method == "PUT") {
+      std::string key = req.path.substr(req.path.find('/', 1) + 1);
+      objects[key] = req.body;
+      reply->extra_headers = "ETag: \"fake-etag\"\r\n";
+    } else {
+      reply->status = "400 Bad Request";
+    }
+  }
+};
+
+class MiniWebHdfsServer : public MiniHttpServer {
+ public:
+  ~MiniWebHdfsServer() override { Shutdown(); }
+  std::map<std::string, std::string> files;  // hdfs path -> bytes
+  std::atomic<int> datanode_hits{0};
+
+ protected:
+  void Handle(const HttpRequest& req, HttpReply* reply) override {
+    TCHECK(req.path.rfind("/webhdfs/v1", 0) == 0) << "bad webhdfs path " << req.path;
+    std::string hpath = req.path.substr(11);
+    std::string op = QueryParam(req.query, "op");
+    std::string self = "http://127.0.0.1:" + std::to_string(port());
+    if (op == "GETFILESTATUS") {
+      auto it = files.find(hpath);
+      bool is_dir = false;
+      if (it == files.end()) {
+        for (const auto& [k, v] : files) {
+          if (k.rfind(hpath + "/", 0) == 0) is_dir = true;
+        }
+        if (!is_dir) {
+          reply->status = "404 Not Found";
+          reply->body = R"({"RemoteException":{"message":"not found"}})";
+          return;
+        }
+      }
+      size_t len = is_dir ? 0 : it->second.size();
+      reply->body = std::string(R"({"FileStatus":{"accessTime":0,"length":)") +
+                    std::to_string(len) + R"(,"type":")" +
+                    (is_dir ? "DIRECTORY" : "FILE") + R"(","owner":"u"}})";
+    } else if (op == "LISTSTATUS") {
+      std::string items;
+      for (const auto& [k, v] : files) {
+        if (k.rfind(hpath + "/", 0) != 0) continue;
+        std::string suffix = k.substr(hpath.size() + 1);
+        if (suffix.find('/') != std::string::npos) continue;  // direct children
+        if (!items.empty()) items += ",";
+        items += R"({"pathSuffix":")" + suffix + R"(","type":"FILE","length":)" +
+                 std::to_string(v.size()) + "}";
+      }
+      reply->body = R"({"FileStatuses":{"FileStatus":[)" + items + "]}}";
+    } else if (op == "OPEN" && QueryParam(req.query, "datanode").empty()) {
+      reply->body = R"({"Location":")" + self + req.path + "?" + req.query +
+                    R"(&datanode=1"})";
+    } else if (op == "OPEN") {
+      ++datanode_hits;
+      auto it = files.find(hpath);
+      if (it == files.end()) {
+        reply->status = "404 Not Found";
+      } else {
+        size_t offset = 0;
+        std::string off = QueryParam(req.query, "offset");
+        if (!off.empty()) offset = std::stoul(off);
+        reply->body = it->second.substr(std::min(offset, it->second.size()));
+      }
+    } else if ((op == "CREATE" || op == "APPEND") &&
+               QueryParam(req.query, "datanode").empty()) {
+      reply->body = R"({"Location":")" + self + req.path + "?" + req.query +
+                    R"(&datanode=1"})";
+    } else if (op == "CREATE") {
+      files[hpath] = req.body;
+      reply->status = "201 Created";
+    } else if (op == "APPEND") {
+      files[hpath] += req.body;
+    } else {
+      reply->status = "400 Bad Request";
+    }
+  }
+};
+
+class MiniAzureServer : public MiniHttpServer {
+ public:
+  ~MiniAzureServer() override { Shutdown(); }
+  std::map<std::string, std::string> blobs;  // "/account/container/name" -> bytes
+  std::map<std::string, std::map<std::string, std::string>> staged_blocks;
+  bool paginate = false;  // List Blobs: one blob per page + NextMarker
+
+ protected:
+  void Handle(const HttpRequest& req, HttpReply* reply) override {
+    bool authed = req.headers.count("authorization") &&
+                  req.headers.at("authorization").rfind("SharedKey ", 0) == 0 &&
+                  req.headers.count("x-ms-date") && req.headers.count("x-ms-version");
+    if (!authed) {
+      reply->status = "403 Forbidden";
+      return;
+    }
+    if (req.method == "GET" && req.query.find("comp=list") != std::string::npos) {
+      std::vector<std::pair<std::string, size_t>> names;
+      for (const auto& [key, bytes] : blobs) {
+        size_t third = key.find('/', key.find('/', 1) + 1);
+        names.emplace_back(key.substr(third + 1), bytes.size());
+      }
+      std::string marker = QueryParam(req.query, "marker");
+      size_t begin = 0;
+      if (!marker.empty()) begin = std::stoul(marker);
+      size_t end = paginate ? std::min(begin + 1, names.size()) : names.size();
+      std::ostringstream xml;
+      xml << "<EnumerationResults><Blobs>";
+      for (size_t i = begin; i < end; ++i) {
+        xml << "<Blob><Name>" << names[i].first
+            << "</Name><Properties><Content-Length>" << names[i].second
+            << "</Content-Length></Properties></Blob>";
+      }
+      xml << "</Blobs>";
+      if (end < names.size()) xml << "<NextMarker>" << end << "</NextMarker>";
+      xml << "</EnumerationResults>";
+      reply->body = xml.str();
+    } else if (req.method == "HEAD") {
+      auto it = blobs.find(req.path);
+      if (it == blobs.end()) {
+        reply->status = "404 Not Found";
+      } else {
+        reply->extra_headers =
+            "Content-Length: " + std::to_string(it->second.size()) + "\r\n";
+      }
+      reply->head_no_body = true;
+    } else if (req.method == "GET") {
+      auto it = blobs.find(req.path);
+      if (it == blobs.end()) {
+        reply->status = "404 Not Found";
+      } else {
+        size_t begin = 0;
+        auto range = req.headers.find("range");
+        if (range != req.headers.end()) {
+          ::sscanf(range->second.c_str(), "bytes=%zu-", &begin);
+          reply->status = "206 Partial Content";
+        }
+        reply->body = it->second.substr(std::min(begin, it->second.size()));
+      }
+    } else if (req.method == "PUT" && QueryParam(req.query, "comp") == "block") {
+      staged_blocks[req.path][UrlDecode(QueryParam(req.query, "blockid"))] = req.body;
+      reply->status = "201 Created";
+    } else if (req.method == "PUT" &&
+               QueryParam(req.query, "comp") == "blocklist") {
+      // assemble <Latest>id</Latest> in order
+      std::string assembled;
+      size_t at = 0;
+      while ((at = req.body.find("<Latest>", at)) != std::string::npos) {
+        at += 8;
+        size_t end = req.body.find("</Latest>", at);
+        assembled += staged_blocks[req.path][req.body.substr(at, end - at)];
+      }
+      blobs[req.path] = assembled;
+      reply->status = "201 Created";
+    } else if (req.method == "PUT") {
+      if (!req.headers.count("x-ms-blob-type")) {
+        reply->status = "400 Bad Request";
+      } else {
+        blobs[req.path] = req.body;
+        reply->status = "201 Created";
+      }
+    } else {
+      reply->status = "400 Bad Request";
+    }
+  }
+};
+
 }  // namespace
+
+TESTCASE(base64_rfc4648_vectors) {
+  EXPECT_EQV(crypto::Base64Encode(std::string("")), "");
+  EXPECT_EQV(crypto::Base64Encode(std::string("f")), "Zg==");
+  EXPECT_EQV(crypto::Base64Encode(std::string("fo")), "Zm8=");
+  EXPECT_EQV(crypto::Base64Encode(std::string("foo")), "Zm9v");
+  EXPECT_EQV(crypto::Base64Encode(std::string("foob")), "Zm9vYg==");
+  EXPECT_EQV(crypto::Base64Encode(std::string("fooba")), "Zm9vYmE=");
+  EXPECT_EQV(crypto::Base64Encode(std::string("foobar")), "Zm9vYmFy");
+  std::string out;
+  EXPECT_TRUE(crypto::Base64Decode("Zm9vYmFy", &out));
+  EXPECT_EQV(out, "foobar");
+  EXPECT_TRUE(crypto::Base64Decode("Zg==", &out));
+  EXPECT_EQV(out, "f");
+  EXPECT_TRUE(!crypto::Base64Decode("not!valid", &out));
+  // strict RFC 4648: reject unpadded tails, data after '=', dangling bits
+  EXPECT_TRUE(!crypto::Base64Decode("Zg", &out));    // length % 4 != 0
+  EXPECT_TRUE(!crypto::Base64Decode("Zg=", &out));   // bad padding width
+  EXPECT_TRUE(!crypto::Base64Decode("Z=g=", &out));  // data after '='
+  EXPECT_TRUE(!crypto::Base64Decode("Zh==", &out));  // nonzero leftover bits
+}
+
+TESTCASE(azure_sharedkey_golden_signature) {
+  // golden values computed with an independent implementation
+  // (python hmac/hashlib/base64) for this key/date/resource
+  io::AzureSharedKey signer;
+  signer.account = "acct";
+  signer.key_base64 = "c3VwZXJzZWNyZXRrZXkwMTIzNDU2Nzg5";  // "supersecretkey0123456789"
+  auto result = signer.Sign("GET", "/cont/blob.txt", {}, {}, 0,
+                            "Wed, 01 Jan 2025 00:00:00 GMT");
+  EXPECT_EQV(result.headers.at("Authorization"),
+             "SharedKey acct:yOCkBQfi627IoUkpDECz4iSGDQjIf//d2e61Y5ZAW6k=");
+  // string-to-sign shape: 12 newline-separated slots, then x-ms headers,
+  // then the canonical resource
+  EXPECT_TRUE(result.string_to_sign.rfind("GET\n", 0) == 0);
+  EXPECT_TRUE(result.string_to_sign.find(
+                  "x-ms-date:Wed, 01 Jan 2025 00:00:00 GMT\n") != std::string::npos);
+  EXPECT_TRUE(result.string_to_sign.find("/acct/cont/blob.txt") != std::string::npos);
+  // canonical resource appends sorted query as \nk:v lines
+  EXPECT_EQV(io::AzureSharedKey::CanonicalResource(
+                 "a", "/c", {{"restype", "container"}, {"comp", "list"}}),
+             "/a/c\ncomp:list\nrestype:container");
+}
+
+TESTCASE(azure_list_blobs_xml_parse) {
+  std::string xml = R"(<?xml version="1.0"?>
+<EnumerationResults><Blobs>
+  <Blob><Name>data/part-000</Name><Properties><Content-Length>4096</Content-Length></Properties></Blob>
+  <Blob><Name>data/part-001</Name><Properties><Content-Length>128</Content-Length></Properties></Blob>
+  <BlobPrefix><Name>data/nested/</Name></BlobPrefix>
+</Blobs></EnumerationResults>)";
+  std::vector<io::FileInfo> files;
+  std::vector<std::string> prefixes;
+  io::AzureFileSystem::ParseListBlobs(xml, "azure://cont/", &files, &prefixes);
+  EXPECT_EQV(files.size(), 2u);
+  EXPECT_EQV(files[0].path.name, "/data/part-000");
+  EXPECT_EQV(files[0].size, 4096u);
+  EXPECT_EQV(prefixes.size(), 1u);
+  EXPECT_EQV(prefixes[0], "data/nested/");
+}
+
+TESTCASE(webhdfs_roundtrip_against_mini_server) {
+  MiniWebHdfsServer server;
+  ::setenv("DMLCTPU_WEBHDFS_ADDR",
+           ("127.0.0.1:" + std::to_string(server.port())).c_str(), 1);
+  std::string payload;
+  for (int i = 0; i < 5000; ++i) payload += "hdfs-rec-" + std::to_string(i) + "\n";
+  server.files["/data/train.txt"] = payload;
+  server.files["/data/other.txt"] = "abc";
+
+  // stat through the generic dispatch
+  auto* fs = io::FileSystem::GetInstance(io::URI("hdfs://nn/data/train.txt"));
+  io::FileInfo info = fs->GetPathInfo(io::URI("hdfs://nn/data/train.txt"));
+  EXPECT_EQV(info.size, payload.size());
+  EXPECT_TRUE(info.type == io::FileType::kFile);
+  EXPECT_TRUE(fs->GetPathInfo(io::URI("hdfs://nn/data")).type ==
+              io::FileType::kDirectory);
+
+  // whole read + ranged re-read (OPEN with offset through the 2-step hop)
+  auto in = SeekStream::CreateForRead("hdfs://nn/data/train.txt");
+  std::string got(payload.size(), '\0');
+  in->ReadAll(got.data(), got.size());
+  EXPECT_TRUE(got == payload);
+  in->Seek(payload.size() - 7);
+  char tail[7];
+  in->ReadAll(tail, 7);
+  EXPECT_EQV(std::string(tail, 7), payload.substr(payload.size() - 7));
+  EXPECT_TRUE(server.datanode_hits.load() >= 2);
+
+  // listing
+  std::vector<io::FileInfo> listing;
+  fs->ListDirectory(io::URI("hdfs://nn/data"), &listing);
+  EXPECT_EQV(listing.size(), 2u);
+
+  // write: CREATE + APPEND via buffered stream
+  {
+    auto out = Stream::Create("hdfs://nn/out/model.bin", "w");
+    out->Write(payload.data(), 2048);
+  }
+  EXPECT_EQV(server.files.at("/out/model.bin").size(), 2048u);
+  {
+    auto out = Stream::Create("hdfs://nn/out/model.bin", "a");
+    out->Write("tail", 4);
+  }
+  EXPECT_EQV(server.files.at("/out/model.bin").size(), 2052u);
+  ::unsetenv("DMLCTPU_WEBHDFS_ADDR");
+}
+
+TESTCASE(azure_roundtrip_against_mini_server) {
+  MiniAzureServer server;
+  ::setenv("AZURE_STORAGE_ACCOUNT", "acct", 1);
+  ::setenv("AZURE_STORAGE_ACCESS_KEY", "c3VwZXJzZWNyZXRrZXkwMTIzNDU2Nzg5", 1);
+  ::setenv("DMLCTPU_AZURE_ENDPOINT",
+           ("http://127.0.0.1:" + std::to_string(server.port())).c_str(), 1);
+  std::string payload;
+  for (int i = 0; i < 4000; ++i) payload += "azure-rec-" + std::to_string(i) + "\n";
+  server.blobs["/acct/cont/data/train.txt"] = payload;
+
+  auto in = SeekStream::CreateForRead("azure://cont/data/train.txt");
+  std::string got(payload.size(), '\0');
+  in->ReadAll(got.data(), got.size());
+  EXPECT_TRUE(got == payload);
+  in->Seek(payload.size() - 5);
+  char tail[5];
+  in->ReadAll(tail, 5);
+  EXPECT_EQV(std::string(tail, 5), payload.substr(payload.size() - 5));
+
+  {
+    auto out = Stream::Create("azure://cont/out/model.bin", "w");
+    out->Write(payload.data(), 512);
+  }
+  EXPECT_EQV(server.blobs.at("/acct/cont/out/model.bin").size(), 512u);
+
+  std::vector<io::FileInfo> listing;
+  io::AzureFileSystem::GetInstance()->ListDirectory(io::URI("azure://cont/data"),
+                                                    &listing);
+  EXPECT_TRUE(!listing.empty());
+
+  // virtual directory prefix stats as a directory (no marker blob needed)
+  io::FileInfo dir =
+      io::AzureFileSystem::GetInstance()->GetPathInfo(io::URI("azure://cont/data"));
+  EXPECT_TRUE(dir.type == io::FileType::kDirectory);
+
+  // paginated listing walks NextMarker pages to completion
+  server.paginate = true;
+  std::vector<io::FileInfo> paged;
+  io::AzureFileSystem::GetInstance()->ListDirectory(io::URI("azure://cont/"),
+                                                    &paged);
+  EXPECT_EQV(paged.size(), server.blobs.size());
+  server.paginate = false;
+
+  // large write goes through Put Block / Put Block List and reassembles
+  ::setenv("DMLCTPU_AZURE_WRITE_BUFFER_MB", "1", 1);
+  std::string big;
+  while (big.size() < (5u << 20) / 2) big += payload;  // ~2.5 MB
+  {
+    auto out = Stream::Create("azure://cont/out/big.bin", "w");
+    // write in two chunks so one flush happens mid-stream
+    out->Write(big.data(), big.size() / 2);
+    out->Write(big.data() + big.size() / 2, big.size() - big.size() / 2);
+  }
+  EXPECT_TRUE(server.staged_blocks.size() >= 1u);
+  EXPECT_EQV(server.blobs.at("/acct/cont/out/big.bin"), big);
+  ::unsetenv("DMLCTPU_AZURE_WRITE_BUFFER_MB");
+  ::unsetenv("DMLCTPU_AZURE_ENDPOINT");
+}
 
 TESTCASE(s3_roundtrip_against_mini_server) {
   MiniS3Server server;
