@@ -121,17 +121,38 @@ def pick_backend():
     return jax, jax.devices()[0].platform
 
 
-def run_parse(data: Path, repeats: int = 3) -> dict:
+def make_csv_dataset() -> Path:
+    """Higgs-style dense CSV: label + 28 float features per row."""
+    CACHE.mkdir(parents=True, exist_ok=True)
+    path = CACHE / f"higgs_{DATA_MB}mb.csv"
+    if path.exists() and path.stat().st_size >= DATA_MB << 20:
+        return path
+    import numpy as np
+    rng = np.random.default_rng(7)
+    target = DATA_MB << 20
+    with open(path, "w") as f:
+        written = 0
+        while written < target:
+            rows = rng.random((2048, 29), dtype=np.float32)
+            rows[:, 0] = (rows[:, 0] > 0.5)
+            chunk = "\n".join(",".join(f"{x:.6f}" for x in r) for r in rows) + "\n"
+            f.write(chunk)
+            written += len(chunk)
+    return path
+
+
+def run_parse(data: Path, fmt: str = "libsvm", repeats: int = 3) -> dict:
     """Our native parse -> RowBlock drain: the reference instrument, 1:1."""
     import ctypes
 
     from dmlc_core_tpu._native import RowBlockC, check, lib
     L = lib()
+    uri = str(data) if fmt == "libsvm" else f"{data}?format={fmt}&label_column=0"
+    ptype = b"libsvm" if fmt == "libsvm" else b"auto"
     best = {"mb_s": 0.0}
     for _ in range(repeats):
         h = ctypes.c_void_p()
-        check(L.DmlcTpuParserCreate(str(data).encode(), 0, 1, b"libsvm",
-                                    ctypes.byref(h)))
+        check(L.DmlcTpuParserCreate(uri.encode(), 0, 1, ptype, ctypes.byref(h)))
         check(L.DmlcTpuParserBeforeFirst(h))
         c = RowBlockC()
         t0 = time.monotonic()
@@ -147,13 +168,30 @@ def run_parse(data: Path, repeats: int = 3) -> dict:
     return best
 
 
-def run_staging(data: Path) -> dict:
+def run_allreduce() -> dict | None:
+    """BASELINE config 4: psum bandwidth over the device mesh (the rabit
+    tree/ring-allreduce equivalent).  Needs >1 device to be meaningful."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return None
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from dmlc_core_tpu.parallel.collective import allreduce_bench
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    return allreduce_bench(mesh, mib_per_device=16.0, iters=5)
+
+
+def run_staging(data: Path, fmt: str = "auto") -> dict:
     """Extra: the full native parse -> pad -> HBM staging path."""
     jax, platform = pick_backend()
     from dmlc_core_tpu.data import DeviceStagingIter
 
+    uri = str(data) if fmt == "auto" else f"{data}?format={fmt}&label_column=0"
+
     def drain() -> dict:
-        it = DeviceStagingIter(str(data), batch_size=65536, nnz_bucket=1 << 18)
+        it = DeviceStagingIter(uri, batch_size=65536, nnz_bucket=1 << 18)
         t0 = time.monotonic()
         rows = 0
         last = None
@@ -185,10 +223,18 @@ def main() -> None:
 
     parse = run_parse(data)
     log(f"[bench] ours parse->RowBlock: {parse['mb_s']:.1f} MB/s")
+    csv_data = make_csv_dataset()
+    csv_parse = run_parse(csv_data, fmt="csv")
+    log(f"[bench] ours csv parse: {csv_parse['mb_s']:.1f} MB/s")
     staging = run_staging(data)
     log(f"[bench] ours parse->pad->HBM: {staging['mb_s']:.1f} MB/s, "
         f"{staging['rows_s']:.0f} rows/s -> {staging['platform']} "
         f"({staging['rows']} rows)")
+    csv_staging = run_staging(csv_data, fmt="csv")
+    log(f"[bench] ours csv->HBM prefetch: {csv_staging['mb_s']:.1f} MB/s")
+    allreduce = run_allreduce()
+    if allreduce:
+        log(f"[bench] allreduce: {allreduce}")
 
     vs = (parse["mb_s"] / ref_rate) if ref_rate else None
     print(json.dumps({
@@ -200,6 +246,10 @@ def main() -> None:
         "staging_to_hbm_mb_s": round(staging["mb_s"], 2),
         "staging_rows_per_sec": round(staging["rows_s"]),
         "staging_platform": staging["platform"],
+        "csv_parse_mb_s": round(csv_parse["mb_s"], 2),
+        "csv_staging_to_hbm_mb_s": round(csv_staging["mb_s"], 2),
+        "allreduce_bus_gbps": (round(allreduce["bus_gbps"], 2)
+                               if allreduce else None),
         "data_mb": data.stat().st_size >> 20,
     }))
 

@@ -7,11 +7,13 @@
 #ifndef DMLCTPU_JSON_H_
 #define DMLCTPU_JSON_H_
 
+#include <any>
 #include <cctype>
 #include <charconv>
 #include <cmath>
 #include <cstdio>
 #include <functional>
+#include <typeindex>
 #include <istream>
 #include <limits>
 #include <map>
@@ -201,7 +203,9 @@ class JSONReader {
   std::string ReadBareWord() {
     std::string w;
     int ch;
-    while ((ch = is_->peek()) != EOF && std::isalpha(ch)) w.push_back(static_cast<char>(NextChar()));
+    while ((ch = is_->peek()) != EOF && std::isalpha(ch)) {
+      w.push_back(static_cast<char>(NextChar()));
+    }
     return w;
   }
   std::string ReadNumericToken() {
@@ -355,6 +359,11 @@ class JSONWriter {
 // ---- generic typed Read/Write ---------------------------------------------
 namespace json {
 
+// declared ahead of the compound templates (vector/map/pair) so two-phase
+// lookup finds them when those templates hold std::any members
+inline void WriteValue(JSONWriter* w, const std::any& v);
+inline void ReadValue(JSONReader* r, std::any* v);
+
 template <typename T>
 inline void WriteValue(JSONWriter* w, const T& v) {
   if constexpr (std::is_same_v<T, std::string>) {
@@ -467,6 +476,84 @@ template <typename T>
 inline void JSONWriter::Write(const T& value) {
   json::WriteValue(this, value);
 }
+
+/*!
+ * \brief std::any <-> JSON bridge (parity: reference json.h AnyJSONManager
+ *        :532).  Types opt in via EnableType<T>("name"); an any is stored as
+ *        the 2-element array ["name", value].
+ */
+class AnyJSONManager {
+ public:
+  static AnyJSONManager* Global() {
+    static AnyJSONManager inst;
+    return &inst;
+  }
+  template <typename T>
+  AnyJSONManager& EnableType(const std::string& name) {
+    std::type_index tid(typeid(T));
+    auto it = type_names_.find(tid);
+    if (it != type_names_.end()) {
+      TCHECK_EQ(it->second, name)
+          << "AnyJSONManager: type already enabled as '" << it->second << "'";
+      return *this;
+    }
+    type_names_[tid] = name;
+    Entry e;
+    e.write = [](JSONWriter* w, const std::any& v) {
+      json::WriteValue(w, std::any_cast<const T&>(v));
+    };
+    e.read = [](JSONReader* r, std::any* v) {
+      T out{};
+      json::ReadValue(r, &out);
+      *v = std::move(out);
+    };
+    entries_[name] = std::move(e);
+    return *this;
+  }
+
+  void Write(JSONWriter* w, const std::any& v) {
+    auto name_it = type_names_.find(std::type_index(v.type()));
+    TCHECK(name_it != type_names_.end())
+        << "AnyJSONManager: type " << v.type().name()
+        << " not enabled (call EnableType<T> first)";
+    w->BeginArray();
+    w->BeginArrayItem();
+    w->WriteString(name_it->second);
+    w->BeginArrayItem();
+    entries_[name_it->second].write(w, v);
+    w->EndArray();
+  }
+  void Read(JSONReader* r, std::any* v) {
+    r->BeginArray();
+    TCHECK(r->NextArrayItem()) << "AnyJSONManager: expected [\"type\", value]";
+    std::string name;
+    r->ReadString(&name);
+    auto it = entries_.find(name);
+    TCHECK(it != entries_.end())
+        << "AnyJSONManager: type '" << name << "' not enabled";
+    TCHECK(r->NextArrayItem()) << "AnyJSONManager: missing value";
+    it->second.read(r, v);
+    TCHECK(!r->NextArrayItem()) << "AnyJSONManager: trailing items";
+  }
+
+ private:
+  struct Entry {
+    std::function<void(JSONWriter*, const std::any&)> write;
+    std::function<void(JSONReader*, std::any*)> read;
+  };
+  AnyJSONManager() = default;
+  std::unordered_map<std::type_index, std::string> type_names_;
+  std::map<std::string, Entry> entries_;
+};
+
+namespace json {
+inline void WriteValue(JSONWriter* w, const std::any& v) {
+  AnyJSONManager::Global()->Write(w, v);
+}
+inline void ReadValue(JSONReader* r, std::any* v) {
+  AnyJSONManager::Global()->Read(r, v);
+}
+}  // namespace json
 
 /*!
  * \brief declarative reader for JSON objects whose members map to struct

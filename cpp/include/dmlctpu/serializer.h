@@ -141,7 +141,9 @@ struct AssocHandler {
 
 template <typename K, typename V, typename C, typename A>
 struct Handler<std::map<K, V, C, A>> {
-  static void Write(Stream* s, const std::map<K, V, C, A>& m) { AssocHandler<std::map<K, V, C, A>>::Write(s, m); }
+  static void Write(Stream* s, const std::map<K, V, C, A>& m) {
+    AssocHandler<std::map<K, V, C, A>>::Write(s, m);
+  }
   static bool Read(Stream* s, std::map<K, V, C, A>* m) {
     uint64_t n;
     if (!Handler<uint64_t>::Read(s, &n)) return false;
@@ -173,7 +175,9 @@ struct Handler<std::unordered_map<K, V, H, E, A>> {
 };
 template <typename K, typename C, typename A>
 struct Handler<std::set<K, C, A>> {
-  static void Write(Stream* s, const std::set<K, C, A>& c) { AssocHandler<std::set<K, C, A>>::Write(s, c); }
+  static void Write(Stream* s, const std::set<K, C, A>& c) {
+    AssocHandler<std::set<K, C, A>>::Write(s, c);
+  }
   static bool Read(Stream* s, std::set<K, C, A>* c) {
     uint64_t n;
     if (!Handler<uint64_t>::Read(s, &n)) return false;
@@ -205,7 +209,9 @@ struct Handler<std::unordered_set<K, H, E, A>> {
 };
 template <typename T, typename A>
 struct Handler<std::list<T, A>> {
-  static void Write(Stream* s, const std::list<T, A>& c) { SeqHandler<std::list<T, A>>::Write(s, c); }
+  static void Write(Stream* s, const std::list<T, A>& c) {
+    SeqHandler<std::list<T, A>>::Write(s, c);
+  }
   static bool Read(Stream* s, std::list<T, A>* c) {
     uint64_t n;
     if (!Handler<uint64_t>::Read(s, &n)) return false;

@@ -49,65 +49,66 @@ class CSVParser : public TextParserBase<IndexType, DType> {
   }
 
  protected:
+  // Single-pass hot loop: cells are tokenized in place (no line-end or
+  // cell-end pre-scan, which would touch every byte twice).
   void ParseBlock(const char* begin, const char* end,
                   RowBlockContainer<IndexType, DType>* out) override {
     out->Clear();
     const char* p = begin;
-    while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
     while (p != end) {
-      const char* line_end = p;
-      while (line_end != end && *line_end != '\n' && *line_end != '\r' &&
-             *line_end != '\0') {
-        ++line_end;
-      }
-      ParseLine(p, line_end, out);
-      p = line_end;
       while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+      if (p == end) break;
+      int column = 0;
+      IndexType feat = 0;
+      DType label = DType(0);
+      real_t weight = std::numeric_limits<real_t>::quiet_NaN();
+      bool any_field = false;
+      bool line_done = false;
+      while (!line_done) {
+        // intra-cell blank skip — but never across the delimiter itself
+        // (a tab delimiter must still delimit empty cells)
+        while (p != end && (*p == ' ' || *p == '\t') && *p != delim_) ++p;
+        DType v{};
+        bool has_value = TryParseNumToken(&p, end, &v);
+        // advance to the cell boundary (tolerates trailing junk in the cell)
+        while (p != end && *p != delim_ && *p != '\n' && *p != '\r' && *p != '\0') {
+          ++p;
+        }
+        if (column == param_.label_column) {
+          if (has_value) label = v;
+        } else if (std::is_same_v<DType, real_t> && column == param_.weight_column) {
+          if (has_value) weight = static_cast<real_t>(v);
+        } else {
+          if (has_value) {
+            out->value.push_back(v);
+            out->index.push_back(feat);
+            out->max_index = std::max(out->max_index, feat);
+          }
+          ++feat;  // missing cells still advance the feature position
+          any_field = true;
+        }
+        ++column;
+        if (p != end && *p == delim_) {
+          ++p;  // next cell of the same line
+        } else {
+          line_done = true;
+        }
+      }
+      TCHECK(any_field || param_.label_column >= 0)
+          << "csv line with no parseable field (check the delimiter '" << delim_
+          << "')";
+      out->label.push_back(static_cast<real_t>(label));
+      if (!std::isnan(weight)) {
+        if (out->weight.size() + 1 < out->label.size()) {
+          out->weight.resize(out->label.size() - 1, 1.0f);
+        }
+        out->weight.push_back(weight);
+      }
+      out->offset.push_back(out->index.size());
     }
   }
 
  private:
-  void ParseLine(const char* p, const char* end, RowBlockContainer<IndexType, DType>* out) {
-    int column = 0;
-    IndexType feat = 0;
-    DType label = DType(0);
-    real_t weight = std::numeric_limits<real_t>::quiet_NaN();
-    bool any_field = false;
-    while (true) {
-      // one cell: [p, cell_end)
-      const char* cell_end = p;
-      while (cell_end != end && *cell_end != delim_) ++cell_end;
-      DType v{};
-      const char* q = p;
-      bool has_value = TryParseNum(&q, cell_end, &v);
-      if (column == param_.label_column) {
-        if (has_value) label = v;
-      } else if (std::is_same_v<DType, real_t> && column == param_.weight_column) {
-        if (has_value) weight = static_cast<real_t>(v);
-      } else {
-        if (has_value) {
-          out->value.push_back(v);
-          out->index.push_back(feat);
-          out->max_index = std::max(out->max_index, feat);
-        }
-        ++feat;  // missing cells still advance the feature position
-        any_field = true;
-      }
-      ++column;
-      if (cell_end == end) break;
-      p = cell_end + 1;
-    }
-    TCHECK(any_field || param_.label_column >= 0)
-        << "csv line with no parseable field (check the delimiter '" << delim_ << "')";
-    out->label.push_back(static_cast<real_t>(label));
-    if (!std::isnan(weight)) {
-      if (out->weight.size() + 1 < out->label.size()) {
-        out->weight.resize(out->label.size() - 1, 1.0f);
-      }
-      out->weight.push_back(weight);
-    }
-    out->offset.push_back(out->index.size());
-  }
 
   CSVParserParam param_;
   char delim_ = ',';

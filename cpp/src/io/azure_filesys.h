@@ -28,9 +28,12 @@ struct AzureSharedKey {
   std::string account;
   std::string key_base64;
 
-  /*! \brief canonicalized resource: /account/path + sorted \nk:v query lines */
+  /*! \brief canonicalized resource: "/" + account + decoded URL path +
+   *         sorted \nk:v query lines.  With path-style/emulator addressing
+   *         the URL path itself starts with "/account", so the account name
+   *         appears twice — matching what the service recomputes. */
   static std::string CanonicalResource(
-      const std::string& account, const std::string& path,
+      const std::string& account, const std::string& url_path,
       const std::map<std::string, std::string>& query);
 
   struct Signed {
@@ -39,12 +42,11 @@ struct AzureSharedKey {
   };
   /*!
    * \brief sign a request (service version 2021-08-06 string-to-sign).
-   * \param resource_path the "/container/blob" part — WITHOUT any emulator
-   *        "/account" URL prefix; the canonical resource is always
-   *        "/" + account + resource_path regardless of addressing style
+   * \param url_path the request path as sent on the wire, decoded — for
+   *        this build's path-style endpoints that is "/account/container/blob"
    * \param ms_date RFC1123 date (caller-supplied for testability)
    */
-  Signed Sign(const std::string& method, const std::string& resource_path,
+  Signed Sign(const std::string& method, const std::string& url_path,
               const std::map<std::string, std::string>& query,
               std::map<std::string, std::string> headers,
               size_t content_length, const std::string& ms_date) const;

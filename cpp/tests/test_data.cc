@@ -154,7 +154,8 @@ TESTCASE(csv_custom_delimiter_and_int_dtypes) {
   TemporaryDirectory tmp;
   std::string f = tmp.path + "/b.csv";
   WriteFile(f, "7\t100\t-5\n3\t200\t9\n");
-  std::string uri = f + "?format=csv&label_column=0&delimiter=%09";  // not url-decoded; use tab directly
+  // delimiter value is not url-decoded; pass the tab char via %09 spelling
+  std::string uri = f + "?format=csv&label_column=0&delimiter=%09";
   // use a literal tab in the arg instead
   uri = f + "?format=csv&label_column=0&delimiter=\t";
   auto parser = Parser<uint32_t, int64_t>::Create(uri.c_str(), 0, 1, "auto");

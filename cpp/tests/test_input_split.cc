@@ -11,6 +11,7 @@
 #include <string>
 #include <vector>
 
+#include "../src/io/single_file_split.h"
 #include "dmlctpu/input_split.h"
 #include "dmlctpu/input_split_shuffle.h"
 #include "dmlctpu/io/filesystem.h"
@@ -289,6 +290,31 @@ TESTCASE(shuffle_wrapper_coarse_shuffle) {
   EXPECT_TRUE(std::multiset<std::string>(out.begin(), out.end()) ==
               std::multiset<std::string>(lines.begin(), lines.end()));
   EXPECT_TRUE(out != lines);  // order must differ (8 shuffled sub-splits)
+}
+
+TESTCASE(single_file_split_records_and_reset) {
+  // parity: reference src/io/single_file_split.h (stdin / single-FILE
+  // fallback, no partitioning) — driven here through a regular file
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/single.txt";
+  WriteFile(f, "alpha\nbeta\ngamma");  // NOEOL final record
+  io::SingleFileSplit split(f.c_str());
+  std::vector<std::string> records;
+  InputSplit::Blob blob;
+  while (split.NextRecord(&blob)) {
+    records.emplace_back(static_cast<const char*>(blob.dptr), blob.size);
+  }
+  EXPECT_EQV(records.size(), 3u);
+  EXPECT_EQV(records[0], "alpha");
+  EXPECT_EQV(records[2], "gamma");
+  // second epoch after BeforeFirst
+  split.BeforeFirst();
+  size_t again = 0;
+  while (split.NextRecord(&blob)) ++again;
+  EXPECT_EQV(again, 3u);
+  // only partition (0, 1) is valid
+  split.ResetPartition(0, 1);
+  EXPECT_THROWS(split.ResetPartition(1, 2));
 }
 
 TESTMAIN()

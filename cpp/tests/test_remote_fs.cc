@@ -479,16 +479,18 @@ TESTCASE(azure_sharedkey_golden_signature) {
   io::AzureSharedKey signer;
   signer.account = "acct";
   signer.key_base64 = "c3VwZXJzZWNyZXRrZXkwMTIzNDU2Nzg5";  // "supersecretkey0123456789"
-  auto result = signer.Sign("GET", "/cont/blob.txt", {}, {}, 0,
+  // Sign takes the wire path (path-style => account appears again inside)
+  auto result = signer.Sign("GET", "/acct/cont/blob.txt", {}, {}, 0,
                             "Wed, 01 Jan 2025 00:00:00 GMT");
   EXPECT_EQV(result.headers.at("Authorization"),
-             "SharedKey acct:yOCkBQfi627IoUkpDECz4iSGDQjIf//d2e61Y5ZAW6k=");
+             "SharedKey acct:MPkOTvhyfWhSDugF7Ux6R9X/ZoVnNWhmeTSEoMI6u4U=");
   // string-to-sign shape: 12 newline-separated slots, then x-ms headers,
   // then the canonical resource
   EXPECT_TRUE(result.string_to_sign.rfind("GET\n", 0) == 0);
   EXPECT_TRUE(result.string_to_sign.find(
                   "x-ms-date:Wed, 01 Jan 2025 00:00:00 GMT\n") != std::string::npos);
-  EXPECT_TRUE(result.string_to_sign.find("/acct/cont/blob.txt") != std::string::npos);
+  EXPECT_TRUE(result.string_to_sign.find("/acct/acct/cont/blob.txt") !=
+              std::string::npos);  // canonical resource doubles the account
   // canonical resource appends sorted query as \nk:v lines
   EXPECT_EQV(io::AzureSharedKey::CanonicalResource(
                  "a", "/c", {{"restype", "container"}, {"comp", "list"}}),
@@ -498,8 +500,10 @@ TESTCASE(azure_sharedkey_golden_signature) {
 TESTCASE(azure_list_blobs_xml_parse) {
   std::string xml = R"(<?xml version="1.0"?>
 <EnumerationResults><Blobs>
-  <Blob><Name>data/part-000</Name><Properties><Content-Length>4096</Content-Length></Properties></Blob>
-  <Blob><Name>data/part-001</Name><Properties><Content-Length>128</Content-Length></Properties></Blob>
+  <Blob><Name>data/part-000</Name>
+    <Properties><Content-Length>4096</Content-Length></Properties></Blob>
+  <Blob><Name>data/part-001</Name>
+    <Properties><Content-Length>128</Content-Length></Properties></Blob>
   <BlobPrefix><Name>data/nested/</Name></BlobPrefix>
 </Blobs></EnumerationResults>)";
   std::vector<io::FileInfo> files;

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Repo lint (parity: reference scripts/lint.py, which drives cpplint+pylint).
+
+This image has neither cpplint nor pylint baked in, so the same gate is
+built from what is available:
+  * python: py_compile every file (syntax), plus pyflakes when importable
+  * C++: header-guard consistency, no tabs, no trailing whitespace,
+    100-char line limit, #pragma once ban (guards match reference style)
+
+Exit code is nonzero on any finding; run as `python scripts/lint.py`.
+"""
+from __future__ import annotations
+
+import py_compile
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+MAX_LINE = 100
+
+
+def lint_python() -> list[str]:
+    errors: list[str] = []
+    files = [p for p in REPO.rglob("*.py")
+             if ".git" not in p.parts and "build" not in p.parts]
+    for path in files:
+        try:
+            py_compile.compile(str(path), doraise=True)
+        except py_compile.PyCompileError as e:
+            errors.append(f"{path}: {e.msg}")
+    try:
+        from pyflakes import api as pyflakes_api
+        from pyflakes.reporter import Reporter
+        import io
+        out, err = io.StringIO(), io.StringIO()
+        for path in files:
+            pyflakes_api.checkPath(str(path), Reporter(out, err))
+        errors += [line for line in out.getvalue().splitlines()
+                   # ctypes star-imports and intentional re-exports are fine
+                   if "unable to detect undefined names" not in line
+                   and "imported but unused" not in line]
+    except ImportError:
+        pass
+    return errors
+
+
+GUARD_RE = re.compile(r"#ifndef\s+(DMLCTPU_[A-Z0-9_]+_H_)")
+
+
+def lint_cpp() -> list[str]:
+    errors: list[str] = []
+    for path in list(REPO.glob("cpp/**/*.h")) + list(REPO.glob("cpp/**/*.cc")):
+        rel = path.relative_to(REPO)
+        text = path.read_text()
+        if path.suffix == ".h":
+            m = GUARD_RE.search(text)
+            if not m:
+                errors.append(f"{rel}: missing DMLCTPU_*_H_ include guard")
+            elif f"#define {m.group(1)}" not in text:
+                errors.append(f"{rel}: guard #define does not match #ifndef")
+            if "#pragma once" in text:
+                errors.append(f"{rel}: use include guards, not #pragma once")
+        for i, line in enumerate(text.splitlines(), 1):
+            if "\t" in line:
+                errors.append(f"{rel}:{i}: tab character")
+            if line != line.rstrip():
+                errors.append(f"{rel}:{i}: trailing whitespace")
+            if len(line) > MAX_LINE:
+                errors.append(f"{rel}:{i}: line longer than {MAX_LINE} chars")
+    return errors
+
+
+def main() -> int:
+    errors = lint_python() + lint_cpp()
+    for e in errors:
+        print(e)
+    print(f"lint: {len(errors)} finding(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
