@@ -1,0 +1,260 @@
+// dmlctpu/lua.h — optional header-only Lua interop bridge.
+// Parity: reference include/dmlc/lua.h (LuaState/LuaRef embedding for
+// Torch-era scripting interop; optional, requires liblua at build time).
+// Fresh design against the Lua 5.3+ C API: RAII state + registry-anchored
+// references, typed conversions, table iteration, and function calls.
+//
+// OPTIONAL COMPONENT: compiles only where Lua headers are installed —
+// define DMLCTPU_USE_LUA=1 and link -llua.  This image ships no liblua, so
+// the component is excluded from the default build and CI; the primary
+// embedding/interop surface of this library is the Python ctypes layer
+// (dmlc_core_tpu/_native.py), which supersedes the Lua bridge for every
+// modern use.
+#ifndef DMLCTPU_LUA_H_
+#define DMLCTPU_LUA_H_
+
+#if !defined(DMLCTPU_USE_LUA) || !DMLCTPU_USE_LUA
+#error "dmlctpu/lua.h is optional: define DMLCTPU_USE_LUA=1 and link -llua"
+#endif
+
+extern "C" {
+#include <lauxlib.h>
+#include <lua.h>
+#include <lualib.h>
+}
+
+#include <map>
+#include <memory>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include "./logging.h"
+
+namespace dmlctpu {
+
+class LuaRef;
+
+/*! \brief an owned Lua interpreter state with stdlib loaded */
+class LuaState {
+ public:
+  LuaState() : L_(luaL_newstate()) {
+    TCHECK(L_ != nullptr) << "lua: cannot allocate interpreter state";
+    luaL_openlibs(L_);
+  }
+  ~LuaState() {
+    if (L_ != nullptr) lua_close(L_);
+  }
+  LuaState(const LuaState&) = delete;
+  LuaState& operator=(const LuaState&) = delete;
+
+  /*! \brief one interpreter per thread (parity: reference ThreadLocalState) */
+  static LuaState* ThreadLocalState() {
+    static thread_local LuaState state;
+    return &state;
+  }
+
+  /*! \brief run a chunk of Lua source; FATAL with the Lua error on failure */
+  void Eval(const std::string& code) {
+    if (luaL_loadstring(L_, code.c_str()) != LUA_OK ||
+        lua_pcall(L_, 0, 0, 0) != LUA_OK) {
+      std::string err = lua_tostring(L_, -1);
+      lua_pop(L_, 1);
+      TLOG(Fatal) << "lua: " << err;
+    }
+  }
+
+  /*! \brief evaluate an expression and return its (single) result */
+  inline LuaRef EvalExpr(const std::string& expr);
+  /*! \brief fetch a global by name */
+  inline LuaRef GetGlobal(const std::string& name);
+
+  template <typename T>
+  void SetGlobal(const std::string& name, const T& value) {
+    Push(value);
+    lua_setglobal(L_, name.c_str());
+  }
+
+  lua_State* handle() { return L_; }
+
+  // ---- stack push helpers ---------------------------------------------------
+  void Push(bool v) { lua_pushboolean(L_, v ? 1 : 0); }
+  void Push(int v) { lua_pushinteger(L_, v); }
+  void Push(int64_t v) { lua_pushinteger(L_, static_cast<lua_Integer>(v)); }
+  void Push(double v) { lua_pushnumber(L_, v); }
+  void Push(const char* v) { lua_pushstring(L_, v); }
+  void Push(const std::string& v) { lua_pushlstring(L_, v.data(), v.size()); }
+  template <typename T>
+  void Push(const std::vector<T>& v) {
+    lua_createtable(L_, static_cast<int>(v.size()), 0);
+    for (size_t i = 0; i < v.size(); ++i) {
+      Push(v[i]);
+      lua_rawseti(L_, -2, static_cast<lua_Integer>(i + 1));  // 1-based
+    }
+  }
+
+ private:
+  lua_State* L_;
+};
+
+/*!
+ * \brief a value anchored in the Lua registry (survives stack unwinds);
+ *        copyable via registry re-reference
+ */
+class LuaRef {
+ public:
+  LuaRef() = default;
+  /*! \brief pops the value currently on top of the stack and anchors it */
+  LuaRef(LuaState* state, bool pop_from_stack) : state_(state) {
+    (void)pop_from_stack;
+    ref_ = luaL_ref(state_->handle(), LUA_REGISTRYINDEX);
+  }
+  ~LuaRef() { Release(); }
+  LuaRef(LuaRef&& other) noexcept : state_(other.state_), ref_(other.ref_) {
+    other.state_ = nullptr;
+    other.ref_ = LUA_NOREF;
+  }
+  LuaRef& operator=(LuaRef&& other) noexcept {
+    if (this != &other) {
+      Release();
+      state_ = other.state_;
+      ref_ = other.ref_;
+      other.state_ = nullptr;
+      other.ref_ = LUA_NOREF;
+    }
+    return *this;
+  }
+  LuaRef(const LuaRef& other) { *this = other; }
+  LuaRef& operator=(const LuaRef& other) {
+    if (this != &other) {
+      Release();
+      state_ = other.state_;
+      if (state_ != nullptr && other.ref_ != LUA_NOREF) {
+        other.PushSelf();
+        ref_ = luaL_ref(state_->handle(), LUA_REGISTRYINDEX);
+      }
+    }
+    return *this;
+  }
+
+  bool is_nil() const {
+    if (state_ == nullptr || ref_ == LUA_NOREF) return true;
+    PushSelf();
+    bool nil = lua_isnil(state_->handle(), -1);
+    lua_pop(state_->handle(), 1);
+    return nil;
+  }
+
+  /*! \brief typed conversion; FATAL on type mismatch */
+  template <typename T>
+  T Get() const {
+    TCHECK(state_ != nullptr && ref_ != LUA_NOREF) << "lua: empty LuaRef";
+    lua_State* L = state_->handle();
+    PushSelf();
+    T out{};
+    if constexpr (std::is_same_v<T, bool>) {
+      out = lua_toboolean(L, -1) != 0;
+    } else if constexpr (std::is_integral_v<T>) {
+      int ok = 0;
+      out = static_cast<T>(lua_tointegerx(L, -1, &ok));
+      if (!ok) Fail(L, "integer");
+    } else if constexpr (std::is_floating_point_v<T>) {
+      int ok = 0;
+      out = static_cast<T>(lua_tonumberx(L, -1, &ok));
+      if (!ok) Fail(L, "number");
+    } else if constexpr (std::is_same_v<T, std::string>) {
+      size_t len = 0;
+      const char* s = lua_tolstring(L, -1, &len);
+      if (s == nullptr) Fail(L, "string");
+      out.assign(s, len);
+    } else {
+      static_assert(sizeof(T) == 0, "unsupported LuaRef::Get type");
+    }
+    lua_pop(L, 1);
+    return out;
+  }
+
+  /*! \brief sequence-table to vector conversion */
+  template <typename T>
+  std::vector<T> GetVector() const {
+    TCHECK(state_ != nullptr) << "lua: empty LuaRef";
+    lua_State* L = state_->handle();
+    PushSelf();
+    TCHECK(lua_istable(L, -1)) << "lua: value is not a table";
+    std::vector<T> out;
+    lua_Integer n = luaL_len(L, -1);
+    for (lua_Integer i = 1; i <= n; ++i) {
+      lua_rawgeti(L, -1, i);
+      LuaRef item(state_, true);
+      out.push_back(item.Get<T>());
+    }
+    lua_pop(L, 1);
+    return out;
+  }
+
+  /*! \brief string-keyed table field */
+  LuaRef Field(const std::string& key) const {
+    TCHECK(state_ != nullptr) << "lua: empty LuaRef";
+    lua_State* L = state_->handle();
+    PushSelf();
+    lua_getfield(L, -1, key.c_str());
+    LuaRef out(state_, true);
+    lua_pop(L, 1);
+    return out;
+  }
+
+  /*! \brief call self as a function with typed args; returns one result */
+  template <typename... Args>
+  LuaRef operator()(const Args&... args) const {
+    TCHECK(state_ != nullptr) << "lua: empty LuaRef";
+    lua_State* L = state_->handle();
+    PushSelf();
+    (state_->Push(args), ...);
+    if (lua_pcall(L, sizeof...(Args), 1, 0) != LUA_OK) {
+      std::string err = lua_tostring(L, -1);
+      lua_pop(L, 1);
+      TLOG(Fatal) << "lua call: " << err;
+    }
+    return LuaRef(state_, true);
+  }
+
+ private:
+  void PushSelf() const {
+    lua_rawgeti(state_->handle(), LUA_REGISTRYINDEX,
+                static_cast<lua_Integer>(ref_));
+  }
+  void Release() {
+    if (state_ != nullptr && ref_ != LUA_NOREF) {
+      luaL_unref(state_->handle(), LUA_REGISTRYINDEX, ref_);
+    }
+    state_ = nullptr;
+    ref_ = LUA_NOREF;
+  }
+  [[noreturn]] static void Fail(lua_State* L, const char* want) {
+    const char* got = luaL_typename(L, -1);
+    lua_pop(L, 1);
+    TLOG(Fatal) << "lua: expected " << want << ", got " << got;
+  }
+
+  LuaState* state_ = nullptr;
+  int ref_ = LUA_NOREF;
+};
+
+inline LuaRef LuaState::EvalExpr(const std::string& expr) {
+  std::string chunk = "return " + expr;
+  if (luaL_loadstring(L_, chunk.c_str()) != LUA_OK ||
+      lua_pcall(L_, 0, 1, 0) != LUA_OK) {
+    std::string err = lua_tostring(L_, -1);
+    lua_pop(L_, 1);
+    TLOG(Fatal) << "lua: " << err;
+  }
+  return LuaRef(this, true);
+}
+
+inline LuaRef LuaState::GetGlobal(const std::string& name) {
+  lua_getglobal(L_, name.c_str());
+  return LuaRef(this, true);
+}
+
+}  // namespace dmlctpu
+#endif  // DMLCTPU_LUA_H_

@@ -17,7 +17,7 @@ from ..submit import submit
 
 
 def _job_manifest(name: str, image: str, n: int, pairs: dict, command: list,
-                  cores: int, memory_mb: int) -> dict:
+                  cores: int, memory_mb: int, retries: int = 3) -> dict:
     env = [{"name": k, "value": str(v)} for k, v in pairs.items()]
     env.append({"name": "DMLC_TASK_ID",
                 "valueFrom": {"fieldRef": {
@@ -30,6 +30,9 @@ def _job_manifest(name: str, image: str, n: int, pairs: dict, command: list,
             "completions": n,
             "parallelism": n,
             "completionMode": "Indexed",
+            # per-rank restarts (k8s >= 1.28): one flaky worker retries alone
+            # instead of burning the Job-wide budget for all ranks
+            "backoffLimitPerIndex": retries,
             "template": {
                 "spec": {
                     "restartPolicy": "Never",
@@ -62,7 +65,8 @@ def run(args) -> None:
             pairs.update({"DMLC_ROLE": role, "DMLC_JOB_CLUSTER": "kubernetes"})
             manifest = _job_manifest(f"{jobname}-{role}", image, n, pairs,
                                      args.command, args.worker_cores,
-                                     args.worker_memory_mb)
+                                     args.worker_memory_mb,
+                                     getattr(args, "container_retries", 3))
             text = json.dumps(manifest)
             if dry_run:
                 sys.stdout.write(text + "\n")

@@ -1,11 +1,19 @@
 """YARN launcher.
 
 Parity: reference tracker/dmlc_tracker/yarn.py + the Java ApplicationMaster
-(tracker/yarn/).  This build keeps the Python control flow — tracker start,
-env contract, `yarn jar` submission — but does not ship a Java AM; it drives
-YARN's distributed-shell AM with the DMLC_* env exported per container,
-which covers the rank bootstrap (workers rendezvous through the tracker, so
-container placement does not need a custom AM).  Requires `yarn` on PATH.
+(tracker/yarn/ — container negotiation, failed-task restart, app attempts).
+This build ships no Java: it drives YARN's stock distributed-shell AM
+(part of every Hadoop install) with the DMLC_* env exported per container.
+The Java AM's responsibilities map as:
+  * container negotiation  -> -num_containers/-container_memory/-container_vcores
+  * failed-task restart    -> -container_retry_policy RETRY_ON_ALL_ERRORS
+                              with --container-retries max retries
+  * AM restart             -> the RM re-attempts the DS AM per the cluster's
+                              yarn.resourcemanager.am.max-attempts config;
+                              restarted ranks re-rendezvous through the
+                              tracker's `recover` path
+Rank assignment never needed the custom AM: workers rendezvous through the
+rabit tracker, which assigns ranks on connect.  Requires `yarn` on PATH.
 """
 from __future__ import annotations
 
@@ -13,6 +21,7 @@ import logging
 import os
 import shutil
 import subprocess
+import time
 
 from ..submit import submit
 
@@ -22,6 +31,7 @@ LOGGER = logging.getLogger("dmlc_tpu.yarn")
 def run(args) -> None:
     if shutil.which("yarn") is None:
         raise SystemExit("--cluster=yarn requires the yarn CLI on PATH")
+    procs: list = []
 
     def spawn_all(num_workers: int, num_servers: int, envs: dict) -> None:
         def launch(role: str, n: int) -> None:
@@ -31,22 +41,38 @@ def run(args) -> None:
             pairs.update(args.extra_env)
             pairs.update({"DMLC_ROLE": role, "DMLC_JOB_CLUSTER": "yarn"})
             shell_env = ",".join(f"{k}={v}" for k, v in pairs.items())
+            ds_jar = os.environ.get("HADOOP_YARN_DS_JAR", "distributedshell.jar")
             cmd = [
-                "yarn", "jar",
-                os.environ.get("HADOOP_YARN_DS_JAR", "distributedshell.jar"),
-                "-jar", os.environ.get("HADOOP_YARN_DS_JAR", "distributedshell.jar"),
+                "yarn", "jar", ds_jar,
+                "-jar", ds_jar,
+                "-appname", (args.jobname or "dmlc") + "-" + role,
+                "-queue", args.queue,
                 "-num_containers", str(n),
                 "-container_memory", str(args.worker_memory_mb),
                 "-container_vcores", str(args.worker_cores),
+                "-container_retry_policy", "RETRY_ON_ALL_ERRORS",
+                "-container_max_retries", str(args.container_retries),
+                "-container_retry_interval", "1000",
                 "-shell_env", shell_env,
                 "-shell_command", " ".join(args.command),
             ]
             LOGGER.info("yarn submit: %s", " ".join(cmd))
-            subprocess.Popen(cmd)
+            procs.append(subprocess.Popen(cmd))
 
         launch("server", num_servers)
         launch("worker", num_workers)
 
     tracker = submit(args.num_workers, args.num_servers, spawn_all,
                      host_ip=args.host_ip, extra_envs=args.extra_env)
+    # poll the submission clients while waiting: a failed `yarn jar` means
+    # no worker will ever connect, so joining unconditionally would hang
+    while tracker.alive():
+        for p in procs:
+            rc = p.poll()
+            if rc is not None and rc != 0:
+                raise SystemExit(f"yarn submission client exited with {rc}")
+        time.sleep(1.0)
     tracker.join()
+    failures = [p.wait() for p in procs]
+    if any(rc != 0 for rc in failures):
+        raise SystemExit(f"yarn submission client(s) exited with {failures}")

@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--host-ip", default=os.environ.get("DMLC_TRACKER_HOST", "auto"),
                         help="IP the tracker binds/advertises")
     parser.add_argument("--jobname", default=None, help="job name")
+    parser.add_argument("--queue", default=os.environ.get("DMLC_JOB_QUEUE", "default"),
+                        help="yarn: submission queue")
+    parser.add_argument("--container-retries", type=int,
+                        default=int(os.environ.get("DMLC_NUM_ATTEMPT", "3")),
+                        help="yarn/kubernetes: per-container restart attempts")
     parser.add_argument("--sync-dst-dir", default=None,
                         help="ssh: rsync the working dir to this remote path first")
     parser.add_argument("--local-num-attempt", type=int,
