@@ -54,13 +54,20 @@ class LuaState {
     return &state;
   }
 
+  /*! \brief the error value on top of the stack as text (non-string error
+   *         objects stringify via luaL_tolstring; never dereferences NULL) */
+  static std::string PopError(lua_State* L) {
+    const char* msg = luaL_tolstring(L, -1, nullptr);
+    std::string err = msg != nullptr ? msg : "(non-string lua error)";
+    lua_pop(L, 2);  // the error value and luaL_tolstring's result
+    return err;
+  }
+
   /*! \brief run a chunk of Lua source; FATAL with the Lua error on failure */
   void Eval(const std::string& code) {
     if (luaL_loadstring(L_, code.c_str()) != LUA_OK ||
         lua_pcall(L_, 0, 0, 0) != LUA_OK) {
-      std::string err = lua_tostring(L_, -1);
-      lua_pop(L_, 1);
-      TLOG(Fatal) << "lua: " << err;
+      TLOG(Fatal) << "lua: " << PopError(L_);
     }
   }
 
@@ -211,9 +218,7 @@ class LuaRef {
     PushSelf();
     (state_->Push(args), ...);
     if (lua_pcall(L, sizeof...(Args), 1, 0) != LUA_OK) {
-      std::string err = lua_tostring(L, -1);
-      lua_pop(L, 1);
-      TLOG(Fatal) << "lua call: " << err;
+      TLOG(Fatal) << "lua call: " << LuaState::PopError(L);
     }
     return LuaRef(state_, true);
   }
@@ -244,9 +249,7 @@ inline LuaRef LuaState::EvalExpr(const std::string& expr) {
   std::string chunk = "return " + expr;
   if (luaL_loadstring(L_, chunk.c_str()) != LUA_OK ||
       lua_pcall(L_, 0, 1, 0) != LUA_OK) {
-    std::string err = lua_tostring(L_, -1);
-    lua_pop(L_, 1);
-    TLOG(Fatal) << "lua: " << err;
+    TLOG(Fatal) << "lua: " << PopError(L_);
   }
   return LuaRef(this, true);
 }

@@ -38,6 +38,8 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
   }
 
  protected:
+  // Single-pass hot loop (no line-end pre-scan); newline/'\r'/NUL terminate
+  // lines during tokenization, mirroring the libsvm parser.
   void ParseBlock(const char* begin, const char* end,
                   RowBlockContainer<IndexType, DType>* out) override {
     out->Clear();
@@ -45,37 +47,42 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
     IndexType min_index = std::numeric_limits<IndexType>::max();
     const char* p = begin;
     while (p != end) {
-      const char* line_end = p;
-      while (line_end != end && *line_end != '\n' && *line_end != '\r' && *line_end != '\0') {
-        ++line_end;
-      }
-      // label
-      const char* q = p;
+      // blank lines, terminators, and NUL padding all skip (a NUL must be
+      // consumed here: DiscardLine stops AT terminators, never past them)
+      while (p != end && (IsSpaceChar(*p) || *p == '\0')) ++p;
+      if (p == end) break;
       real_t label;
-      if (TryParseNum(&q, line_end, &label)) {
-        out->label.push_back(label);
-        // field:index:value triples
-        while (true) {
-          while (q != line_end && IsSpaceChar(*q)) ++q;
-          if (q == line_end) break;
-          IndexType field, index;
-          DType value;
-          if (!ParseTriple(&q, line_end, ':', &field, &index, &value)) break;
-          out->field.push_back(field);
-          out->index.push_back(index);
-          out->value.push_back(value);
-          out->max_field = std::max(out->max_field, field);
-          out->max_index = std::max(out->max_index, index);
-          min_field = std::min(min_field, field);
-          min_index = std::min(min_index, index);
-        }
-        out->offset.push_back(out->index.size());
+      if (!TryParseNumToken(&p, end, &label)) {
+        DiscardLine(&p, end);  // unparseable label: skip the whole line
+        continue;
       }
-      p = line_end;
-      while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
+      out->label.push_back(label);
+      // field:index:value triples until end of line
+      while (true) {
+        while (p != end && (*p == ' ' || *p == '\t')) ++p;
+        if (p == end || *p == '\n' || *p == '\r' || *p == '\0') break;
+        IndexType field, index;
+        DType value;
+        bool ok = TryParseNumToken(&p, end, &field) && p != end && *p == ':' &&
+                  (++p, TryParseNumToken(&p, end, &index)) && p != end &&
+                  *p == ':' && (++p, TryParseNumToken(&p, end, &value));
+        if (!ok) {
+          DiscardLine(&p, end);  // malformed triple: drop rest of line
+          break;
+        }
+        out->field.push_back(field);
+        out->index.push_back(index);
+        out->value.push_back(value);
+        out->max_field = std::max(out->max_field, field);
+        out->max_index = std::max(out->max_index, index);
+        min_field = std::min(min_field, field);
+        min_index = std::min(min_index, index);
+      }
+      out->offset.push_back(out->index.size());
     }
     if (param_.indexing_mode > 0 ||
-        (param_.indexing_mode < 0 && !out->index.empty() && min_field > 0 && min_index > 0)) {
+        (param_.indexing_mode < 0 && !out->index.empty() && min_field > 0 &&
+         min_index > 0)) {
       for (IndexType& f : out->field) --f;
       for (IndexType& i : out->index) --i;
       if (out->max_field > 0) --out->max_field;
@@ -84,6 +91,8 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
   }
 
  private:
+  using TextParserBase<IndexType, DType>::DiscardLine;
+
   LibFMParserParam param_;
 };
 

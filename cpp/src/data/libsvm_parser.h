@@ -51,10 +51,10 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
     IndexType min_index = std::numeric_limits<IndexType>::max();
     const char* p = begin;
     while (p != end) {
-      // skip blank space between rows (covers blank lines and terminators)
-      while (p != end && IsSpaceChar(*p)) ++p;
+      // skip blank space between rows (blank lines, terminators, NUL pad)
+      while (p != end && (IsSpaceChar(*p) || *p == '\0')) ++p;
       if (p == end) break;
-      if (*p == '#' || *p == '\0') {  // comment-only line / NUL padding
+      if (*p == '#') {  // comment-only line
         DiscardLine(&p, end);
         continue;
       }
@@ -128,10 +128,7 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
   }
 
  private:
-  /*! \brief advance to the current line's terminator ('\n', bare '\r', or NUL) */
-  static void DiscardLine(const char** p, const char* end) {
-    while (*p != end && **p != '\n' && **p != '\r' && **p != '\0') ++*p;
-  }
+  using TextParserBase<IndexType, DType>::DiscardLine;
 
   LibSVMParserParam param_;
 };

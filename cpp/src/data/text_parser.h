@@ -76,6 +76,11 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
     return true;
   }
 
+  /*! \brief advance to the current line's terminator ('\n', bare '\r', or NUL) */
+  static void DiscardLine(const char** p, const char* end) {
+    while (*p != end && **p != '\n' && **p != '\r' && **p != '\0') ++*p;
+  }
+
   /*! \brief step backward/forward to a line boundary so ranges do not split lines */
   static const char* BackFindLineEnd(const char* p, const char* begin, const char* end) {
     if (p >= end) return end;

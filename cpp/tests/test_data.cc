@@ -125,6 +125,28 @@ TESTCASE(libsvm_malformed_token_keeps_alignment) {
   EXPECT_TRUE(std::abs(all.value[1] - 4.0f) < kEps);
 }
 
+TESTCASE(nul_bytes_do_not_hang_parsers) {
+  // a NUL inside the buffer must be skipped like a terminator, never pin
+  // the cursor (regression: single-pass rewrite once looped forever here)
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/nul.libsvm";
+  std::string content = "1 2:3.0\n";
+  content.push_back('\0');
+  content += "\n0 4:1.5\n";
+  WriteFile(f, content);
+  auto all = DrainParser(Parser<uint64_t>::Create(f.c_str(), 0, 1, "libsvm").get());
+  EXPECT_EQV(all.Size(), 2u);
+
+  std::string g = tmp.path + "/nul.libfm";
+  std::string fm = "1 0:2:3.0\n";
+  fm.push_back('\0');
+  fm += "-1 1:4:1.5\n";
+  WriteFile(g, fm);
+  auto fmall =
+      DrainParser(Parser<uint64_t>::Create((g + "?format=libfm").c_str(), 0, 1, "auto").get());
+  EXPECT_EQV(fmall.Size(), 2u);
+}
+
 TESTCASE(csv_basic_label_weight_missing) {
   TemporaryDirectory tmp;
   std::string f = tmp.path + "/a.csv";

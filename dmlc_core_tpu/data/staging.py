@@ -22,8 +22,10 @@ Design (SURVEY.md §7 step 7):
 from __future__ import annotations
 
 import ctypes
+import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -34,6 +36,8 @@ import jax.numpy as jnp
 
 from .._native import check, lib
 from .rowblock import Parser  # noqa: F401  (re-exported convenience)
+
+LOGGER = logging.getLogger("dmlc_core_tpu.staging")
 
 
 @dataclass
@@ -112,7 +116,8 @@ class DeviceStagingIter:
 
     def __init__(self, uri: str, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
                  part: int = 0, num_parts: int = 1, format: str = "auto",  # noqa: A002
-                 sharding=None, with_field: bool = False, prefetch: int = 2):
+                 sharding=None, with_field: bool = False, prefetch: int = 2,
+                 log_every: int = 0):
         self._lib = _declare_batcher_sig()
         self._handle = ctypes.c_void_p()
         check(self._lib.DmlcTpuStagedBatcherCreate(
@@ -123,6 +128,12 @@ class DeviceStagingIter:
         self._with_field = with_field
         self._max_index = -1
         self.batches_staged = 0
+        # throughput self-reporting cadence in batches (0 = off); parity with
+        # the reference loaders' MB/sec logs (basic_row_iter.h:70-81)
+        self._log_every = log_every
+        self._epoch_t0 = 0.0
+        self._epoch_bytes0 = 0
+        self._epoch_batches0 = 0
         self._lock = threading.Lock()  # one native cursor per handle
 
     @property
@@ -150,6 +161,11 @@ class DeviceStagingIter:
 
     # ---- staging ------------------------------------------------------------
     def _stage(self, c: _StagedBatchC) -> PaddedBatch:
+        # visible as one span per staged batch in jax profiler / xplane traces
+        with jax.profiler.TraceAnnotation("dmlctpu.stage_batch"):
+            return self._stage_inner(c)
+
+    def _stage_inner(self, c: _StagedBatchC) -> PaddedBatch:
         B = c.batch_size
         nnz = c.nnz_pad
 
@@ -177,6 +193,12 @@ class DeviceStagingIter:
         )
         self._max_index = max(self._max_index, int(c.max_index))
         self.batches_staged += 1
+        epoch_batches = self.batches_staged - self._epoch_batches0
+        if self._log_every and epoch_batches % self._log_every == 0:
+            secs = max(time.monotonic() - self._epoch_t0, 1e-9)
+            epoch_mb = (self.bytes_read - self._epoch_bytes0) / (1 << 20)
+            LOGGER.info("staged %d batches, %.2f MB/sec -> device",
+                        epoch_batches, epoch_mb / secs)
         return batch
 
     def __iter__(self) -> Iterator[PaddedBatch]:
@@ -185,6 +207,10 @@ class DeviceStagingIter:
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         sentinel = object()
         error: list = []
+
+        self._epoch_t0 = time.monotonic()
+        self._epoch_bytes0 = self.bytes_read
+        self._epoch_batches0 = self.batches_staged
 
         def producer():
             try:

@@ -70,6 +70,12 @@ def run(args) -> None:
         for p in procs:
             rc = p.poll()
             if rc is not None and rc != 0:
+                for other in procs:  # best-effort cleanup of the other role
+                    if other.poll() is None:
+                        other.terminate()
+                LOGGER.warning(
+                    "a yarn submission client failed; applications already "
+                    "accepted by the RM may need `yarn application -kill`")
                 raise SystemExit(f"yarn submission client exited with {rc}")
         time.sleep(1.0)
     tracker.join()
