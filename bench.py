@@ -81,16 +81,58 @@ def ensure_reference_binary() -> Path | None:
     return exe
 
 
-def run_reference(exe: Path, data: Path) -> float | None:
-    """Run the reference driver; return its final MB/sec reading."""
-    nthread = max(os.cpu_count() or 1, 1)
+def ensure_reference_csv_binary() -> Path | None:
+    """The reference's own csv_parser_test is hardwired to int payloads; for
+    a like-for-like float comparison, compile a minimal driver that runs the
+    reference's float CSV parser (same library code, same drain loop)."""
+    exe = CACHE / "ref_csv_parser_float"
+    if exe.exists():
+        return exe
+    if not REF_SRC.exists():
+        return None
+    driver = CACHE / "ref_csv_driver.cc"
+    driver.write_text(
+        '#include <cstdio>\n#include <cstdlib>\n#include <memory>\n'
+        '#include <dmlc/data.h>\n#include <dmlc/timer.h>\n'
+        'int main(int argc, char** argv) {\n'
+        '  if (argc < 4) return 1;\n'
+        '  std::unique_ptr<dmlc::Parser<unsigned, float> > parser(\n'
+        '      dmlc::Parser<unsigned, float>::Create(argv[1], atoi(argv[2]),\n'
+        '                                            atoi(argv[3]), "csv"));\n'
+        '  double t0 = dmlc::GetTime();\n'
+        '  size_t rows = 0;\n'
+        '  while (parser->Next()) rows += parser->Value().size;\n'
+        '  double mb = parser->BytesRead() / (1024.0 * 1024.0);\n'
+        '  printf("%lu rows, %.3f MB/sec\\n", rows, mb / (dmlc::GetTime() - t0));\n'
+        '  return 0;\n}\n')
+    srcs = [driver, REF_SRC / "src/io.cc", REF_SRC / "src/data.cc",
+            REF_SRC / "src/recordio.cc"]
+    srcs += [REF_SRC / "src/io" / n for n in
+             ("filesys.cc", "local_filesys.cc", "input_split_base.cc",
+              "line_split.cc", "recordio_split.cc", "indexed_recordio_split.cc")]
+    cmd = ["g++", "-O2", "-std=c++17", f"-I{REF_SRC}/include",
+           *map(str, srcs), "-o", str(exe), "-lpthread"]
     try:
-        proc = subprocess.run([str(exe), str(data), "0", "1", str(nthread)],
-                              capture_output=True, text=True, timeout=600)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        log(f"[bench] reference csv driver build failed: {e}")
+        return None
+    return exe
+
+
+def run_rate(cmd: list) -> float | None:
+    """Run a driver binary; return the last MB/sec it printed."""
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
     except subprocess.TimeoutExpired:
         return None
     rates = re.findall(r"([0-9.]+) MB/sec", proc.stdout)
     return float(rates[-1]) if rates else None
+
+
+def run_reference(exe: Path, data: Path) -> float | None:
+    nthread = max(os.cpu_count() or 1, 1)
+    return run_rate([str(exe), str(data), "0", "1", str(nthread)])
 
 
 def pick_backend():
@@ -224,6 +266,12 @@ def main() -> None:
     parse = run_parse(data)
     log(f"[bench] ours parse->RowBlock: {parse['mb_s']:.1f} MB/s")
     csv_data = make_csv_dataset()
+    csv_ref_rate = None
+    csv_exe = ensure_reference_csv_binary()
+    if csv_exe is not None:
+        run_rate([str(csv_exe), str(csv_data), "0", "1"])  # page-cache warmup
+        csv_ref_rate = run_rate([str(csv_exe), str(csv_data), "0", "1"])
+        log(f"[bench] reference csv (float) parse: {csv_ref_rate} MB/s")
     csv_parse = run_parse(csv_data, fmt="csv")
     log(f"[bench] ours csv parse: {csv_parse['mb_s']:.1f} MB/s")
     staging = run_staging(data)
@@ -247,6 +295,9 @@ def main() -> None:
         "staging_rows_per_sec": round(staging["rows_s"]),
         "staging_platform": staging["platform"],
         "csv_parse_mb_s": round(csv_parse["mb_s"], 2),
+        "csv_baseline_mb_s": csv_ref_rate,
+        "csv_vs_baseline": (round(csv_parse["mb_s"] / csv_ref_rate, 3)
+                            if csv_ref_rate else None),
         "csv_staging_to_hbm_mb_s": round(csv_staging["mb_s"], 2),
         "allreduce_bus_gbps": (round(allreduce["bus_gbps"], 2)
                                if allreduce else None),

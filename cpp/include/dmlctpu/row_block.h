@@ -47,6 +47,14 @@ struct RowBlockContainer {
 
   /*! \brief borrow the content as a RowBlock view */
   RowBlock<IndexType, DType> GetBlock() const {
+    // per-row arrays must cover every row — a shortfall would make the
+    // view's per-row indexing read out of bounds
+    TCHECK(weight.empty() || weight.size() == label.size())
+        << "RowBlockContainer: weight column covers " << weight.size()
+        << " of " << label.size() << " rows";
+    TCHECK(qid.empty() || qid.size() == label.size())
+        << "RowBlockContainer: qid column covers " << qid.size() << " of "
+        << label.size() << " rows";
     RowBlock<IndexType, DType> b;
     b.size = Size();
     b.offset = offset.data();

@@ -65,15 +65,15 @@ class CSVParser : public TextParserBase<IndexType, DType> {
       bool any_field = false;
       bool line_done = false;
       while (!line_done) {
-        // intra-cell blank skip — but never across the delimiter itself
-        // (a tab delimiter must still delimit empty cells)
-        while (p != end && (*p == ' ' || *p == '\t') && *p != delim_) ++p;
+        // intra-cell blank skip — but never across the delimiter itself (a
+        // tab delimiter must still delimit empty cells).  The chunk's '\0'
+        // sentinel (split_base.cc Chunk::Load) terminates these scans, so
+        // no bounds check per char.
+        while ((*p == ' ' || *p == '\t') && *p != delim_) ++p;
         DType v{};
         bool has_value = TryParseNumToken(&p, end, &v);
         // advance to the cell boundary (tolerates trailing junk in the cell)
-        while (p != end && *p != delim_ && *p != '\n' && *p != '\r' && *p != '\0') {
-          ++p;
-        }
+        while (*p != delim_ && *p != '\n' && *p != '\r' && *p != '\0') ++p;
         if (column == param_.label_column) {
           if (has_value) label = v;
         } else if (std::is_same_v<DType, real_t> && column == param_.weight_column) {
@@ -105,6 +105,10 @@ class CSVParser : public TextParserBase<IndexType, DType> {
         out->weight.push_back(weight);
       }
       out->offset.push_back(out->index.size());
+    }
+    // pad the weight tail (see libsvm_parser.h: shortfall = OOB row reads)
+    if (!out->weight.empty() && out->weight.size() < out->label.size()) {
+      out->weight.resize(out->label.size(), 1.0f);
     }
   }
 

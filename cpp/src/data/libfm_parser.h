@@ -59,7 +59,8 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
       out->label.push_back(label);
       // field:index:value triples until end of line
       while (true) {
-        while (p != end && (*p == ' ' || *p == '\t')) ++p;
+        // sentinel-terminated scans (chunk buffers end with '\0')
+        while (*p == ' ' || *p == '\t') ++p;
         if (p == end || *p == '\n' || *p == '\r' || *p == '\0') break;
         IndexType field, index;
         DType value;

@@ -79,7 +79,8 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
       // ---- optional qid:n, then features idx[:val] until end of line
       bool at_qid_slot = true;
       while (true) {
-        while (p != end && (*p == ' ' || *p == '\t')) ++p;
+        // sentinel-terminated scans (chunk buffers end with '\0')
+        while (*p == ' ' || *p == '\t') ++p;
         if (p == end || *p == '\n' || *p == '\r' || *p == '\0') break;
         if (*p == '#') {  // trailing comment: discard rest of line
           DiscardLine(&p, end);
@@ -118,6 +119,15 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
         if (has_val) out->value.push_back(val);
       }
       out->offset.push_back(out->index.size());
+    }
+    // rows after the last weighted/qid row carry defaults — the per-row
+    // lazy resize only back-fills, so pad the tail too (RowBlock views
+    // index these arrays per row; a shortfall is an out-of-bounds read)
+    if (!out->weight.empty() && out->weight.size() < out->label.size()) {
+      out->weight.resize(out->label.size(), 1.0f);
+    }
+    if (!out->qid.empty() && out->qid.size() < out->label.size()) {
+      out->qid.resize(out->label.size(), 0);
     }
     // indexing-mode resolution
     if (param_.indexing_mode > 0 ||

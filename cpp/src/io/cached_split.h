@@ -108,6 +108,7 @@ class CachedInputSplit : public InputSplit {
           c->begin = reinterpret_cast<char*>(c->data.data());
           c->end = c->begin + size;
           fi_->ReadAll(c->begin, size);
+          *c->end = '\0';  // sentinel for terminator-less digit loops
           return true;
         },
         [this] { fi_->Seek(0); });

@@ -24,7 +24,7 @@ class SingleFileSplit : public InputSplit {
       TCHECK(fp_ != nullptr) << "SingleFileSplit: cannot open " << fname;
       own_ = true;
     }
-    buffer_.resize(kBufferSize);
+    buffer_.resize(kBufferSize + 1);  // +1: terminator slack byte
   }
   ~SingleFileSplit() override {
     if (own_ && fp_ != nullptr) std::fclose(fp_);
@@ -56,7 +56,8 @@ class SingleFileSplit : public InputSplit {
     while (true) {
       if (read_ptr_ == read_end_) {
         if (end_of_file_) break;
-        read_end_ = std::fread(buffer_.data(), 1, buffer_.size(), fp_);
+        read_end_ = std::fread(buffer_.data(), 1, kBufferSize, fp_);
+        buffer_[read_end_] = '\0';
         read_ptr_ = 0;
         if (read_end_ == 0) {
           end_of_file_ = true;
@@ -90,7 +91,8 @@ class SingleFileSplit : public InputSplit {
     started_ = true;
     if (read_ptr_ == read_end_) {
       if (end_of_file_) return false;
-      read_end_ = std::fread(buffer_.data(), 1, buffer_.size(), fp_);
+      read_end_ = std::fread(buffer_.data(), 1, kBufferSize, fp_);
+      buffer_[read_end_] = '\0';  // sentinel for terminator-less digit loops
       read_ptr_ = 0;
       if (read_end_ == 0) {
         end_of_file_ = true;

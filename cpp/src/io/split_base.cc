@@ -226,6 +226,7 @@ bool SplitterBase::Chunk::Load(SplitterBase* split, size_t units) {
     } else {
       begin = reinterpret_cast<char*>(data.data());
       end = begin + size;
+      *end = '\0';  // sentinel: parsers run terminator-less digit loops
       return true;
     }
   }
@@ -243,6 +244,7 @@ bool SplitterBase::Chunk::Append(SplitterBase* split, size_t units) {
     } else {
       begin = reinterpret_cast<char*>(data.data());
       end = begin + prev + size;
+      *end = '\0';  // sentinel: parsers run terminator-less digit loops
       return true;
     }
   }

@@ -125,6 +125,33 @@ TESTCASE(libsvm_malformed_token_keeps_alignment) {
   EXPECT_TRUE(std::abs(all.value[1] - 4.0f) < kEps);
 }
 
+TESTCASE(weight_qid_tail_padding) {
+  // a weighted/qid row followed by plain rows: the per-row columns must be
+  // padded to full length (regression: short arrays made RowBlock views
+  // read out of bounds, caught by ASan)
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/tail.libsvm";
+  WriteFile(f, "1:0.25 qid:7 2:1\n0 3:1\n1 4:1\n");
+  auto all = DrainParser(Parser<uint32_t>::Create(f.c_str(), 0, 1, "libsvm").get());
+  EXPECT_EQV(all.Size(), 3u);
+  EXPECT_EQV(all.weight.size(), 3u);
+  EXPECT_EQV(all.weight[0], 0.25f);
+  EXPECT_EQV(all.weight[2], 1.0f);  // padded default
+  EXPECT_EQV(all.qid.size(), 3u);
+  EXPECT_EQV(all.qid[0], 7u);
+  EXPECT_EQV(all.qid[2], 0u);  // padded default
+
+  // csv: weight column missing in later rows
+  std::string g = tmp.path + "/tail.csv";
+  WriteFile(g, "1,2.5,0.5\n0,3.5,\n");
+  auto parser = Parser<uint32_t>::Create(
+      (g + "?format=csv&label_column=0&weight_column=2").c_str(), 0, 1, "auto");
+  auto csv = DrainParser(parser.get());
+  EXPECT_EQV(csv.Size(), 2u);
+  EXPECT_EQV(csv.weight.size(), 2u);
+  EXPECT_EQV(csv.weight[1], 1.0f);
+}
+
 TESTCASE(nul_bytes_do_not_hang_parsers) {
   // a NUL inside the buffer must be skipped like a terminator, never pin
   // the cursor (regression: single-pass rewrite once looped forever here)

@@ -1,4 +1,6 @@
 """TPU compute ops over padded CSR batches."""
+from .pallas_segment import segment_sum
 from .sparse import csr_matvec, csr_matmul, csr_row_sumsq_matmul, padded_row_mean
 
-__all__ = ["csr_matvec", "csr_matmul", "csr_row_sumsq_matmul", "padded_row_mean"]
+__all__ = ["csr_matvec", "csr_matmul", "csr_row_sumsq_matmul",
+           "padded_row_mean", "segment_sum"]

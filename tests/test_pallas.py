@@ -1,0 +1,43 @@
+"""Pallas segment-sum kernel vs the XLA scatter reference (interpret mode
+on the CPU mesh; the same code path compiles natively on TPU)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from dmlc_core_tpu.ops.pallas_segment import segment_sum
+
+
+def _case(nnz, rows, seed):
+    rng = np.random.default_rng(seed)
+    row_id = np.sort(rng.integers(0, rows, size=nnz)).astype(np.int32)
+    contrib = rng.standard_normal(nnz).astype(np.float32)
+    return jnp.asarray(contrib), jnp.asarray(row_id)
+
+
+def test_matches_xla_segment_sum():
+    for nnz, rows, seed in [(1000, 64, 0), (4096, 513, 1), (37, 1024, 2)]:
+        contrib, row_id = _case(nnz, rows, seed)
+        want = segment_sum(contrib, row_id, rows)                  # xla
+        got = segment_sum(contrib, row_id, rows, force="pallas")   # kernel
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_unsorted_and_empty_segments():
+    # correctness must not depend on row_id sortedness or full coverage
+    rng = np.random.default_rng(3)
+    row_id = jnp.asarray(rng.permutation(
+        np.repeat(np.arange(0, 50, 2), 7)).astype(np.int32))  # odd rows empty
+    contrib = jnp.ones(row_id.shape[0], jnp.float32)
+    got = segment_sum(contrib, row_id, 50, force="pallas")
+    want = segment_sum(contrib, row_id, 50)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert float(got[1]) == 0.0  # empty segment stays zero
+
+
+def test_padding_entries_inert():
+    # staging convention: pad entries carry value 0 at row batch-1
+    contrib = jnp.asarray([1.0, 2.0, 0.0, 0.0], jnp.float32)
+    row_id = jnp.asarray([0, 1, 3, 3], jnp.int32)
+    got = segment_sum(contrib, row_id, 4, force="pallas")
+    np.testing.assert_allclose(np.asarray(got), [1.0, 2.0, 0.0, 0.0])
