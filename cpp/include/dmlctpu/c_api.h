@@ -59,7 +59,13 @@ typedef void* DmlcTpuRecordIOReaderHandle;
 int DmlcTpuRecordIOWriterCreate(const char* uri, DmlcTpuRecordIOWriterHandle* out);
 int DmlcTpuRecordIOWriterWrite(DmlcTpuRecordIOWriterHandle handle, const void* data,
                                uint64_t size);
-/*! \brief closes the underlying stream */
+/*! \brief flush + finalize the underlying stream, surfacing upload errors
+ *         (-1 + DmlcTpuGetLastError).  Remote backends (s3/azure/hdfs)
+ *         finalize lazily; Free alone LOGS AND DISCARDS a failed final
+ *         flush, so callers who must know the object landed call Close
+ *         first.  Idempotent. */
+int DmlcTpuRecordIOWriterClose(DmlcTpuRecordIOWriterHandle handle);
+/*! \brief closes the underlying stream (failures logged, not reported) */
 void DmlcTpuRecordIOWriterFree(DmlcTpuRecordIOWriterHandle handle);
 int DmlcTpuRecordIOReaderCreate(const char* uri, DmlcTpuRecordIOReaderHandle* out);
 int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** data,

@@ -27,6 +27,17 @@ class Stream {
   virtual size_t Write(const void* ptr, size_t size) = 0;
 
   /*!
+   * \brief flush buffered writes and finalize the target, surfacing failures.
+   *
+   * Buffered write streams (S3 multipart, Azure block list, WebHDFS append)
+   * finalize lazily; their destructors cannot throw, so a failed final flush
+   * in a destructor is logged and swallowed.  Callers that need the error —
+   * anyone writing data they cannot regenerate — must call Close() and let
+   * it throw.  Safe to call multiple times; the stream is unusable after.
+   */
+  virtual void Close() {}
+
+  /*!
    * \brief open a stream from a URI.
    * \param uri  file path or protocol URI (file://, mem://ref not supported here)
    * \param mode "r", "w", or "a"

@@ -30,21 +30,33 @@ namespace detail {
 /*! \brief consume a digit run into *mantissa (wrapping past 19 digits —
  *         callers bail to from_chars beyond 15 significant digits anyway).
  *
- *         TERMINATOR CONTRACT: the loop tests one condition per char and
- *         relies on a dereferenceable non-digit byte at the end of the
- *         buffer instead of a bounds check (measured ~45% faster on the
- *         parse benches; the reference's strtof has the same contract).
- *         Every internal buffer guarantees it: chunk loaders write '\0'
- *         at chunk end, std::string data is NUL-terminated.  External
- *         callers of TryParseNum* must pass sentinel-terminated memory. */
+ *         Bounded=false is the TERMINATOR CONTRACT variant: the loop tests
+ *         one condition per char and relies on a dereferenceable non-digit
+ *         byte at the end of the buffer instead of a bounds check (measured
+ *         ~45% faster on the parse benches; the reference's strtof has the
+ *         same contract).  Internal chunk buffers guarantee it: chunk
+ *         loaders write '\0' at chunk end, std::string data is
+ *         NUL-terminated.  Only the *Unsafe entry points below use it; the
+ *         public TryParseNum/TryParseNumToken keep the bounded loop so the
+ *         documented [p, end) contract stays safe for external callers
+ *         (e.g. an mmap ending exactly at a digit on a page boundary). */
+template <bool Bounded>
 inline void ParseDigitRun(const char** s, const char* end, uint64_t* mantissa,
                           int* digits) {
   const char* q = *s;
-  (void)end;  // see contract above
-  while (IsDigitChar(*q)) {
-    *mantissa = *mantissa * 10 + static_cast<uint64_t>(*q - '0');
-    ++*digits;
-    ++q;
+  if constexpr (Bounded) {
+    while (q != end && IsDigitChar(*q)) {
+      *mantissa = *mantissa * 10 + static_cast<uint64_t>(*q - '0');
+      ++*digits;
+      ++q;
+    }
+  } else {
+    (void)end;  // see contract above
+    while (IsDigitChar(*q)) {
+      *mantissa = *mantissa * 10 + static_cast<uint64_t>(*q - '0');
+      ++*digits;
+      ++q;
+    }
   }
   *s = q;
 }
@@ -56,7 +68,7 @@ inline void ParseDigitRun(const char** s, const char* end, uint64_t* mantissa,
  *        Long mantissas / exponent forms / inf / nan fall back to the
  *        correctly-rounded std::from_chars.
  */
-template <typename T>
+template <typename T, bool Bounded = true>
 inline bool FastParseFloat(const char** p, const char* end, T* out) {
   const char* s = *p;
   bool neg = false;
@@ -67,12 +79,12 @@ inline bool FastParseFloat(const char** p, const char* end, T* out) {
   uint64_t mantissa = 0;
   int digits = 0;
   const char* int_start = s;
-  ParseDigitRun(&s, end, &mantissa, &digits);
+  ParseDigitRun<Bounded>(&s, end, &mantissa, &digits);
   int frac_digits = 0;
   if (s != end && *s == '.') {
     ++s;
     int before = digits;
-    ParseDigitRun(&s, end, &mantissa, &digits);
+    ParseDigitRun<Bounded>(&s, end, &mantissa, &digits);
     frac_digits = digits - before;
   }
   if (digits == 0 || digits > 15 ||
@@ -83,9 +95,10 @@ inline bool FastParseFloat(const char** p, const char* end, T* out) {
   }
   // scale by the reciprocal: fdiv is ~4x the latency of fmul and this runs
   // once per numeric cell.  1/10^k is inexact in binary, so for float
-  // outputs this can differ from correctly-rounded by <= 1 double ulp —
-  // invisible after the float cast for <= 15-digit tokens; doubles still
-  // take the exact division path.
+  // outputs the intermediate double can sit within 1 double ulp of the
+  // correctly-rounded value; when that lands on a float rounding boundary
+  // the final float may differ by 1 float ulp from strtof in rare halfway
+  // cases — an accepted trade-off here.  Doubles take the exact division.
   static constexpr double kInvPow10[16] = {
       1e-0, 1e-1, 1e-2, 1e-3, 1e-4, 1e-5, 1e-6, 1e-7,
       1e-8, 1e-9, 1e-10, 1e-11, 1e-12, 1e-13, 1e-14, 1e-15};
@@ -105,24 +118,18 @@ inline bool FastParseFloat(const char** p, const char* end, T* out) {
 }
 }  // namespace detail
 
-/*!
- * \brief parse one number of type T starting exactly at *p (no whitespace
- *        skipping) — the single-pass parser hot path, where the caller has
- *        already positioned the cursor and newlines are line terminators
- *        that must NOT be consumed.
- * \param p     cursor; advanced past the parsed token on success.
- * \param end   exclusive end of the buffer.
- * \param out   parsed value.
- * \return true on success.
- */
-template <typename T>
-inline bool TryParseNumToken(const char** p, const char* end, T* out) {
+namespace detail {
+
+/*! \brief shared implementation of TryParseNumToken[Unsafe]; see the public
+ *         wrappers below for the contract of each. */
+template <typename T, bool Bounded>
+inline bool TryParseNumTokenImpl(const char** p, const char* end, T* out) {
   const char* s = *p;
   if (s == end) return false;
   std::from_chars_result r;
   if constexpr (std::is_floating_point_v<T>) {
     const char* fast = s;
-    if (detail::FastParseFloat(&fast, end, out)) {
+    if (detail::FastParseFloat<T, Bounded>(&fast, end, out)) {
       *p = fast;
       return true;
     }
@@ -137,7 +144,7 @@ inline bool TryParseNumToken(const char** p, const char* end, T* out) {
     return false;
   } else {
     // fast digit-loop path for short integers (feature ids, counts);
-    // terminator contract as in ParseDigitRun (non-digit byte at *end)
+    // Bounded=false uses the terminator contract of ParseDigitRun
     const char* q = s;
     bool neg = false;
     if constexpr (std::is_signed_v<T>) {
@@ -150,10 +157,18 @@ inline bool TryParseNumToken(const char** p, const char* end, T* out) {
     }
     uint64_t acc = 0;
     int digits = 0;
-    while (IsDigitChar(*q) && digits < 18) {
-      acc = acc * 10 + static_cast<uint64_t>(*q - '0');
-      ++digits;
-      ++q;
+    if constexpr (Bounded) {
+      while (q != end && IsDigitChar(*q) && digits < 18) {
+        acc = acc * 10 + static_cast<uint64_t>(*q - '0');
+        ++digits;
+        ++q;
+      }
+    } else {
+      while (IsDigitChar(*q) && digits < 18) {
+        acc = acc * 10 + static_cast<uint64_t>(*q - '0');
+        ++digits;
+        ++q;
+      }
     }
     if (digits > 0 && (q == end || !IsDigitChar(*q))) {
       // range check: out-of-range must fail (like from_chars), not wrap
@@ -179,9 +194,40 @@ inline bool TryParseNumToken(const char** p, const char* end, T* out) {
   }
 }
 
+}  // namespace detail
+
+/*!
+ * \brief parse one number of type T starting exactly at *p (no whitespace
+ *        skipping) — the single-pass parser entry, where the caller has
+ *        already positioned the cursor and newlines are line terminators
+ *        that must NOT be consumed.  Fully bounds-checked: reads only
+ *        within [*p, end), so any caller-supplied buffer is safe.
+ * \param p     cursor; advanced past the parsed token on success.
+ * \param end   exclusive end of the buffer.
+ * \param out   parsed value.
+ * \return true on success.
+ */
+template <typename T>
+inline bool TryParseNumToken(const char** p, const char* end, T* out) {
+  return detail::TryParseNumTokenImpl<T, /*Bounded=*/true>(p, end, out);
+}
+
+/*!
+ * \brief TryParseNumToken without per-character bounds checks — the parser
+ *        hot path.  PRECONDITION: a dereferenceable non-digit byte must sit
+ *        at the end of the buffer (chunk loaders write '\0' at chunk end;
+ *        std::string data is NUL-terminated).  Do NOT call on memory that
+ *        may end exactly at a digit (e.g. an mmap at a page boundary) —
+ *        use TryParseNumToken there.
+ */
+template <typename T>
+inline bool TryParseNumTokenUnsafe(const char** p, const char* end, T* out) {
+  return detail::TryParseNumTokenImpl<T, /*Bounded=*/false>(p, end, out);
+}
+
 /*!
  * \brief parse one number of type T from [p, end), skipping leading
- *        whitespace (including newlines) first.
+ *        whitespace (including newlines) first.  Fully bounds-checked.
  */
 template <typename T>
 inline bool TryParseNum(const char** p, const char* end, T* out) {

@@ -176,6 +176,13 @@ int DmlcTpuRecordIOWriterWrite(DmlcTpuRecordIOWriterHandle handle, const void* d
   });
 }
 
+int DmlcTpuRecordIOWriterClose(DmlcTpuRecordIOWriterHandle handle) {
+  return Guard([&] {
+    static_cast<WriterCtx*>(handle)->stream->Close();
+    return 0;
+  });
+}
+
 void DmlcTpuRecordIOWriterFree(DmlcTpuRecordIOWriterHandle handle) {
   delete static_cast<WriterCtx*>(handle);
 }

@@ -71,7 +71,7 @@ class CSVParser : public TextParserBase<IndexType, DType> {
         // no bounds check per char.
         while ((*p == ' ' || *p == '\t') && *p != delim_) ++p;
         DType v{};
-        bool has_value = TryParseNumToken(&p, end, &v);
+        bool has_value = TryParseNumTokenUnsafe(&p, end, &v);
         // advance to the cell boundary (tolerates trailing junk in the cell)
         while (*p != delim_ && *p != '\n' && *p != '\r' && *p != '\0') ++p;
         if (column == param_.label_column) {

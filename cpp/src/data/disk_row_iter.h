@@ -85,6 +85,7 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
       page.Save(fo.get());
       rows += page.Size();
     }
+    fo->Close();  // surface a failed cache write here, not in ~Stream
     fo.reset();
     // patch the column count in the header
     {

@@ -52,7 +52,7 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
       while (p != end && (IsSpaceChar(*p) || *p == '\0')) ++p;
       if (p == end) break;
       real_t label;
-      if (!TryParseNumToken(&p, end, &label)) {
+      if (!TryParseNumTokenUnsafe(&p, end, &label)) {
         DiscardLine(&p, end);  // unparseable label: skip the whole line
         continue;
       }
@@ -64,9 +64,9 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
         if (p == end || *p == '\n' || *p == '\r' || *p == '\0') break;
         IndexType field, index;
         DType value;
-        bool ok = TryParseNumToken(&p, end, &field) && p != end && *p == ':' &&
-                  (++p, TryParseNumToken(&p, end, &index)) && p != end &&
-                  *p == ':' && (++p, TryParseNumToken(&p, end, &value));
+        bool ok = TryParseNumTokenUnsafe(&p, end, &field) && p != end && *p == ':' &&
+                  (++p, TryParseNumTokenUnsafe(&p, end, &index)) && p != end &&
+                  *p == ':' && (++p, TryParseNumTokenUnsafe(&p, end, &value));
         if (!ok) {
           DiscardLine(&p, end);  // malformed triple: drop rest of line
           break;

@@ -61,13 +61,13 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
       // ---- label[:weight]
       real_t label, weight = 1.0f;
       bool has_weight = false;
-      if (!TryParseNumToken(&p, end, &label)) {
+      if (!TryParseNumTokenUnsafe(&p, end, &label)) {
         DiscardLine(&p, end);  // malformed line: discard
         continue;
       }
       if (p != end && *p == ':') {
         ++p;
-        has_weight = TryParseNumToken(&p, end, &weight);
+        has_weight = TryParseNumTokenUnsafe(&p, end, &weight);
       }
       out->label.push_back(label);
       if (has_weight) {
@@ -101,13 +101,13 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
         IndexType idx;
         DType val;
         bool has_val = false;
-        if (!TryParseNumToken(&p, end, &idx)) {
+        if (!TryParseNumTokenUnsafe(&p, end, &idx)) {
           DiscardLine(&p, end);  // malformed token: drop rest of line
           break;
         }
         if (p != end && *p == ':') {
           ++p;
-          if (!TryParseNumToken(&p, end, &val)) {
+          if (!TryParseNumTokenUnsafe(&p, end, &val)) {
             DiscardLine(&p, end);  // malformed value: drop token AND line,
             break;                 // keeping index[] and value[] aligned
           }

@@ -327,7 +327,17 @@ class AzureWriteStream : public Stream {
     block_bytes_ = static_cast<size_t>(
         GetEnv("DMLCTPU_AZURE_WRITE_BUFFER_MB", 64)) << 20;
   }
-  ~AzureWriteStream() override { Finish(); }
+  // destructors are noexcept: a failed final flush here is logged, not
+  // thrown — callers who must observe upload failure call Close()
+  ~AzureWriteStream() override {
+    try {
+      Finish();
+    } catch (const std::exception& e) {
+      TLOG(Error) << "azure: discarding write-stream flush failure in "
+                     "destructor (call Close() to observe it): " << e.what();
+    }
+  }
+  void Close() override { Finish(); }
 
   size_t Read(void*, size_t) override {
     TLOG(Fatal) << "AzureWriteStream is write-only";
@@ -380,8 +390,8 @@ class AzureWriteStream : public Stream {
     for (const std::string& id : block_ids_) body += "<Latest>" + id + "</Latest>";
     body += "</BlockList>";
     std::map<std::string, std::string> query{{"comp", "blocklist"}};
-    auto signed_req = signer_->Sign("PUT", resource_, query, {}, body.size(),
-                                    NowRfc1123());
+    auto signed_req = signer_->Sign("PUT", ep_.path_prefix + resource_, query,
+                                    {}, body.size(), NowRfc1123());
     http::Response resp = http::Request(ep_.host, ep_.port, "PUT",
                                         req_path_ + BuildQuery(query),
                                         signed_req.headers, body);

@@ -40,6 +40,7 @@ class CachedInputSplit : public InputSplit {
       SplitterBase::Chunk* c = nullptr;
       while (preproc_->Next(&c)) preproc_->Recycle(&c);
       preproc_.reset();
+      if (fo_ != nullptr) fo_->Close();  // cache must be durable before reuse
       fo_.reset();
       TCHECK(InitCachedIter()) << "failed to reopen cache file " << cache_file_;
     } else {

@@ -220,6 +220,14 @@ class WebHdfsWriteStream : public Stream {
                    << 20;
   }
   ~WebHdfsWriteStream() override {
+    try {
+      Close();
+    } catch (const std::exception& e) {
+      TLOG(Error) << "webhdfs: discarding write-stream flush failure in "
+                     "destructor (call Close() to observe it): " << e.what();
+    }
+  }
+  void Close() override {
     // a never-written "w" stream must still create an empty file
     if (!created_ || !buffer_.empty()) Flush();
   }

@@ -280,7 +280,15 @@ class S3WriteStream : public Stream {
     part_bytes_ = static_cast<size_t>(
         GetEnv("DMLC_S3_WRITE_BUFFER_MB", 64)) << 20;
   }
-  ~S3WriteStream() override { Finish(); }
+  ~S3WriteStream() override {
+    try {
+      Finish();
+    } catch (const std::exception& e) {
+      TLOG(Error) << "s3: discarding write-stream flush failure in "
+                     "destructor (call Close() to observe it): " << e.what();
+    }
+  }
+  void Close() override { Finish(); }
 
   size_t Read(void*, size_t) override {
     TLOG(Fatal) << "S3WriteStream is write-only";

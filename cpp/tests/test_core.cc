@@ -50,6 +50,36 @@ TESTCASE(strtonum_basic) {
   EXPECT_TRUE(!TryParseNum(&p, end, &bad));
 }
 
+TESTCASE(strtonum_public_entry_is_bounded) {
+  // the public TryParseNum/TryParseNumToken honor [p, end) even when the
+  // buffer ends mid-digit-run (e.g. an mmap slice at a page boundary);
+  // the sentinel-reliant fast path is opt-in via TryParseNumTokenUnsafe
+  std::string backing = "12345.678";
+  {  // integer truncated at a digit: must stop exactly at end
+    const char* p = backing.data();
+    const char* end = backing.data() + 3;  // "123"
+    uint32_t v = 0;
+    EXPECT_TRUE(TryParseNumToken(&p, end, &v));
+    EXPECT_EQV(v, 123u);
+    EXPECT_TRUE(p == end);
+  }
+  {  // float truncated inside the fraction
+    const char* p = backing.data();
+    const char* end = backing.data() + 7;  // "12345.6"
+    float v = 0;
+    EXPECT_TRUE(TryParseNumToken(&p, end, &v));
+    EXPECT_EQV(v, 12345.6f);
+    EXPECT_TRUE(p == end);
+  }
+  {  // unsafe variant still parses normal sentinel-terminated tokens
+    const char* p = backing.c_str();
+    const char* end = backing.c_str() + backing.size();
+    double v = 0;
+    EXPECT_TRUE(TryParseNumTokenUnsafe(&p, end, &v));
+    EXPECT_TRUE(std::abs(v - 12345.678) < 1e-9);
+  }
+}
+
 TESTCASE(strtonum_out_of_range_rejected) {
   // out-of-range integers must fail (from_chars semantics), never wrap
   auto reject = [](const char* text, auto proto) {

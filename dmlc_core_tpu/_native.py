@@ -91,6 +91,7 @@ _LIB.DmlcTpuInputSplitFree.argtypes = [ctypes.c_void_p]
 
 _LIB.DmlcTpuRecordIOWriterCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuRecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+_LIB.DmlcTpuRecordIOWriterClose.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuRecordIOWriterFree.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuRecordIOReaderCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuRecordIOReaderNext.argtypes = [

@@ -82,9 +82,13 @@ class RecordIOWriter:
         check(lib().DmlcTpuRecordIOWriterWrite(self._handle, record, len(record)))
 
     def close(self) -> None:
+        """Finalize and free; raises if the final flush/upload failed."""
         if self._handle:
-            lib().DmlcTpuRecordIOWriterFree(self._handle)
-            self._handle = ctypes.c_void_p()
+            handle, self._handle = self._handle, ctypes.c_void_p()
+            try:
+                check(lib().DmlcTpuRecordIOWriterClose(handle))
+            finally:
+                lib().DmlcTpuRecordIOWriterFree(handle)
 
     def __enter__(self):
         return self
@@ -93,8 +97,10 @@ class RecordIOWriter:
         self.close()
 
     def __del__(self):
-        self.close()
-
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: errors already logged natively
 
 class RecordIOReader:
     """Stream logical records back out of a RecordIO container."""

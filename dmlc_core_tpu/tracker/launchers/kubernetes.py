@@ -17,22 +17,30 @@ from ..submit import submit
 
 
 def _job_manifest(name: str, image: str, n: int, pairs: dict, command: list,
-                  cores: int, memory_mb: int, retries: int = 3) -> dict:
+                  cores: int, memory_mb: int, retries: int | None = None) -> dict:
     env = [{"name": k, "value": str(v)} for k, v in pairs.items()]
     env.append({"name": "DMLC_TASK_ID",
                 "valueFrom": {"fieldRef": {
                     "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"}}})
+    spec = {
+        "completions": n,
+        "parallelism": n,
+        "completionMode": "Indexed",
+    }
+    if retries is not None:
+        # per-rank restarts (k8s >= 1.28 with JobBackoffLimitPerIndex): one
+        # flaky worker retries alone instead of burning the Job-wide budget.
+        # Emitted only when --container-retries is explicitly set — clusters
+        # older than 1.28 reject the field at admission.
+        spec["backoffLimitPerIndex"] = retries
+    else:
+        spec["backoffLimit"] = 3  # Job-wide default, accepted everywhere
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
         "metadata": {"name": name},
         "spec": {
-            "completions": n,
-            "parallelism": n,
-            "completionMode": "Indexed",
-            # per-rank restarts (k8s >= 1.28): one flaky worker retries alone
-            # instead of burning the Job-wide budget for all ranks
-            "backoffLimitPerIndex": retries,
+            **spec,
             "template": {
                 "spec": {
                     "restartPolicy": "Never",
@@ -66,7 +74,7 @@ def run(args) -> None:
             manifest = _job_manifest(f"{jobname}-{role}", image, n, pairs,
                                      args.command, args.worker_cores,
                                      args.worker_memory_mb,
-                                     getattr(args, "container_retries", 3))
+                                     getattr(args, "container_retries", None))
             text = json.dumps(manifest)
             if dry_run:
                 sys.stdout.write(text + "\n")

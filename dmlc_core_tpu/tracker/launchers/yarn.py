@@ -51,7 +51,8 @@ def run(args) -> None:
                 "-container_memory", str(args.worker_memory_mb),
                 "-container_vcores", str(args.worker_cores),
                 "-container_retry_policy", "RETRY_ON_ALL_ERRORS",
-                "-container_max_retries", str(args.container_retries),
+                "-container_max_retries",
+                str(args.container_retries if args.container_retries is not None else 3),
                 "-container_retry_interval", "1000",
                 "-shell_env", shell_env,
                 "-shell_command", " ".join(args.command),

@@ -29,8 +29,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--jobname", default=None, help="job name")
     parser.add_argument("--queue", default=os.environ.get("DMLC_JOB_QUEUE", "default"),
                         help="yarn: submission queue")
+    # default None = "not explicitly set": yarn substitutes 3; kubernetes
+    # omits backoffLimitPerIndex (rejected at admission before k8s 1.28)
     parser.add_argument("--container-retries", type=int,
-                        default=int(os.environ.get("DMLC_NUM_ATTEMPT", "3")),
+                        default=(int(os.environ["DMLC_NUM_ATTEMPT"])
+                                 if "DMLC_NUM_ATTEMPT" in os.environ else None),
                         help="yarn/kubernetes: per-container restart attempts")
     parser.add_argument("--sync-dst-dir", default=None,
                         help="ssh: rsync the working dir to this remote path first")
