@@ -100,6 +100,29 @@ int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle);
 int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle);
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle);
 
+/* ---- RecordBatcher: RecordIO → packed fixed-shape device batches --------- */
+typedef void* DmlcTpuRecordBatcherHandle;
+
+/*! \brief borrowed view of one packed record batch (static shapes for HBM) */
+typedef struct {
+  uint32_t num_records;     /* true records in this batch */
+  uint64_t records_cap;     /* offsets length - 1 (fixed) */
+  uint64_t bytes_cap;       /* bytes length (fixed) */
+  uint64_t bytes_used;      /* payload bytes before zero padding */
+  const char* bytes;        /* [bytes_cap] concatenated payloads */
+  const int32_t* offsets;   /* [records_cap+1]; tail repeats bytes_used */
+} DmlcTpuRecordBatchC;
+
+int DmlcTpuRecordBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
+                               uint64_t records_cap, uint64_t bytes_cap,
+                               DmlcTpuRecordBatcherHandle* out);
+/*! \brief next batch (1/0/-1); buffers valid until the following call */
+int DmlcTpuRecordBatcherNext(DmlcTpuRecordBatcherHandle handle,
+                             DmlcTpuRecordBatchC* out);
+int DmlcTpuRecordBatcherBeforeFirst(DmlcTpuRecordBatcherHandle handle);
+int64_t DmlcTpuRecordBatcherBytesRead(DmlcTpuRecordBatcherHandle handle);
+void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle);
+
 /* ---- misc ---------------------------------------------------------------- */
 /*! \brief library version string */
 const char* DmlcTpuVersion(void);

@@ -4,6 +4,7 @@
 #include <memory>
 #include <string>
 
+#include "./data/record_batcher.h"
 #include "./data/staged_batcher.h"
 #include "dmlctpu/data.h"
 #include "dmlctpu/input_split.h"
@@ -47,6 +48,12 @@ struct BatcherCtx {
   std::unique_ptr<dmlctpu::data::StagedBatcher> batcher;
   dmlctpu::data::StagedBatch* borrowed = nullptr;
   uint64_t batch_size = 0;
+};
+struct RecordBatcherCtx {
+  std::unique_ptr<dmlctpu::data::RecordBatcher> batcher;
+  dmlctpu::data::RecordBatch* borrowed = nullptr;
+  uint64_t records_cap = 0;
+  uint64_t bytes_cap = 0;
 };
 
 }  // namespace
@@ -266,6 +273,60 @@ int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle) {
 
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle) {
   delete static_cast<BatcherCtx*>(handle);
+}
+
+int DmlcTpuRecordBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
+                               uint64_t records_cap, uint64_t bytes_cap,
+                               DmlcTpuRecordBatcherHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<RecordBatcherCtx>();
+    auto split = dmlctpu::InputSplit::Create(uri, part, num_parts, "recordio");
+    ctx->batcher = std::make_unique<dmlctpu::data::RecordBatcher>(
+        std::move(split), records_cap, bytes_cap);
+    ctx->records_cap = records_cap;
+    ctx->bytes_cap = bytes_cap;
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuRecordBatcherNext(DmlcTpuRecordBatcherHandle handle,
+                             DmlcTpuRecordBatchC* out) {
+  return Guard([&] {
+    auto* ctx = static_cast<RecordBatcherCtx*>(handle);
+    if (ctx->borrowed != nullptr) {
+      ctx->batcher->Recycle(&ctx->borrowed);
+    }
+    if (!ctx->batcher->Next(&ctx->borrowed)) return 0;
+    const auto* b = ctx->borrowed;
+    out->num_records = b->num_records;
+    out->records_cap = ctx->records_cap;
+    out->bytes_cap = ctx->bytes_cap;
+    out->bytes_used = b->bytes_used;
+    out->bytes = b->bytes.data();
+    out->offsets = b->offsets.data();
+    return 1;
+  });
+}
+
+int DmlcTpuRecordBatcherBeforeFirst(DmlcTpuRecordBatcherHandle handle) {
+  return Guard([&] {
+    auto* ctx = static_cast<RecordBatcherCtx*>(handle);
+    ctx->batcher->BeforeFirst();
+    if (ctx->borrowed != nullptr) {
+      ctx->batcher->Recycle(&ctx->borrowed);
+    }
+    return 0;
+  });
+}
+
+int64_t DmlcTpuRecordBatcherBytesRead(DmlcTpuRecordBatcherHandle handle) {
+  return static_cast<int64_t>(
+      static_cast<RecordBatcherCtx*>(handle)->batcher->BytesRead());
+}
+
+void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle) {
+  delete static_cast<RecordBatcherCtx*>(handle);
 }
 
 }  // extern "C"

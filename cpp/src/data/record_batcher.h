@@ -1,0 +1,133 @@
+// record_batcher.h — native RecordIO→device staging pipeline (BASELINE
+// target 2).  Drains a sharded "recordio" InputSplit chunk-wise, iterates
+// records zero-copy with RecordIOChunkReader, and packs them into
+// fixed-capacity batches: one contiguous byte buffer (tail zero-padded to
+// bytes_cap) plus an int32 offsets table (padded by repeating the end
+// offset) — a bounded set of static shapes Python can device_put into HBM
+// without retracing.  A ThreadedIter packs one batch ahead of the consumer,
+// mirroring staged_batcher.h.
+// Parity: the reference's recordio read path (src/recordio.cc:101-156 chunk
+// reader; test/recordio_test.cc:17-48 is the adversarial instrument) — the
+// device-staging layout is the TPU-era addition.
+#ifndef DMLCTPU_SRC_DATA_RECORD_BATCHER_H_
+#define DMLCTPU_SRC_DATA_RECORD_BATCHER_H_
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "dmlctpu/input_split.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/recordio.h"
+#include "dmlctpu/threaded_iter.h"
+
+namespace dmlctpu {
+namespace data {
+
+struct RecordBatch {
+  std::vector<char> bytes;       // [bytes_cap] packed payloads, zero tail
+  std::vector<int32_t> offsets;  // [records_cap + 1]; tail repeats bytes_used
+  uint32_t num_records = 0;
+  uint64_t bytes_used = 0;
+};
+
+class RecordBatcher {
+ public:
+  RecordBatcher(std::unique_ptr<InputSplit> split, size_t records_cap,
+                size_t bytes_cap)
+      : split_(std::move(split)),
+        records_cap_(std::max<size_t>(records_cap, 1)),
+        bytes_cap_(std::max<size_t>(bytes_cap, 1)),
+        iter_(4) {
+    TCHECK_LT(bytes_cap_, (1ull << 31))
+        << "bytes_cap must fit int32 offsets for device staging";
+    split_->BeforeFirst();
+    iter_.Init([this](RecordBatch** cell) { return Produce(cell); },
+               [this] {
+                 split_->BeforeFirst();
+                 chunk_ = InputSplit::Blob();
+                 reader_.reset();
+                 pending_.clear();
+                 have_pending_ = false;
+                 source_end_ = false;
+               });
+  }
+  ~RecordBatcher() { iter_.Destroy(); }
+
+  bool Next(RecordBatch** out) { return iter_.Next(out); }
+  void Recycle(RecordBatch** inout) { iter_.Recycle(inout); }
+  void BeforeFirst() { iter_.BeforeFirst(); }
+  /*! \brief wire bytes consumed from the split so far (throughput metric) */
+  size_t BytesRead() const { return bytes_read_.load(std::memory_order_relaxed); }
+
+ private:
+  bool Produce(RecordBatch** cell) {
+    if (*cell == nullptr) *cell = new RecordBatch();
+    RecordBatch* out = *cell;
+    out->bytes.resize(bytes_cap_);
+    out->offsets.assign(records_cap_ + 1, 0);
+    size_t used = 0;
+    size_t nrec = 0;
+
+    if (have_pending_) {  // carried over: record that overflowed last batch
+      TCHECK_LE(pending_.size(), bytes_cap_)
+          << "single record larger than bytes_cap (" << pending_.size()
+          << " > " << bytes_cap_ << ")";
+      std::memcpy(out->bytes.data(), pending_.data(), pending_.size());
+      used = pending_.size();
+      out->offsets[++nrec] = static_cast<int32_t>(used);
+      have_pending_ = false;
+    }
+    RecordIOChunkReader::Blob rec;
+    while (nrec < records_cap_) {
+      if (reader_ == nullptr || !reader_->NextRecord(&rec)) {
+        if (source_end_ || !split_->NextChunk(&chunk_)) {
+          source_end_ = true;
+          break;
+        }
+        bytes_read_.fetch_add(chunk_.size, std::memory_order_relaxed);
+        reader_ = std::make_unique<RecordIOChunkReader>(RecordIOChunkReader::Blob{
+            static_cast<char*>(chunk_.dptr), chunk_.size});
+        continue;
+      }
+      if (used + rec.size > bytes_cap_) {
+        TCHECK_LE(rec.size, bytes_cap_)
+            << "single record larger than bytes_cap (" << rec.size << " > "
+            << bytes_cap_ << ")";
+        pending_.assign(rec.dptr, rec.dptr + rec.size);  // survives chunk swap
+        have_pending_ = true;
+        break;
+      }
+      std::memcpy(out->bytes.data() + used, rec.dptr, rec.size);
+      used += rec.size;
+      out->offsets[++nrec] = static_cast<int32_t>(used);
+    }
+    if (nrec == 0) return false;
+    std::fill(out->offsets.begin() + nrec + 1, out->offsets.end(),
+              static_cast<int32_t>(used));
+    std::memset(out->bytes.data() + used, 0, bytes_cap_ - used);
+    out->num_records = static_cast<uint32_t>(nrec);
+    out->bytes_used = used;
+    return true;
+  }
+
+  std::unique_ptr<InputSplit> split_;
+  size_t records_cap_;
+  size_t bytes_cap_;
+  InputSplit::Blob chunk_{};
+  std::unique_ptr<RecordIOChunkReader> reader_;
+  std::string pending_;
+  bool have_pending_ = false;
+  bool source_end_ = false;
+  std::atomic<size_t> bytes_read_{0};
+  ThreadedIter<RecordBatch> iter_;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_RECORD_BATCHER_H_

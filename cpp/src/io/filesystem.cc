@@ -43,6 +43,14 @@ class StdioFileStream : public SeekStream {
   }
   size_t Tell() override { return static_cast<size_t>(::ftello(fp_)); }
   bool AtEnd() override { return std::feof(fp_) != 0; }
+  void Close() override {
+    // flush the stdio buffer and surface what the destructor's unchecked
+    // fclose would swallow (e.g. ENOSPC on the tail of a cache write)
+    if (fp_ == nullptr) return;
+    int rc = std::fflush(fp_);
+    TCHECK(rc == 0 && std::ferror(fp_) == 0)
+        << "file flush failed (disk full?)";
+  }
 
  private:
   std::FILE* fp_;

@@ -9,6 +9,7 @@
 #include <string>
 #include <vector>
 
+#include "../src/data/record_batcher.h"
 #include "dmlctpu/data.h"
 #include "dmlctpu/row_block.h"
 #include "dmlctpu/stream.h"
@@ -344,6 +345,91 @@ TESTCASE(rowblock_container_save_load) {
   EXPECT_TRUE(back.value == all.value);
   EXPECT_TRUE(back.qid == all.qid);
   EXPECT_EQV(back.max_index, all.max_index);
+}
+
+TESTCASE(record_batcher_packs_adversarial_records) {
+  // RecordIO -> fixed-shape packed batches (record_batcher.h), on payloads
+  // salted with the magic word (reference test/recordio_test.cc:17-48)
+  TemporaryDirectory tmp;
+  const uint32_t magic = RecordIOWriter::kMagic;
+  std::vector<std::string> records;
+  for (int i = 0; i < 523; ++i) {
+    std::string r = "rec" + std::to_string(i) + std::string(i % 91, 'x');
+    if (i % 5 == 0) r.append(reinterpret_cast<const char*>(&magic), 4);
+    if (i % 7 == 0) r.insert(0, reinterpret_cast<const char*>(&magic), 4);
+    records.push_back(r);
+  }
+  std::string path = tmp.path + "/adv.rec";
+  {
+    auto fo = Stream::Create(path.c_str(), "w");
+    RecordIOWriter w(fo.get());
+    for (const auto& r : records) w.WriteRecord(r);
+    fo->Close();
+  }
+  // small caps force both limits (records_cap and bytes_cap carry-over)
+  const size_t records_cap = 64, bytes_cap = 4096;
+  auto split = InputSplit::Create(path.c_str(), 0, 1, "recordio");
+  data::RecordBatcher batcher(std::move(split), records_cap, bytes_cap);
+  std::vector<std::string> got;
+  for (int epoch = 0; epoch < 2; ++epoch) {  // BeforeFirst replays exactly
+    got.clear();
+    batcher.BeforeFirst();
+    data::RecordBatch* b = nullptr;
+    while (batcher.Next(&b)) {
+      EXPECT_TRUE(b->num_records >= 1 && b->num_records <= records_cap);
+      EXPECT_EQV(b->bytes.size(), bytes_cap);
+      EXPECT_EQV(b->offsets.size(), records_cap + 1);
+      EXPECT_EQV(b->offsets[0], 0);
+      for (size_t r = 0; r < b->num_records; ++r) {
+        EXPECT_TRUE(b->offsets[r] <= b->offsets[r + 1]);
+        got.emplace_back(b->bytes.data() + b->offsets[r],
+                         b->bytes.data() + b->offsets[r + 1]);
+      }
+      // offsets tail repeats bytes_used; byte tail is zeroed
+      EXPECT_EQV(static_cast<uint64_t>(b->offsets[b->num_records]), b->bytes_used);
+      for (size_t r = b->num_records; r <= records_cap; ++r) {
+        EXPECT_EQV(static_cast<uint64_t>(b->offsets[r]), b->bytes_used);
+      }
+      for (size_t k = b->bytes_used; k < bytes_cap; ++k) {
+        EXPECT_EQV(b->bytes[k], '\0');
+      }
+      batcher.Recycle(&b);
+    }
+    EXPECT_TRUE(got == records);
+  }
+  EXPECT_TRUE(batcher.BytesRead() > 0);
+}
+
+TESTCASE(record_batcher_multirank_union) {
+  // each rank's batcher sees a disjoint shard; union is exactly the dataset
+  TemporaryDirectory tmp;
+  std::vector<std::string> records;
+  for (int i = 0; i < 977; ++i) records.push_back("row-" + std::to_string(i));
+  std::string path = tmp.path + "/u.rec";
+  {
+    auto fo = Stream::Create(path.c_str(), "w");
+    RecordIOWriter w(fo.get());
+    for (const auto& r : records) w.WriteRecord(r);
+    fo->Close();
+  }
+  for (unsigned nparts : {1u, 3u}) {
+    std::multiset<std::string> seen;
+    for (unsigned rank = 0; rank < nparts; ++rank) {
+      data::RecordBatcher batcher(
+          InputSplit::Create(path.c_str(), rank, nparts, "recordio"), 128, 1 << 16);
+      data::RecordBatch* b = nullptr;
+      while (batcher.Next(&b)) {
+        for (size_t r = 0; r < b->num_records; ++r) {
+          seen.emplace(b->bytes.data() + b->offsets[r],
+                       b->bytes.data() + b->offsets[r + 1]);
+        }
+        batcher.Recycle(&b);
+      }
+    }
+    EXPECT_EQV(seen.size(), records.size());
+    std::multiset<std::string> want(records.begin(), records.end());
+    EXPECT_TRUE(seen == want);
+  }
 }
 
 TESTMAIN()

@@ -13,7 +13,8 @@ Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
 """
 from . import data, io, models, ops, parallel
 from ._native import NativeError, version as native_version
-from .data import DeviceStagingIter, PaddedBatch, Parser, RowBlock
+from .data import (DeviceStagingIter, PaddedBatch, Parser, RecordBatch,
+                   RecordStagingIter, RowBlock)
 from .io import InputSplit, RecordIOReader, RecordIOWriter
 
 __version__ = "0.1.0"
@@ -21,5 +22,6 @@ __all__ = [
     "data", "io", "models", "ops", "parallel",
     "NativeError", "native_version",
     "DeviceStagingIter", "PaddedBatch", "Parser", "RowBlock",
+    "RecordBatch", "RecordStagingIter",
     "InputSplit", "RecordIOReader", "RecordIOWriter",
 ]

@@ -1,5 +1,7 @@
 """Data layer: parsed RowBlocks (numpy) and TPU HBM staging."""
 from .rowblock import RowBlock, Parser
-from .staging import PaddedBatch, DeviceStagingIter
+from .staging import (PaddedBatch, DeviceStagingIter, RecordBatch,
+                      RecordStagingIter)
 
-__all__ = ["RowBlock", "Parser", "PaddedBatch", "DeviceStagingIter"]
+__all__ = ["RowBlock", "Parser", "PaddedBatch", "DeviceStagingIter",
+           "RecordBatch", "RecordStagingIter"]

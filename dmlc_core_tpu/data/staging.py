@@ -40,6 +40,65 @@ from .rowblock import Parser  # noqa: F401  (re-exported convenience)
 LOGGER = logging.getLogger("dmlc_core_tpu.staging")
 
 
+def _staged_iter(produce, prefetch: int):
+    """Drive ``produce(emit)`` on a background thread, yielding emitted items
+    up to ``prefetch`` ahead of the consumer.
+
+    ``emit(item) -> bool`` returns False when the consumer has gone away
+    (break / generator close): the producer must return promptly, releasing
+    any native cursor locks — a plain blocking ``q.put`` here deadlocked
+    abandoned iterators (producer parked in put holding the cursor lock).
+    Producer exceptions are re-raised in the consumer.
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
+    sentinel = object()
+    stop = threading.Event()
+    error: list = []
+
+    def emit(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def runner():
+        try:
+            produce(emit)
+        except BaseException as e:  # relayed to consumer
+            error.append(e)
+        finally:
+            while True:
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    # only drop queued batches once the consumer signalled
+                    # it is gone — a full queue at normal end-of-stream just
+                    # means the consumer has not caught up yet
+                    if stop.is_set():
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            pass
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if error:
+            raise error[0]
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+
+
 @dataclass
 class PaddedBatch:
     """Static-shape CSR batch (a pytree; arrays live on device after staging).
@@ -98,6 +157,138 @@ def _declare_batcher_sig():
     L.DmlcTpuStagedBatcherFree.argtypes = [ctypes.c_void_p]
     L._staged_batcher_declared = True
     return L
+
+
+@dataclass
+class RecordBatch:
+    """Static-shape packed RecordIO batch (device-resident after staging).
+
+    ``bytes`` is the concatenated payloads zero-padded to ``bytes_cap``;
+    record k spans ``bytes[offsets[k]:offsets[k+1]]``.  Padding offsets
+    repeat the end offset, so vectorized per-record compute over
+    ``records_cap`` lanes is numerically inert on padding lanes.
+    """
+
+    bytes: jax.Array     # u8 [bytes_cap]
+    offsets: jax.Array   # i32 [records_cap + 1]
+    num_records: jax.Array  # i32 [] true record count
+
+    @property
+    def records_cap(self) -> int:
+        return self.offsets.shape[0] - 1
+
+
+jax.tree_util.register_dataclass(
+    RecordBatch, data_fields=["bytes", "offsets", "num_records"], meta_fields=[])
+
+
+class _RecordBatchC(ctypes.Structure):
+    _fields_ = [
+        ("num_records", ctypes.c_uint32),
+        ("records_cap", ctypes.c_uint64),
+        ("bytes_cap", ctypes.c_uint64),
+        ("bytes_used", ctypes.c_uint64),
+        ("bytes", ctypes.POINTER(ctypes.c_char)),
+        ("offsets", ctypes.POINTER(ctypes.c_int32)),
+    ]
+
+
+def _declare_record_batcher_sig():
+    L = lib()
+    if getattr(L, "_record_batcher_declared", False):
+        return L
+    L.DmlcTpuRecordBatcherCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p)]
+    L.DmlcTpuRecordBatcherNext.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(_RecordBatchC)]
+    L.DmlcTpuRecordBatcherBeforeFirst.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuRecordBatcherBytesRead.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuRecordBatcherBytesRead.restype = ctypes.c_int64
+    L.DmlcTpuRecordBatcherFree.argtypes = [ctypes.c_void_p]
+    L._record_batcher_declared = True
+    return L
+
+
+class RecordStagingIter:
+    """Stage sharded RecordIO into HBM as fixed-shape packed byte batches.
+
+    The RecordIO analogue of DeviceStagingIter (BASELINE target 2): the
+    native RecordBatcher (cpp/src/data/record_batcher.h) reads and packs one
+    batch ahead; a background thread device_puts one batch ahead of the
+    consumer, so disk read, packing, and H2D DMA all overlap.
+
+    Parameters
+    ----------
+    uri : recordio dataset URI (same sharding/URI sugar as InputSplit).
+    records_cap : max records per batch (offsets array length - 1).
+    bytes_cap : byte-buffer capacity per batch (fixed device shape).
+    sharding : optional jax sharding for the staged arrays.
+    """
+
+    def __init__(self, uri: str, records_cap: int = 4096,
+                 bytes_cap: int = 1 << 22, part: int = 0, num_parts: int = 1,
+                 sharding=None, prefetch: int = 2):
+        self._lib = _declare_record_batcher_sig()
+        self._handle = ctypes.c_void_p()
+        check(self._lib.DmlcTpuRecordBatcherCreate(
+            uri.encode(), part, num_parts, records_cap, bytes_cap,
+            ctypes.byref(self._handle)))
+        self._sharding = sharding
+        self._prefetch = max(prefetch, 1)
+        self._lock = threading.Lock()
+        self.batches_staged = 0
+
+    @property
+    def bytes_read(self) -> int:
+        return self._lib.DmlcTpuRecordBatcherBytesRead(self._handle)
+
+    def close(self) -> None:
+        handle, self._handle = self._handle, ctypes.c_void_p()
+        if handle:
+            try:
+                self._lib.DmlcTpuRecordBatcherFree(handle)
+            except (AttributeError, TypeError):
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _stage(self, c: _RecordBatchC) -> RecordBatch:
+        with jax.profiler.TraceAnnotation("dmlctpu.stage_records"):
+            def put(arr):
+                if self._sharding is not None:
+                    if jax.process_count() > 1:
+                        return jax.make_array_from_process_local_data(
+                            self._sharding, arr)
+                    return jax.device_put(arr, self._sharding)
+                return jax.device_put(arr)
+
+            raw = np.frombuffer(
+                ctypes.string_at(c.bytes, int(c.bytes_cap)), dtype=np.uint8)
+            offs = np.ctypeslib.as_array(
+                c.offsets, shape=(int(c.records_cap) + 1,)).copy()
+            batch = RecordBatch(
+                bytes=put(raw),
+                offsets=put(offs),
+                num_records=jnp.asarray(np.int32(c.num_records)))
+            self.batches_staged += 1
+            return batch
+
+    def __iter__(self) -> Iterator[RecordBatch]:
+        def produce(emit):
+            with self._lock:
+                check(self._lib.DmlcTpuRecordBatcherBeforeFirst(self._handle))
+                c = _RecordBatchC()
+                while check(self._lib.DmlcTpuRecordBatcherNext(
+                        self._handle, ctypes.byref(c))) == 1:
+                    if not emit(self._stage(c)):
+                        return
+
+        yield from _staged_iter(produce, self._prefetch)
 
 
 class DeviceStagingIter:
@@ -203,37 +394,18 @@ class DeviceStagingIter:
 
     def __iter__(self) -> Iterator[PaddedBatch]:
         """Yield device-resident batches; parse/pack (C++) and device_put
-        (this background thread) run ahead of the consumer."""
-        q: queue.Queue = queue.Queue(maxsize=self._prefetch)
-        sentinel = object()
-        error: list = []
-
+        (a background thread) run ahead of the consumer."""
         self._epoch_t0 = time.monotonic()
         self._epoch_bytes0 = self.bytes_read
         self._epoch_batches0 = self.batches_staged
 
-        def producer():
-            try:
-                with self._lock:
-                    check(self._lib.DmlcTpuStagedBatcherBeforeFirst(self._handle))
-                    c = _StagedBatchC()
-                    while check(self._lib.DmlcTpuStagedBatcherNext(
-                            self._handle, ctypes.byref(c))) == 1:
-                        q.put(self._stage(c))
-            except BaseException as e:  # relayed to consumer
-                error.append(e)
-            finally:
-                q.put(sentinel)
+        def produce(emit):
+            with self._lock:
+                check(self._lib.DmlcTpuStagedBatcherBeforeFirst(self._handle))
+                c = _StagedBatchC()
+                while check(self._lib.DmlcTpuStagedBatcherNext(
+                        self._handle, ctypes.byref(c))) == 1:
+                    if not emit(self._stage(c)):
+                        return
 
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is sentinel:
-                    break
-                yield item
-            if error:
-                raise error[0]
-        finally:
-            t.join(timeout=10.0)
+        yield from _staged_iter(produce, self._prefetch)
