@@ -89,6 +89,29 @@ typedef struct {
   const int32_t* field;     /* [nnz_pad] or NULL */
 } DmlcTpuStagedBatchC;
 
+/*! \brief one fixed-shape padded COO batch in a single OWNED allocation.
+ *
+ *  All arrays live inside `arena` (64-byte-aligned offsets); the caller
+ *  owns it and must release with DmlcTpuArenaFree once every consumer of
+ *  the memory is done.  Unlike the borrowed DmlcTpuStagedBatchC, the
+ *  native pipeline recycles its internal cell before returning, so the
+ *  arena can safely back zero-copy host arrays / in-flight DMA with no
+ *  lifetime coupling to the next Next() call. */
+typedef struct {
+  uint32_t num_rows;
+  uint64_t batch_size;
+  uint64_t nnz_pad;
+  int64_t max_index;
+  void* arena;
+  uint64_t arena_bytes;
+  uint64_t label_off;    /* float [batch_size] */
+  uint64_t weight_off;   /* float [batch_size] */
+  uint64_t index_off;    /* int32 [nnz_pad] */
+  uint64_t value_off;    /* float [nnz_pad] */
+  uint64_t row_id_off;   /* int32 [nnz_pad] */
+  uint64_t field_off;    /* int32 [nnz_pad]; UINT64_MAX when absent */
+} DmlcTpuStagedBatchOwnedC;
+
 int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                const char* format, uint64_t batch_size,
                                uint64_t nnz_bucket, int with_field,
@@ -96,6 +119,11 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
 /*! \brief next batch (1/0/-1); buffers stay valid until the following call
  *  to Next/BeforeFirst/Free on this handle */
 int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBatchC* out);
+/*! \brief next batch copied into a freshly allocated arena (1/0/-1); the
+ *  copy runs without holding any interpreter lock and the internal cell is
+ *  recycled before return, keeping the parse pipeline moving */
+int DmlcTpuStagedBatcherNextOwned(DmlcTpuStagedBatcherHandle handle,
+                                  DmlcTpuStagedBatchOwnedC* out);
 int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle);
 int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle);
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle);
@@ -124,6 +152,8 @@ int64_t DmlcTpuRecordBatcherBytesRead(DmlcTpuRecordBatcherHandle handle);
 void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle);
 
 /* ---- misc ---------------------------------------------------------------- */
+/*! \brief release an arena returned by a *NextOwned call (NULL is a no-op) */
+void DmlcTpuArenaFree(void* arena);
 /*! \brief library version string */
 const char* DmlcTpuVersion(void);
 

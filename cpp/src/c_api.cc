@@ -1,6 +1,8 @@
 // C API implementation: exception → error-string translation at the boundary.
 #include "dmlctpu/c_api.h"
 
+#include <cstdlib>
+#include <cstring>
 #include <memory>
 #include <string>
 
@@ -256,6 +258,55 @@ int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBat
   });
 }
 
+int DmlcTpuStagedBatcherNextOwned(DmlcTpuStagedBatcherHandle handle,
+                                  DmlcTpuStagedBatchOwnedC* out) {
+  return Guard([&] {
+    auto* ctx = static_cast<BatcherCtx*>(handle);
+    if (ctx->borrowed != nullptr) {
+      ctx->batcher->Recycle(&ctx->borrowed);
+    }
+    if (!ctx->batcher->Next(&ctx->borrowed)) return 0;
+    const auto* b = ctx->borrowed;
+    const size_t B = ctx->batch_size;
+    const size_t nnz = b->index.size();
+    const bool with_field = !b->field.empty();
+    auto align64 = [](size_t x) { return (x + 63) & ~static_cast<size_t>(63); };
+    const size_t label_off = 0;
+    const size_t weight_off = align64(label_off + B * 4);
+    const size_t index_off = align64(weight_off + B * 4);
+    const size_t value_off = align64(index_off + nnz * 4);
+    const size_t row_id_off = align64(value_off + nnz * 4);
+    const size_t field_off = align64(row_id_off + nnz * 4);
+    const size_t total = with_field ? align64(field_off + nnz * 4) : field_off;
+    void* arena = nullptr;
+    TCHECK_EQ(::posix_memalign(&arena, 64, std::max<size_t>(total, 64)), 0)
+        << "staged-batch arena allocation failed (" << total << " bytes)";
+    char* base = static_cast<char*>(arena);
+    std::memcpy(base + label_off, b->label.data(), B * 4);
+    std::memcpy(base + weight_off, b->weight.data(), B * 4);
+    std::memcpy(base + index_off, b->index.data(), nnz * 4);
+    std::memcpy(base + value_off, b->value.data(), nnz * 4);
+    std::memcpy(base + row_id_off, b->row_id.data(), nnz * 4);
+    if (with_field) std::memcpy(base + field_off, b->field.data(), nnz * 4);
+    out->num_rows = b->num_rows;
+    out->batch_size = B;
+    out->nnz_pad = nnz;
+    out->max_index = b->max_index;
+    out->arena = arena;
+    out->arena_bytes = total;
+    out->label_off = label_off;
+    out->weight_off = weight_off;
+    out->index_off = index_off;
+    out->value_off = value_off;
+    out->row_id_off = row_id_off;
+    out->field_off = with_field ? field_off : ~static_cast<uint64_t>(0);
+    // hand the cell straight back so the pack pipeline never waits on the
+    // consumer (the arena now carries the data)
+    ctx->batcher->Recycle(&ctx->borrowed);
+    return 1;
+  });
+}
+
 int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle) {
   return Guard([&] {
     auto* ctx = static_cast<BatcherCtx*>(handle);
@@ -328,5 +379,7 @@ int64_t DmlcTpuRecordBatcherBytesRead(DmlcTpuRecordBatcherHandle handle) {
 void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle) {
   delete static_cast<RecordBatcherCtx*>(handle);
 }
+
+void DmlcTpuArenaFree(void* arena) { std::free(arena); }
 
 }  // extern "C"
