@@ -75,7 +75,12 @@ void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle);
 /* ---- StagedBatcher: parse→pack→pad pipeline for device staging ---------- */
 typedef void* DmlcTpuStagedBatcherHandle;
 
-/*! \brief borrowed view of one fixed-shape padded COO batch */
+/*! \brief borrowed view of one fixed-shape padded CSR batch.
+ *
+ *  Row membership is the CSR row pointer (the reference RowBlock's own
+ *  offset[size+1] layout): row r's nonzeros span
+ *  [row_ptr[r], row_ptr[r+1]); padding rows are empty; slots in
+ *  [row_ptr[batch_size], nnz_pad) are value-0 padding lanes. */
 typedef struct {
   uint32_t num_rows;        /* true rows (rest is padding) */
   uint64_t batch_size;      /* padded row count */
@@ -83,47 +88,58 @@ typedef struct {
   int64_t max_index;        /* max feature id seen so far (-1 if none) */
   const float* label;       /* [batch_size] */
   const float* weight;      /* [batch_size], 0 on padding rows */
+  const int32_t* row_ptr;   /* [batch_size+1] CSR row pointer */
   const int32_t* index;     /* [nnz_pad] */
   const float* value;       /* [nnz_pad], 0 on padding slots */
-  const int32_t* row_id;    /* [nnz_pad], batch_size-1 on padding slots */
   const int32_t* field;     /* [nnz_pad] or NULL */
 } DmlcTpuStagedBatchC;
 
 /*! \brief one fixed-shape padded COO batch in a single OWNED allocation.
  *
- *  All arrays live inside `arena` (64-byte-aligned offsets); the caller
- *  owns it and must release with DmlcTpuArenaFree once every consumer of
- *  the memory is done.  Unlike the borrowed DmlcTpuStagedBatchC, the
- *  native pipeline recycles its internal cell before returning, so the
- *  arena can safely back zero-copy host arrays / in-flight DMA with no
- *  lifetime coupling to the next Next() call. */
+ *  All arrays live inside `arena` (64-byte-aligned offsets; the native
+ *  pipeline packs directly into it, no extra copy).  The caller owns the
+ *  batch and must release it with DmlcTpuStagedBatchFree(batch) once every
+ *  consumer of the memory is done; the allocation is then recycled into the
+ *  batcher's arena pool, so steady state stages into warm pages.  Unlike
+ *  the borrowed DmlcTpuStagedBatchC there is no lifetime coupling to the
+ *  next Next() call, so the arena can back zero-copy host arrays and
+ *  in-flight DMA. */
 typedef struct {
   uint32_t num_rows;
   uint64_t batch_size;
   uint64_t nnz_pad;
   int64_t max_index;
-  void* arena;
+  void* batch;           /* opaque owner; release with DmlcTpuStagedBatchFree */
+  void* arena;           /* base address of the allocation */
   uint64_t arena_bytes;
   uint64_t label_off;    /* float [batch_size] */
   uint64_t weight_off;   /* float [batch_size] */
+  uint64_t row_ptr_off;  /* int32 [batch_size+1] CSR row pointer */
   uint64_t index_off;    /* int32 [nnz_pad] */
   uint64_t value_off;    /* float [nnz_pad] */
-  uint64_t row_id_off;   /* int32 [nnz_pad] */
   uint64_t field_off;    /* int32 [nnz_pad]; UINT64_MAX when absent */
 } DmlcTpuStagedBatchOwnedC;
 
+/*! \brief nnz_max: 0 = unbounded (nnz padded to nnz_bucket multiples); else
+ *  a hard per-batch nonzero cap — rows that would exceed it spill into the
+ *  next batch and every batch has nnz_pad == nnz_max (fully fixed shapes,
+ *  required for multi-host global-array staging) */
 int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                const char* format, uint64_t batch_size,
-                               uint64_t nnz_bucket, int with_field,
+                               uint64_t nnz_bucket, uint64_t nnz_max,
+                               int with_field,
                                DmlcTpuStagedBatcherHandle* out);
 /*! \brief next batch (1/0/-1); buffers stay valid until the following call
  *  to Next/BeforeFirst/Free on this handle */
 int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBatchC* out);
-/*! \brief next batch copied into a freshly allocated arena (1/0/-1); the
- *  copy runs without holding any interpreter lock and the internal cell is
+/*! \brief take ownership of the next packed batch (1/0/-1); no copy — the
+ *  pack thread produced straight into out->arena, and the internal slot is
  *  recycled before return, keeping the parse pipeline moving */
 int DmlcTpuStagedBatcherNextOwned(DmlcTpuStagedBatcherHandle handle,
                                   DmlcTpuStagedBatchOwnedC* out);
+/*! \brief release an owned batch: its arena returns to the batcher's pool
+ *  (or is freed if the pool is full/gone).  NULL is a no-op. */
+void DmlcTpuStagedBatchFree(void* batch);
 int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle);
 int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle);
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle);
@@ -152,8 +168,6 @@ int64_t DmlcTpuRecordBatcherBytesRead(DmlcTpuRecordBatcherHandle handle);
 void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle);
 
 /* ---- misc ---------------------------------------------------------------- */
-/*! \brief release an arena returned by a *NextOwned call (NULL is a no-op) */
-void DmlcTpuArenaFree(void* arena);
 /*! \brief library version string */
 const char* DmlcTpuVersion(void);
 

@@ -48,7 +48,8 @@ struct ReaderCtx {
 };
 struct BatcherCtx {
   std::unique_ptr<dmlctpu::data::StagedBatcher> batcher;
-  dmlctpu::data::StagedBatch* borrowed = nullptr;
+  // backs the borrowed DmlcTpuStagedBatcherNext view until the next call
+  dmlctpu::data::OwnedStagedBatch borrowed;
   uint64_t batch_size = 0;
 };
 struct RecordBatcherCtx {
@@ -227,7 +228,9 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
                                DmlcTpuStagedBatcherHandle* out) {
   return Guard([&] {
     auto ctx = std::make_unique<BatcherCtx>();
-    auto parser = dmlctpu::Parser<uint64_t, float>::Create(uri, part, num_parts, format);
+    // uint32 parse type: the staged device layout is int32, so the index
+    // column packs with a straight memcpy (see staged_batcher.h)
+    auto parser = dmlctpu::Parser<uint32_t, float>::Create(uri, part, num_parts, format);
     ctx->batcher = std::make_unique<dmlctpu::data::StagedBatcher>(
         std::move(parser), batch_size, nnz_bucket, with_field != 0);
     ctx->batch_size = batch_size;
@@ -236,24 +239,43 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
   });
 }
 
+namespace {
+void FillOwnedC(const dmlctpu::data::StagedArena* a, void* batch,
+                DmlcTpuStagedBatchOwnedC* out) {
+  out->num_rows = a->num_rows;
+  out->batch_size = a->batch_size;
+  out->nnz_pad = a->nnz_pad;
+  out->max_index = a->max_index;
+  out->batch = batch;
+  out->arena = a->base;
+  out->arena_bytes = a->bytes;
+  out->label_off = a->label_off;
+  out->weight_off = a->weight_off;
+  out->row_ptr_off = a->row_ptr_off;
+  out->index_off = a->index_off;
+  out->value_off = a->value_off;
+  out->field_off = a->with_field ? a->field_off : ~static_cast<uint64_t>(0);
+}
+}  // namespace
+
 int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBatchC* out) {
   return Guard([&] {
     auto* ctx = static_cast<BatcherCtx*>(handle);
-    if (ctx->borrowed != nullptr) {
-      ctx->batcher->Recycle(&ctx->borrowed);
+    if (!ctx->batcher->NextOwned(&ctx->borrowed)) {
+      ctx->borrowed.Reset();
+      return 0;
     }
-    if (!ctx->batcher->Next(&ctx->borrowed)) return 0;
-    const auto* b = ctx->borrowed;
-    out->num_rows = b->num_rows;
-    out->batch_size = ctx->batch_size;
-    out->nnz_pad = b->index.size();
-    out->max_index = b->max_index;
-    out->label = b->label.data();
-    out->weight = b->weight.data();
-    out->index = b->index.data();
-    out->value = b->value.data();
-    out->row_id = b->row_id.data();
-    out->field = b->field.empty() ? nullptr : b->field.data();
+    dmlctpu::data::StagedArena* a = ctx->borrowed.arena.get();
+    out->num_rows = a->num_rows;
+    out->batch_size = a->batch_size;
+    out->nnz_pad = a->nnz_pad;
+    out->max_index = a->max_index;
+    out->label = a->label();
+    out->weight = a->weight();
+    out->row_ptr = a->row_ptr();
+    out->index = a->index();
+    out->value = a->value();
+    out->field = a->with_field ? a->field() : nullptr;
     return 1;
   });
 }
@@ -262,58 +284,25 @@ int DmlcTpuStagedBatcherNextOwned(DmlcTpuStagedBatcherHandle handle,
                                   DmlcTpuStagedBatchOwnedC* out) {
   return Guard([&] {
     auto* ctx = static_cast<BatcherCtx*>(handle);
-    if (ctx->borrowed != nullptr) {
-      ctx->batcher->Recycle(&ctx->borrowed);
-    }
-    if (!ctx->batcher->Next(&ctx->borrowed)) return 0;
-    const auto* b = ctx->borrowed;
-    const size_t B = ctx->batch_size;
-    const size_t nnz = b->index.size();
-    const bool with_field = !b->field.empty();
-    auto align64 = [](size_t x) { return (x + 63) & ~static_cast<size_t>(63); };
-    const size_t label_off = 0;
-    const size_t weight_off = align64(label_off + B * 4);
-    const size_t index_off = align64(weight_off + B * 4);
-    const size_t value_off = align64(index_off + nnz * 4);
-    const size_t row_id_off = align64(value_off + nnz * 4);
-    const size_t field_off = align64(row_id_off + nnz * 4);
-    const size_t total = with_field ? align64(field_off + nnz * 4) : field_off;
-    void* arena = nullptr;
-    TCHECK_EQ(::posix_memalign(&arena, 64, std::max<size_t>(total, 64)), 0)
-        << "staged-batch arena allocation failed (" << total << " bytes)";
-    char* base = static_cast<char*>(arena);
-    std::memcpy(base + label_off, b->label.data(), B * 4);
-    std::memcpy(base + weight_off, b->weight.data(), B * 4);
-    std::memcpy(base + index_off, b->index.data(), nnz * 4);
-    std::memcpy(base + value_off, b->value.data(), nnz * 4);
-    std::memcpy(base + row_id_off, b->row_id.data(), nnz * 4);
-    if (with_field) std::memcpy(base + field_off, b->field.data(), nnz * 4);
-    out->num_rows = b->num_rows;
-    out->batch_size = B;
-    out->nnz_pad = nnz;
-    out->max_index = b->max_index;
-    out->arena = arena;
-    out->arena_bytes = total;
-    out->label_off = label_off;
-    out->weight_off = weight_off;
-    out->index_off = index_off;
-    out->value_off = value_off;
-    out->row_id_off = row_id_off;
-    out->field_off = with_field ? field_off : ~static_cast<uint64_t>(0);
-    // hand the cell straight back so the pack pipeline never waits on the
-    // consumer (the arena now carries the data)
-    ctx->batcher->Recycle(&ctx->borrowed);
+    auto owned = std::make_unique<dmlctpu::data::OwnedStagedBatch>();
+    if (!ctx->batcher->NextOwned(owned.get())) return 0;
+    FillOwnedC(owned->arena.get(), owned.get(), out);
+    owned.release();  // caller frees via DmlcTpuStagedBatchFree
     return 1;
   });
+}
+
+void DmlcTpuStagedBatchFree(void* batch) {
+  // returns the arena to the batcher's pool (or frees it if the pool is full
+  // or the batcher is gone — the pool is shared_ptr-held by each batch)
+  delete static_cast<dmlctpu::data::OwnedStagedBatch*>(batch);
 }
 
 int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle) {
   return Guard([&] {
     auto* ctx = static_cast<BatcherCtx*>(handle);
+    ctx->borrowed.Reset();
     ctx->batcher->BeforeFirst();
-    if (ctx->borrowed != nullptr) {
-      ctx->batcher->Recycle(&ctx->borrowed);
-    }
     return 0;
   });
 }
@@ -334,8 +323,10 @@ int DmlcTpuRecordBatcherCreate(const char* uri, unsigned part, unsigned num_part
     auto split = dmlctpu::InputSplit::Create(uri, part, num_parts, "recordio");
     ctx->batcher = std::make_unique<dmlctpu::data::RecordBatcher>(
         std::move(split), records_cap, bytes_cap);
-    ctx->records_cap = records_cap;
-    ctx->bytes_cap = bytes_cap;
+    // report the same clamped caps RecordBatcher sizes its buffers with —
+    // records_cap=0 would otherwise make consumers mis-shape the offsets view
+    ctx->records_cap = std::max<uint64_t>(records_cap, 1);
+    ctx->bytes_cap = std::max<uint64_t>(bytes_cap, 1);
     *out = ctx.release();
     return 0;
   });
@@ -380,6 +371,5 @@ void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle) {
   delete static_cast<RecordBatcherCtx*>(handle);
 }
 
-void DmlcTpuArenaFree(void* arena) { std::free(arena); }
 
 }  // extern "C"

@@ -5,44 +5,177 @@
 // batch_size (tail zero-padded, padding rows weight 0); nonzeros are padded
 // to a multiple of nnz_bucket (bounded set of XLA shapes); padded slots
 // carry value 0 and row_id batch_size-1 (numerically inert in segment-sum).
+//
+// v2 (round 3): every batch is packed DIRECTLY into one 64-byte-aligned
+// arena allocation holding all component arrays at fixed offsets, and
+// arenas are recycled through a pool once the consumer releases them:
+//   * one allocation per batch -> Python wraps the whole batch with a
+//     single buffer owner (one finalizer, zero per-array copies);
+//   * pool reuse -> steady state packs into warm pages (the v1 design
+//     wrote each batch twice: once into vectors, once into a cold arena —
+//     that second copy was the r2 staging-throughput bottleneck);
+//   * the staging parser uses uint32 indices so the index column is a
+//     straight memcpy into the int32 device layout (feature ids must fit
+//     int31 — the staged arrays are i32 on device regardless).
 // A ThreadedIter runs the packing one batch ahead of the consumer.
 #ifndef DMLCTPU_SRC_DATA_STAGED_BATCHER_H_
 #define DMLCTPU_SRC_DATA_STAGED_BATCHER_H_
 
+#include <cstdlib>
 #include <algorithm>
 #include <cstring>
 #include <memory>
+#include <mutex>
 #include <utility>
 #include <vector>
 
 #include "dmlctpu/data.h"
+#include "dmlctpu/logging.h"
 #include "dmlctpu/threaded_iter.h"
 
 namespace dmlctpu {
 namespace data {
 
-struct StagedBatch {
-  std::vector<float> label;     // [batch_size]
-  std::vector<float> weight;    // [batch_size]
-  std::vector<int32_t> index;   // [nnz_pad]
-  std::vector<float> value;     // [nnz_pad]
-  std::vector<int32_t> row_id;  // [nnz_pad]
-  std::vector<int32_t> field;   // [nnz_pad] when with_field
-  uint32_t num_rows = 0;        // true rows (<= batch_size)
-  int64_t max_index = -1;       // running max feature id seen so far
+/*! \brief one staged batch: a single aligned allocation, components at
+ *  64B-aligned offsets.  Offsets depend on the arena's nnz *capacity*
+ *  (fixed per allocation), not the batch's actual nnz, so a pooled arena
+ *  keeps its layout across reuses.
+ *
+ *  Row membership ships as a CSR row pointer (row_ptr[batch_size+1]) —
+ *  the reference RowBlock's own representation (include/dmlc/data.h:74,
+ *  offset[size+1]) — NOT a materialized per-nonzero row id: row_ptr is
+ *  batch_size+1 ints instead of nnz ints, cutting both the pack writes and
+ *  the host->HBM transfer by ~a third for sparse data; consumers derive
+ *  COO row ids on device inside jit (PaddedBatch.row_ids). */
+struct StagedArena {
+  char* base = nullptr;
+  size_t bytes = 0;       // total allocation size
+  size_t batch_size = 0;  // rows capacity (fixed for the batcher)
+  size_t nnz_cap = 0;     // index/value (/field) capacity
+  bool with_field = false;
+  size_t label_off = 0, weight_off = 0, row_ptr_off = 0, index_off = 0,
+         value_off = 0, field_off = 0;
+  // per-batch metadata (rewritten on every reuse)
+  uint32_t num_rows = 0;
+  size_t nnz_pad = 0;
+  int64_t max_index = -1;
+
+  ~StagedArena() { std::free(base); }
+
+  float* label() { return reinterpret_cast<float*>(base + label_off); }
+  float* weight() { return reinterpret_cast<float*>(base + weight_off); }
+  int32_t* row_ptr() { return reinterpret_cast<int32_t*>(base + row_ptr_off); }
+  int32_t* index() { return reinterpret_cast<int32_t*>(base + index_off); }
+  float* value() { return reinterpret_cast<float*>(base + value_off); }
+  int32_t* field() { return reinterpret_cast<int32_t*>(base + field_off); }
+
+  static std::unique_ptr<StagedArena> Make(size_t batch_size, size_t nnz_cap,
+                                           bool with_field) {
+    auto a = std::unique_ptr<StagedArena>(new StagedArena());
+    a->batch_size = batch_size;
+    a->nnz_cap = nnz_cap;
+    a->with_field = with_field;
+    auto align64 = [](size_t x) { return (x + 63) & ~static_cast<size_t>(63); };
+    // fixed-size components first so their offsets are reuse-stable
+    a->label_off = 0;
+    a->weight_off = align64(a->label_off + batch_size * 4);
+    a->row_ptr_off = align64(a->weight_off + batch_size * 4);
+    a->index_off = align64(a->row_ptr_off + (batch_size + 1) * 4);
+    a->value_off = align64(a->index_off + nnz_cap * 4);
+    a->field_off = align64(a->value_off + nnz_cap * 4);
+    a->bytes = with_field ? align64(a->field_off + nnz_cap * 4) : a->field_off;
+    void* p = nullptr;
+    TCHECK_EQ(::posix_memalign(&p, 64, std::max<size_t>(a->bytes, 64)), 0)
+        << "staged-batch arena allocation failed (" << a->bytes << " bytes)";
+    a->base = static_cast<char*>(p);
+    return a;
+  }
 };
 
-class StagedBatcher {
+/*! \brief bounded free-list of arenas; Release beyond the cap frees.
+ *  Shared (shared_ptr) between the batcher and every in-flight owned batch,
+ *  so consumers can release safely after the batcher is destroyed. */
+class StagedArenaPool {
  public:
-  StagedBatcher(std::unique_ptr<Parser<uint64_t, float>> parser, size_t batch_size,
-                size_t nnz_bucket, bool with_field)
+  explicit StagedArenaPool(size_t max_free) : max_free_(max_free) {}
+
+  std::unique_ptr<StagedArena> Acquire(size_t batch_size, size_t min_nnz_cap,
+                                       bool with_field) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      // prefer the largest pooled arena: packing grows capacity adaptively,
+      // so after warmup every pooled arena already fits a full batch
+      auto best = free_.end();
+      for (auto it = free_.begin(); it != free_.end(); ++it) {
+        if ((*it)->batch_size == batch_size && (*it)->with_field == with_field &&
+            (best == free_.end() || (*it)->nnz_cap > (*best)->nnz_cap)) {
+          best = it;
+        }
+      }
+      if (best != free_.end() && (*best)->nnz_cap >= min_nnz_cap) {
+        auto a = std::move(*best);
+        free_.erase(best);
+        return a;
+      }
+    }
+    return StagedArena::Make(batch_size, min_nnz_cap, with_field);
+  }
+
+  void Release(std::unique_ptr<StagedArena> a) {
+    if (a == nullptr) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    if (free_.size() < max_free_) free_.push_back(std::move(a));
+  }
+
+ private:
+  std::mutex mu_;
+  std::vector<std::unique_ptr<StagedArena>> free_;
+  size_t max_free_;
+};
+
+/*! \brief an arena handed to the consumer; returns to the pool on destruction */
+struct OwnedStagedBatch {
+  std::shared_ptr<StagedArenaPool> pool;
+  std::unique_ptr<StagedArena> arena;
+
+  OwnedStagedBatch() = default;
+  OwnedStagedBatch(OwnedStagedBatch&&) = default;
+  OwnedStagedBatch& operator=(OwnedStagedBatch&& o) {
+    Reset();
+    pool = std::move(o.pool);
+    arena = std::move(o.arena);
+    return *this;
+  }
+  ~OwnedStagedBatch() { Reset(); }
+  void Reset() {
+    if (pool && arena) pool->Release(std::move(arena));
+    arena.reset();
+  }
+};
+
+template <typename IndexType>
+class StagedBatcherT {
+ public:
+  /*!
+   * \param nnz_max if nonzero, a HARD nonzero cap per batch: packing stops
+   *   taking rows once the next row would exceed it, and every emitted
+   *   batch has nnz_pad == nnz_max exactly (fully fixed shapes — required
+   *   for multi-host global-array assembly, where every process must
+   *   contribute identically-shaped shards).  0 = unbounded, nnz padded to
+   *   the next nnz_bucket multiple (a small set of shapes).
+   */
+  StagedBatcherT(std::unique_ptr<Parser<IndexType, float>> parser,
+                 size_t batch_size, size_t nnz_bucket, bool with_field,
+                 size_t nnz_max = 0)
       : parser_(std::move(parser)),
         batch_size_(batch_size),
         nnz_bucket_(std::max<size_t>(nnz_bucket, 1)),
+        nnz_max_(nnz_max),
         with_field_(with_field),
-        iter_(4) {
+        pool_(std::make_shared<StagedArenaPool>(kIterDepth + 2)),
+        iter_(kIterDepth) {
     parser_->BeforeFirst();
-    iter_.Init([this](StagedBatch** cell) { return Produce(cell); },
+    iter_.Init([this](Slot** cell) { return Produce(cell); },
                [this] {
                  parser_->BeforeFirst();
                  have_block_ = false;
@@ -51,31 +184,49 @@ class StagedBatcher {
                  source_end_ = false;
                });
   }
-  ~StagedBatcher() { iter_.Destroy(); }
+  ~StagedBatcherT() { iter_.Destroy(); }
 
-  /*! \brief borrow the next batch; call Recycle when the consumer copied it out */
-  bool Next(StagedBatch** out) { return iter_.Next(out); }
-  void Recycle(StagedBatch** inout) { iter_.Recycle(inout); }
+  /*! \brief take ownership of the next packed batch; false at end of data */
+  bool NextOwned(OwnedStagedBatch* out) {
+    Slot* s = nullptr;
+    if (!iter_.Next(&s)) return false;
+    out->Reset();
+    out->pool = pool_;
+    out->arena = std::move(s->arena);
+    iter_.Recycle(&s);
+    return true;
+  }
   void BeforeFirst() { iter_.BeforeFirst(); }
   size_t BytesRead() const { return parser_->BytesRead(); }
+  std::shared_ptr<StagedArenaPool> pool() const { return pool_; }
 
  private:
-  // Single-copy pipeline: rows stream straight from the parser's RowBlock
-  // view into the staged output arrays (no intermediate pool).  A cursor
-  // tracks partial consumption of the current block across batch
+  static constexpr size_t kIterDepth = 4;
+
+  struct Slot {
+    std::unique_ptr<StagedArena> arena;  // freed (not pooled) on iter Destroy
+  };
+
+  size_t BucketRound(size_t nnz) const {
+    size_t b = ((nnz + nnz_bucket_ - 1) / nnz_bucket_) * nnz_bucket_;
+    return b == 0 ? nnz_bucket_ : b;
+  }
+
+  // Pack rows straight from the parser's RowBlock views into the arena.  A
+  // cursor tracks partial consumption of the current block across batch
   // boundaries; the view stays valid until the next parser_->Next().
-  bool Produce(StagedBatch** cell) {
-    if (*cell == nullptr) *cell = new StagedBatch();
-    StagedBatch* out = *cell;
+  bool Produce(Slot** cell) {
+    if (*cell == nullptr) *cell = new Slot();
+    Slot* slot = *cell;
+    if (slot->arena == nullptr) {
+      slot->arena = pool_->Acquire(batch_size_, BucketRound(last_nnz_ + 1),
+                                   with_field_);
+    }
+    StagedArena* a = slot->arena.get();
     const size_t B = batch_size_;
-    out->label.resize(B);
-    out->weight.resize(B);
-    out->index.clear();
-    out->value.clear();
-    out->field.clear();
-    row_nnz_end_.clear();
 
     size_t rows = 0;
+    size_t nnz = 0;
     while (rows < B) {
       if (!have_block_) {
         if (source_end_ || !parser_->Next()) {
@@ -88,98 +239,158 @@ class StagedBatcher {
         continue;
       }
       size_t take = std::min(B - rows, block_.size - cur_row_);
-      AppendRows(out, rows, take);
+      size_t take_nnz =
+          block_.offset[cur_row_ + take] - block_.offset[cur_row_];
+      if (nnz_max_ != 0 && nnz + take_nnz > nnz_max_) {
+        // shrink take to the most rows whose nonzeros still fit the cap
+        size_t budget = nnz_max_ - nnz;
+        size_t lo = 0, hi = take;
+        while (lo < hi) {
+          size_t mid = (lo + hi + 1) / 2;
+          if (block_.offset[cur_row_ + mid] - block_.offset[cur_row_] <= budget) {
+            lo = mid;
+          } else {
+            hi = mid - 1;
+          }
+        }
+        take = lo;
+        take_nnz = block_.offset[cur_row_ + take] - block_.offset[cur_row_];
+        if (take == 0) {
+          TCHECK(rows != 0 || nnz != 0)
+              << "a single row has more than nnz_max=" << nnz_max_
+              << " nonzeros; raise nnz_max";
+          break;  // batch is nnz-full; the row goes into the next batch
+        }
+      }
+      if (nnz + take_nnz > a->nnz_cap) {
+        Grow(slot, nnz, nnz + take_nnz);
+        a = slot->arena.get();
+      }
+      AppendRows(a, rows, nnz, take);
       rows += take;
+      nnz += take_nnz;
       cur_row_ += take;
       if (cur_row_ == block_.size) have_block_ = false;
     }
     if (rows == 0) return false;
-    Finalize(out, rows);
+    last_nnz_ = nnz;
+    Finalize(slot, rows, nnz);
     return true;
   }
 
-  /*! \brief copy rows [cur_row_, cur_row_+take) of block_ into out at row base */
-  void AppendRows(StagedBatch* out, size_t base, size_t take) {
-    const RowBlock<uint64_t, float>& b = block_;
+  /*! \brief grow the slot's arena to fit need_nnz, keeping packed data */
+  void Grow(Slot* slot, size_t packed_nnz, size_t need_nnz) {
+    StagedArena* old = slot->arena.get();
+    size_t new_cap = BucketRound(std::max(need_nnz, old->nnz_cap * 2));
+    auto bigger = pool_->Acquire(batch_size_, new_cap, with_field_);
+    std::memcpy(bigger->label(), old->label(), batch_size_ * 4);
+    std::memcpy(bigger->weight(), old->weight(), batch_size_ * 4);
+    std::memcpy(bigger->row_ptr(), old->row_ptr(), (batch_size_ + 1) * 4);
+    std::memcpy(bigger->index(), old->index(), packed_nnz * 4);
+    std::memcpy(bigger->value(), old->value(), packed_nnz * 4);
+    if (with_field_) std::memcpy(bigger->field(), old->field(), packed_nnz * 4);
+    pool_->Release(std::move(slot->arena));
+    slot->arena = std::move(bigger);
+  }
+
+  /*! \brief copy rows [cur_row_, cur_row_+take) of block_ into the arena */
+  void AppendRows(StagedArena* a, size_t row_base, size_t nnz_base, size_t take) {
+    const RowBlock<IndexType, float>& b = block_;
     size_t lo = b.offset[cur_row_] - b.offset[0];
     size_t hi = b.offset[cur_row_ + take] - b.offset[0];
     size_t nnz = hi - lo;
-    size_t out_nnz = out->index.size();
-    std::memcpy(out->label.data() + base, b.label + cur_row_, take * sizeof(float));
+    std::memcpy(a->label() + row_base, b.label + cur_row_, take * sizeof(float));
     if (b.weight != nullptr) {
-      std::memcpy(out->weight.data() + base, b.weight + cur_row_, take * sizeof(float));
+      std::memcpy(a->weight() + row_base, b.weight + cur_row_, take * sizeof(float));
     } else {
-      std::fill(out->weight.data() + base, out->weight.data() + base + take, 1.0f);
+      std::fill(a->weight() + row_base, a->weight() + row_base + take, 1.0f);
     }
-    const uint64_t* idx = b.index + b.offset[0] + lo;
-    out->index.resize(out_nnz + nnz);
-    int64_t mx = max_index_;
-    for (size_t k = 0; k < nnz; ++k) {
-      uint64_t v = idx[k];
-      out->index[out_nnz + k] = static_cast<int32_t>(v);
-      mx = std::max(mx, static_cast<int64_t>(v));
-    }
-    max_index_ = mx;
-    out->value.resize(out_nnz + nnz);
+    CopyIndex(a->index() + nnz_base, b.index + b.offset[0] + lo, nnz);
     if (b.value != nullptr) {
-      std::memcpy(out->value.data() + out_nnz, b.value + b.offset[0] + lo,
+      std::memcpy(a->value() + nnz_base, b.value + b.offset[0] + lo,
                   nnz * sizeof(float));
     } else {
-      std::fill(out->value.begin() + out_nnz, out->value.end(), 1.0f);
+      std::fill(a->value() + nnz_base, a->value() + nnz_base + nnz, 1.0f);
     }
     if (with_field_) {
-      out->field.resize(out_nnz + nnz);
       if (b.field != nullptr) {
-        const uint64_t* fld = b.field + b.offset[0] + lo;
-        for (size_t k = 0; k < nnz; ++k) {
-          out->field[out_nnz + k] = static_cast<int32_t>(fld[k]);
-        }
+        CopyIndex(a->field() + nnz_base, b.field + b.offset[0] + lo, nnz);
       } else {
-        std::fill(out->field.begin() + out_nnz, out->field.end(), 0);
+        std::fill(a->field() + nnz_base, a->field() + nnz_base + nnz, 0);
       }
     }
+    int32_t* row_ptr = a->row_ptr();
     for (size_t r = 0; r < take; ++r) {
-      row_nnz_end_.push_back(out_nnz + (b.offset[cur_row_ + r + 1] - b.offset[0] - lo));
+      row_ptr[row_base + r + 1] = static_cast<int32_t>(
+          nnz_base + (b.offset[cur_row_ + r + 1] - b.offset[0] - lo));
     }
   }
 
-  /*! \brief zero-pad rows to batch_size and nonzeros to the bucket multiple */
-  void Finalize(StagedBatch* out, size_t rows) {
+  // uint32 source: the int32 device column is a raw copy (ids must fit
+  // int31; the staged layout is i32 on device either way).  uint64 source:
+  // narrowing store loop.
+  static void CopyIndex(int32_t* dst, const uint32_t* src, size_t n) {
+    std::memcpy(dst, src, n * sizeof(int32_t));
+  }
+  static void CopyIndex(int32_t* dst, const uint64_t* src, size_t n) {
+    for (size_t k = 0; k < n; ++k) dst[k] = static_cast<int32_t>(src[k]);
+  }
+
+  /*! \brief zero-pad rows to batch_size / nnz to the bucket multiple,
+   *  close the CSR row pointer, and record batch metadata in the arena */
+  void Finalize(Slot* slot, size_t rows, size_t nnz) {
     const size_t B = batch_size_;
-    size_t nnz = out->index.size();
-    size_t nnz_pad = ((nnz + nnz_bucket_ - 1) / nnz_bucket_) * nnz_bucket_;
-    if (nnz_pad == 0) nnz_pad = nnz_bucket_;
-    out->num_rows = static_cast<uint32_t>(rows);
-    out->max_index = max_index_;
-    std::fill(out->label.begin() + rows, out->label.end(), 0.0f);
-    std::fill(out->weight.begin() + rows, out->weight.end(), 0.0f);
-    out->index.resize(nnz_pad, 0);
-    out->value.resize(nnz_pad, 0.0f);
-    out->row_id.resize(nnz_pad);
-    size_t prev_end = 0;
-    for (size_t r = 0; r < rows; ++r) {
-      size_t end = row_nnz_end_[r];
-      std::fill(out->row_id.begin() + prev_end, out->row_id.begin() + end,
-                static_cast<int32_t>(r));
-      prev_end = end;
+    size_t nnz_pad = nnz_max_ != 0 ? nnz_max_ : BucketRound(nnz);
+    if (nnz_pad > slot->arena->nnz_cap) Grow(slot, nnz, nnz_pad);
+    StagedArena* a = slot->arena.get();
+    std::fill(a->label() + rows, a->label() + B, 0.0f);
+    std::fill(a->weight() + rows, a->weight() + B, 0.0f);
+    std::fill(a->index() + nnz, a->index() + nnz_pad, 0);
+    std::fill(a->value() + nnz, a->value() + nnz_pad, 0.0f);
+    int32_t* row_ptr = a->row_ptr();
+    row_ptr[0] = 0;
+    // padding rows are empty: start == end == nnz
+    std::fill(row_ptr + rows + 1, row_ptr + B + 1, static_cast<int32_t>(nnz));
+    if (with_field_) std::fill(a->field() + nnz, a->field() + nnz_pad, 0);
+    // batch max feature id: tight int32 scan (auto-vectorizes), merged into
+    // the running max so max_index stays cumulative across batches.  A
+    // negative value means the uint32 parse held an id >= 2^31 that the
+    // int32 device layout cannot represent — fail loudly instead of letting
+    // w[negative] wrap silently on device.
+    const int32_t* idx = a->index();
+    int32_t mx = -1;
+    int32_t mn = 0;
+    for (size_t k = 0; k < nnz; ++k) {
+      mx = std::max(mx, idx[k]);
+      mn = std::min(mn, idx[k]);
     }
-    std::fill(out->row_id.begin() + nnz, out->row_id.end(),
-              static_cast<int32_t>(B - 1));
-    if (with_field_) out->field.resize(nnz_pad, 0);
+    TCHECK_GE(mn, 0) << "feature id >= 2^31 in staged batch: the device "
+                     << "layout is int32; ids must be < 2147483648";
+    max_index_ = std::max(max_index_, static_cast<int64_t>(mx));
+    a->num_rows = static_cast<uint32_t>(rows);
+    a->nnz_pad = nnz_pad;
+    a->max_index = max_index_;
   }
 
-  std::unique_ptr<Parser<uint64_t, float>> parser_;
+  std::unique_ptr<Parser<IndexType, float>> parser_;
   size_t batch_size_;
   size_t nnz_bucket_;
+  size_t nnz_max_;
   bool with_field_;
-  RowBlock<uint64_t, float> block_{};
+  RowBlock<IndexType, float> block_{};
   size_t cur_row_ = 0;
   bool have_block_ = false;
   int64_t max_index_ = -1;
-  std::vector<size_t> row_nnz_end_;
+  size_t last_nnz_ = 0;  // sizing hint for the next arena acquisition
   bool source_end_ = false;
-  ThreadedIter<StagedBatch> iter_;
+  std::shared_ptr<StagedArenaPool> pool_;
+  ThreadedIter<Slot> iter_;
 };
+
+// The staging pipeline parses with uint32 indices: the device layout is
+// int32, so a wider parse type would only add a narrowing pass (this was
+// the r2 pack bottleneck).
+using StagedBatcher = StagedBatcherT<uint32_t>;
 
 }  // namespace data
 }  // namespace dmlctpu

@@ -4,20 +4,28 @@ padded CSR batches resident in TPU HBM.
 
 Design (SURVEY.md §7 step 7):
   * the parse→pack→pad pipeline is NATIVE (cpp/src/data/staged_batcher.h):
-    a C++ StagedBatcher drains the parser's RowBlocks into fixed-size
-    batches one batch ahead of the consumer, so Python only wraps buffers;
+    a C++ StagedBatcher packs the parser's RowBlocks straight into pooled
+    single-allocation arenas one batch ahead of the consumer; Python wraps
+    the arena zero-copy (one buffer owner, one finalizer per batch) and
+    releases it back to the native pool when the last array dies;
   * rows are packed to a fixed ``batch_size`` (final short batch zero-padded,
     padding rows carry weight 0 so losses ignore them);
   * nonzeros are padded to the next multiple of ``nnz_bucket`` — a handful of
     distinct shapes total, so XLA compiles a handful of executables instead
     of one per batch (ragged shapes would retrace every step);
-  * padded nnz slots point at row ``batch_size-1`` / column 0 with value 0 —
+  * row membership ships as the CSR row pointer (``row_ptr[batch_size+1]``,
+    the reference RowBlock's own offset[] layout) — B+1 ints over the host→
+    HBM link instead of nnz ints; COO row ids are derived on device inside
+    jit (``PaddedBatch.row_ids``), where they fuse into the consumer;
+  * padded nnz slots carry value 0 (and derive row ``batch_size-1``) —
     numerically inert in segment-sum compute;
-  * a Python thread runs ``device_put`` one batch ahead (double buffering):
-    the host→HBM DMA of batch N+1 overlaps the device compute of batch N;
+  * a Python thread stages one batched ``device_put`` per batch ahead of the
+    consumer (double buffering): the host→HBM DMA of batch N+1 overlaps the
+    device compute of batch N;
   * with a mesh, batches are laid out sharded over the data axis via
     ``jax.make_array_from_process_local_data`` (multi-host: each process
-    contributes its local InputSplit shard; single host: plain sharded put).
+    contributes its local InputSplit shard; single host: plain sharded put);
+    ``row_ptr`` and ``num_rows`` are replicated.
 """
 from __future__ import annotations
 
@@ -26,6 +34,7 @@ import logging
 import queue
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -86,10 +95,12 @@ def _staged_iter(produce, prefetch: int):
 
     t = threading.Thread(target=runner, daemon=True)
     t.start()
+    reached_end = False
     try:
         while True:
             item = q.get()
             if item is sentinel:
+                reached_end = True
                 break
             yield item
         if error:
@@ -97,21 +108,29 @@ def _staged_iter(produce, prefetch: int):
     finally:
         stop.set()
         t.join(timeout=10.0)
+        if error and not reached_end:
+            # the consumer broke out before draining: surface the producer
+            # failure instead of swallowing it in generator close
+            LOGGER.warning("staging producer failed after consumer break: %r",
+                           error[0])
 
 
 @dataclass
 class PaddedBatch:
     """Static-shape CSR batch (a pytree; arrays live on device after staging).
 
-    nnz arrays are flattened COO: ``row_id[k]`` is the row of nonzero k.
-    Padding rows have ``weight == 0``; padding nonzeros have ``value == 0``.
+    Row r's nonzeros span ``index/value[row_ptr[r]:row_ptr[r+1]]`` — the
+    reference RowBlock's offset[] layout (include/dmlc/data.h:74).  Padding
+    rows have ``weight == 0`` and empty spans; padding nonzero lanes (k >=
+    row_ptr[batch_size]) have ``value == 0``.  Use :meth:`row_ids` inside a
+    jitted consumer for the flattened COO view.
     """
 
     label: jax.Array    # f32 [batch]
     weight: jax.Array   # f32 [batch]
+    row_ptr: jax.Array  # i32 [batch + 1] CSR row pointer
     index: jax.Array    # i32 [nnz_pad] column ids
     value: jax.Array    # f32 [nnz_pad]
-    row_id: jax.Array   # i32 [nnz_pad]
     num_rows: jax.Array  # i32 [] true (unpadded) row count
     field: Optional[jax.Array] = None  # i32 [nnz_pad] (libfm)
 
@@ -119,10 +138,21 @@ class PaddedBatch:
     def batch_size(self) -> int:
         return self.label.shape[0]
 
+    def row_ids(self) -> jax.Array:
+        """COO row id per nonzero, derived on device (fuses under jit).
+
+        Padding lanes map to row ``batch_size - 1`` (their value is 0, so
+        segment reductions are unaffected).
+        """
+        k = jnp.arange(self.index.shape[0], dtype=self.row_ptr.dtype)
+        r = jnp.searchsorted(self.row_ptr, k, side="right") - 1
+        return jnp.minimum(r, self.batch_size - 1).astype(jnp.int32)
+
 
 jax.tree_util.register_dataclass(
     PaddedBatch,
-    data_fields=["label", "weight", "index", "value", "row_id", "num_rows", "field"],
+    data_fields=["label", "weight", "row_ptr", "index", "value", "num_rows",
+                 "field"],
     meta_fields=[])
 
 
@@ -134,11 +164,32 @@ class _StagedBatchC(ctypes.Structure):
         ("max_index", ctypes.c_int64),
         ("label", ctypes.POINTER(ctypes.c_float)),
         ("weight", ctypes.POINTER(ctypes.c_float)),
+        ("row_ptr", ctypes.POINTER(ctypes.c_int32)),
         ("index", ctypes.POINTER(ctypes.c_int32)),
         ("value", ctypes.POINTER(ctypes.c_float)),
-        ("row_id", ctypes.POINTER(ctypes.c_int32)),
         ("field", ctypes.POINTER(ctypes.c_int32)),
     ]
+
+
+class _StagedBatchOwnedC(ctypes.Structure):
+    _fields_ = [
+        ("num_rows", ctypes.c_uint32),
+        ("batch_size", ctypes.c_uint64),
+        ("nnz_pad", ctypes.c_uint64),
+        ("max_index", ctypes.c_int64),
+        ("batch", ctypes.c_void_p),
+        ("arena", ctypes.c_void_p),
+        ("arena_bytes", ctypes.c_uint64),
+        ("label_off", ctypes.c_uint64),
+        ("weight_off", ctypes.c_uint64),
+        ("row_ptr_off", ctypes.c_uint64),
+        ("index_off", ctypes.c_uint64),
+        ("value_off", ctypes.c_uint64),
+        ("field_off", ctypes.c_uint64),
+    ]
+
+
+_NO_FIELD = (1 << 64) - 1  # field_off sentinel: batch has no field column
 
 
 def _declare_batcher_sig():
@@ -151,6 +202,10 @@ def _declare_batcher_sig():
         ctypes.POINTER(ctypes.c_void_p)]
     L.DmlcTpuStagedBatcherNext.argtypes = [ctypes.c_void_p,
                                            ctypes.POINTER(_StagedBatchC)]
+    L.DmlcTpuStagedBatcherNextOwned.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_StagedBatchOwnedC)]
+    L.DmlcTpuStagedBatchFree.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuStagedBatchFree.restype = None
     L.DmlcTpuStagedBatcherBeforeFirst.argtypes = [ctypes.c_void_p]
     L.DmlcTpuStagedBatcherBytesRead.argtypes = [ctypes.c_void_p]
     L.DmlcTpuStagedBatcherBytesRead.restype = ctypes.c_int64
@@ -244,12 +299,23 @@ class RecordStagingIter:
         return self._lib.DmlcTpuRecordBatcherBytesRead(self._handle)
 
     def close(self) -> None:
-        handle, self._handle = self._handle, ctypes.c_void_p()
-        if handle:
-            try:
-                self._lib.DmlcTpuRecordBatcherFree(handle)
-            except (AttributeError, TypeError):
-                pass
+        # serialize with the producer thread: freeing the native batcher while
+        # a Next call is still in flight would be a use-after-free.  Bounded
+        # wait — on timeout the producer still owns the cursor, so leak the
+        # handle rather than crash it.
+        if not self._lock.acquire(timeout=30.0):
+            LOGGER.warning("RecordStagingIter.close: producer still busy; "
+                           "leaking native handle")
+            return
+        try:
+            handle, self._handle = self._handle, ctypes.c_void_p()
+            if handle:
+                try:
+                    self._lib.DmlcTpuRecordBatcherFree(handle)
+                except (AttributeError, TypeError):
+                    pass
+        finally:
+            self._lock.release()
 
     def __del__(self):
         try:
@@ -337,12 +403,20 @@ class DeviceStagingIter:
         return self._max_index
 
     def close(self) -> None:
-        handle, self._handle = self._handle, ctypes.c_void_p()
-        if handle:
-            try:
-                self._lib.DmlcTpuStagedBatcherFree(handle)
-            except (AttributeError, TypeError):
-                pass  # interpreter shutdown already tore down ctypes
+        # serialize with the producer thread (see RecordStagingIter.close)
+        if not self._lock.acquire(timeout=30.0):
+            LOGGER.warning("DeviceStagingIter.close: producer still busy; "
+                           "leaking native handle")
+            return
+        try:
+            handle, self._handle = self._handle, ctypes.c_void_p()
+            if handle:
+                try:
+                    self._lib.DmlcTpuStagedBatcherFree(handle)
+                except (AttributeError, TypeError):
+                    pass  # interpreter shutdown already tore down ctypes
+        finally:
+            self._lock.release()
 
     def __del__(self):
         try:
@@ -351,37 +425,62 @@ class DeviceStagingIter:
             pass
 
     # ---- staging ------------------------------------------------------------
-    def _stage(self, c: _StagedBatchC) -> PaddedBatch:
+    def _stage(self, c: _StagedBatchOwnedC) -> PaddedBatch:
         # visible as one span per staged batch in jax profiler / xplane traces
         with jax.profiler.TraceAnnotation("dmlctpu.stage_batch"):
             return self._stage_inner(c)
 
-    def _stage_inner(self, c: _StagedBatchC) -> PaddedBatch:
-        B = c.batch_size
-        nnz = c.nnz_pad
+    def _stage_inner(self, c: _StagedBatchOwnedC) -> PaddedBatch:
+        B = int(c.batch_size)
+        nnz = int(c.nnz_pad)
+        # Zero-copy wrap of the owned arena: every array is a view into one
+        # buffer object; when the last view (or device_put alias) dies, the
+        # finalizer returns the arena to the native pool.  No per-array copy.
+        buf = (ctypes.c_uint8 * int(c.arena_bytes)).from_address(c.arena)
+        weakref.finalize(buf, self._lib.DmlcTpuStagedBatchFree,
+                         ctypes.c_void_p(c.batch))
 
-        def view(ptr, n):
-            # snapshot into an owned array: the native buffer is recycled on
-            # the next cursor advance, and jax's CPU backend zero-copy-aliases
-            # well-aligned numpy buffers (a dangling alias otherwise)
-            return np.ctypeslib.as_array(ptr, shape=(int(n),)).copy()
+        def arr(off, count, dtype):
+            return np.frombuffer(buf, dtype=dtype, count=count, offset=int(off))
 
-        def put(arr):
-            if self._sharding is not None:
-                if jax.process_count() > 1:
-                    return jax.make_array_from_process_local_data(self._sharding, arr)
-                return jax.device_put(arr, self._sharding)
-            return jax.device_put(arr)
+        label = arr(c.label_off, B, np.float32)
+        weight = arr(c.weight_off, B, np.float32)
+        row_ptr = arr(c.row_ptr_off, B + 1, np.int32)
+        index = arr(c.index_off, nnz, np.int32)
+        value = arr(c.value_off, nnz, np.float32)
+        with_field = self._with_field and c.field_off != _NO_FIELD
+        field = arr(c.field_off, nnz, np.int32) if with_field else None
+        num_rows = np.int32(c.num_rows)
+
+        if self._sharding is None:
+            # one batched dispatch for the whole pytree
+            leaves = (label, weight, row_ptr, index, value, num_rows) + (
+                (field,) if with_field else ())
+            staged = jax.device_put(leaves)
+        elif jax.process_count() > 1:
+            # multi-host: each process contributes its local shard of the
+            # data-sharded leaves; row_ptr/num_rows are replicated
+            repl = self._replicated_sharding()
+            put_s = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+                self._sharding, a)
+            staged = (put_s(label), put_s(weight),
+                      jax.device_put(row_ptr, repl),
+                      put_s(index), put_s(value),
+                      jax.device_put(num_rows, repl)) + (
+                          (put_s(field),) if with_field else ())
+        else:
+            repl = self._replicated_sharding()
+            shardings = (self._sharding, self._sharding, repl,
+                         self._sharding, self._sharding, repl) + (
+                             (self._sharding,) if with_field else ())
+            leaves = (label, weight, row_ptr, index, value, num_rows) + (
+                (field,) if with_field else ())
+            staged = jax.device_put(leaves, shardings)
 
         batch = PaddedBatch(
-            label=put(view(c.label, B)),
-            weight=put(view(c.weight, B)),
-            index=put(view(c.index, nnz)),
-            value=put(view(c.value, nnz)),
-            row_id=put(view(c.row_id, nnz)),
-            num_rows=jnp.asarray(np.int32(c.num_rows)),
-            field=put(view(c.field, nnz)) if (self._with_field and c.field) else None,
-        )
+            label=staged[0], weight=staged[1], row_ptr=staged[2],
+            index=staged[3], value=staged[4], num_rows=staged[5],
+            field=staged[6] if with_field else None)
         self._max_index = max(self._max_index, int(c.max_index))
         self.batches_staged += 1
         epoch_batches = self.batches_staged - self._epoch_batches0
@@ -391,6 +490,12 @@ class DeviceStagingIter:
             LOGGER.info("staged %d batches, %.2f MB/sec -> device",
                         epoch_batches, epoch_mb / secs)
         return batch
+
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        if isinstance(self._sharding, NamedSharding):
+            return NamedSharding(self._sharding.mesh, PartitionSpec())
+        return self._sharding  # best effort for exotic sharding types
 
     def __iter__(self) -> Iterator[PaddedBatch]:
         """Yield device-resident batches; parse/pack (C++) and device_put
@@ -402,8 +507,8 @@ class DeviceStagingIter:
         def produce(emit):
             with self._lock:
                 check(self._lib.DmlcTpuStagedBatcherBeforeFirst(self._handle))
-                c = _StagedBatchC()
-                while check(self._lib.DmlcTpuStagedBatcherNext(
+                c = _StagedBatchOwnedC()
+                while check(self._lib.DmlcTpuStagedBatcherNextOwned(
                         self._handle, ctypes.byref(c))) == 1:
                     if not emit(self._stage(c)):
                         return

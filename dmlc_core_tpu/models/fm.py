@@ -42,10 +42,11 @@ class FactorizationMachine:
 
     def margins(self, params: dict, batch: PaddedBatch) -> jax.Array:
         B = batch.batch_size
-        linear = csr_matvec(params["w"], batch.index, batch.value, batch.row_id, B)
-        vx = csr_matmul(params["v"], batch.index, batch.value, batch.row_id, B)  # [B,K]
+        rid = batch.row_ids()  # derived on device; CSE'd across the three uses
+        linear = csr_matvec(params["w"], batch.index, batch.value, rid, B)
+        vx = csr_matmul(params["v"], batch.index, batch.value, rid, B)  # [B,K]
         v2x2 = csr_row_sumsq_matmul(params["v"], batch.index, batch.value,
-                                    batch.row_id, B)  # [B,K]
+                                    rid, B)  # [B,K]
         second = 0.5 * jnp.sum(vx ** 2 - v2x2, axis=-1)
         return linear + second + params["b"]
 

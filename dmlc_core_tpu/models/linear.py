@@ -41,8 +41,8 @@ class SparseLinearModel:
     # ---- pure functions (jit-friendly) --------------------------------------
     def margins(self, params: dict, batch: PaddedBatch) -> jax.Array:
         """Per-row scores w·x + b."""
-        return csr_matvec(params["w"], batch.index, batch.value, batch.row_id,
-                          batch.batch_size) + params["b"]
+        return csr_matvec(params["w"], batch.index, batch.value,
+                          batch.row_ids(), batch.batch_size) + params["b"]
 
     def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
         m = self.margins(params, batch)

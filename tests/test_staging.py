@@ -27,7 +27,8 @@ def test_static_shapes_and_bucketing(libsvm_file):
     rows_total = 0
     for batch in it:
         assert batch.label.shape == (256,)
-        assert batch.index.shape == batch.value.shape == batch.row_id.shape
+        assert batch.row_ptr.shape == (257,)
+        assert batch.index.shape == batch.value.shape == batch.row_ids().shape
         assert batch.index.shape[0] % 512 == 0
         shapes.add(batch.index.shape[0])
         rows_total += int(batch.num_rows)
@@ -49,7 +50,7 @@ def test_padding_is_inert(libsvm_file):
                 expected_rows.append(vals[lo:hi].sum())
     got = []
     for batch in it:
-        per_row = jax.ops.segment_sum(w[batch.index] * batch.value, batch.row_id,
+        per_row = jax.ops.segment_sum(w[batch.index] * batch.value, batch.row_ids(),
                                       num_segments=batch.batch_size)
         got.extend(np.asarray(per_row)[: int(batch.num_rows)].tolist())
         # padding rows have weight 0
