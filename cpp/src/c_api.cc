@@ -224,7 +224,8 @@ void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle) {
 
 int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                const char* format, uint64_t batch_size,
-                               uint64_t nnz_bucket, int with_field,
+                               uint64_t nnz_bucket, uint64_t nnz_max,
+                               int with_field,
                                DmlcTpuStagedBatcherHandle* out) {
   return Guard([&] {
     auto ctx = std::make_unique<BatcherCtx>();
@@ -232,7 +233,7 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
     // column packs with a straight memcpy (see staged_batcher.h)
     auto parser = dmlctpu::Parser<uint32_t, float>::Create(uri, part, num_parts, format);
     ctx->batcher = std::make_unique<dmlctpu::data::StagedBatcher>(
-        std::move(parser), batch_size, nnz_bucket, with_field != 0);
+        std::move(parser), batch_size, nnz_bucket, with_field != 0, nnz_max);
     ctx->batch_size = batch_size;
     *out = ctx.release();
     return 0;

@@ -198,7 +198,7 @@ def _declare_batcher_sig():
         return L
     L.DmlcTpuStagedBatcherCreate.argtypes = [
         ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
-        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(ctypes.c_void_p)]
     L.DmlcTpuStagedBatcherNext.argtypes = [ctypes.c_void_p,
                                            ctypes.POINTER(_StagedBatchC)]
@@ -365,6 +365,11 @@ class DeviceStagingIter:
     uri : dataset URI (same sugar as Parser).
     batch_size : rows per emitted batch (global batch when sharded).
     nnz_bucket : pad nonzeros to a multiple of this (shape-bucketing).
+    nnz_max : if nonzero, a hard per-batch nonzero cap — rows that would
+        exceed it spill into the next batch and every batch has
+        ``nnz_pad == nnz_max`` (fully fixed shapes, required for multi-host
+        global-array staging where each process must contribute
+        identically-shaped shards).  0 = unbounded (bucketed shapes).
     sharding : optional ``jax.sharding.Sharding`` for the staged arrays
         (e.g. NamedSharding(mesh, P('data')) on the leading axis).  Scalars
         and ``num_rows`` are replicated.
@@ -374,12 +379,13 @@ class DeviceStagingIter:
     def __init__(self, uri: str, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
                  part: int = 0, num_parts: int = 1, format: str = "auto",  # noqa: A002
                  sharding=None, with_field: bool = False, prefetch: int = 2,
-                 log_every: int = 0):
+                 nnz_max: int = 0, log_every: int = 0):
         self._lib = _declare_batcher_sig()
         self._handle = ctypes.c_void_p()
         check(self._lib.DmlcTpuStagedBatcherCreate(
             uri.encode(), part, num_parts, format.encode(),
-            batch_size, nnz_bucket, int(with_field), ctypes.byref(self._handle)))
+            batch_size, nnz_bucket, nnz_max, int(with_field),
+            ctypes.byref(self._handle)))
         self._sharding = sharding
         self._prefetch = max(prefetch, 1)
         self._with_field = with_field

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Local "never ship red" gate: build + all native suites + pytest.
+# Installed as .git/hooks/pre-commit by scripts/install_hooks.sh.
+# Mirrors the reference's per-push CI contract
+# (reference scripts/test_script.sh:19-40) as a local pre-commit check,
+# since no CI runner executes .github/workflows/ci.yml in this environment.
+#
+# Fast by construction: incremental ninja rebuild (~s when clean), the five
+# native suites (~10s), pytest on the 8-device virtual CPU mesh (~25s).
+# DMLCTPU_CHECK_FAST=1 skips pytest (native-only, for tight C++ loops).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+cmake -S . -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
+ninja -C build >/dev/null
+
+for t in test_core test_runtime test_data test_input_split test_remote_fs; do
+  if ! ./build/"$t" >/tmp/dmlctpu_check_$t.log 2>&1; then
+    echo "check.sh: NATIVE SUITE FAILED: $t (log: /tmp/dmlctpu_check_$t.log)" >&2
+    exit 1
+  fi
+done
+
+if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
+  python -m pytest tests/ -x -q
+fi
+echo "check.sh: green (5 native suites$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo ", pytest skipped" || echo " + pytest"))"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Install scripts/check.sh as the git pre-commit hook so a commit can never
+# ship with a red build/test state.  Bypass (emergencies only): git commit -n.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .git/hooks
+cat > .git/hooks/pre-commit <<'EOF'
+#!/usr/bin/env bash
+exec bash "$(git rev-parse --show-toplevel)/scripts/check.sh"
+EOF
+chmod +x .git/hooks/pre-commit
+echo "pre-commit hook installed -> scripts/check.sh"
