@@ -10,6 +10,7 @@
 #include <vector>
 
 #include "../src/data/record_batcher.h"
+#include "../src/data/staged_batcher.h"
 #include "dmlctpu/data.h"
 #include "dmlctpu/row_block.h"
 #include "dmlctpu/stream.h"
@@ -430,6 +431,133 @@ TESTCASE(record_batcher_multirank_union) {
     std::multiset<std::string> want(records.begin(), records.end());
     EXPECT_TRUE(seen == want);
   }
+}
+
+namespace {
+
+// Drain a StagedBatcher, checking per-batch shape invariants, and rebuild
+// (label, [(index, value)...]) per real row for content comparison.
+struct DrainedStaged {
+  std::vector<float> labels;
+  std::vector<std::vector<std::pair<int32_t, float>>> rows;
+  std::vector<size_t> batch_nnz_pads;
+  std::vector<uint32_t> batch_rows;
+};
+
+DrainedStaged DrainStaged(data::StagedBatcher* b, size_t batch_size) {
+  DrainedStaged out;
+  data::OwnedStagedBatch ob;
+  while (b->NextOwned(&ob)) {
+    data::StagedArena* a = ob.arena.get();
+    EXPECT_EQV(a->batch_size, batch_size);
+    out.batch_nnz_pads.push_back(a->nnz_pad);
+    out.batch_rows.push_back(a->num_rows);
+    const int32_t* rp = a->row_ptr();
+    EXPECT_EQV(rp[0], 0);
+    for (size_t r = 0; r < batch_size; ++r) EXPECT_TRUE(rp[r] <= rp[r + 1]);
+    // padding rows are empty with weight 0; padded nnz slots are zero
+    for (size_t r = a->num_rows; r < batch_size; ++r) {
+      EXPECT_EQV(rp[r + 1], rp[a->num_rows]);
+      EXPECT_EQV(a->weight()[r], 0.0f);
+    }
+    for (size_t k = rp[a->num_rows]; k < a->nnz_pad; ++k) {
+      EXPECT_EQV(a->index()[k], 0);
+      EXPECT_EQV(a->value()[k], 0.0f);
+    }
+    for (size_t r = 0; r < a->num_rows; ++r) {
+      out.labels.push_back(a->label()[r]);
+      std::vector<std::pair<int32_t, float>> row;
+      for (int32_t k = rp[r]; k < rp[r + 1]; ++k)
+        row.emplace_back(a->index()[k], a->value()[k]);
+      out.rows.push_back(std::move(row));
+    }
+    ob.Reset();
+  }
+  return out;
+}
+
+std::unique_ptr<Parser<uint32_t>> MakeVariedLibsvm(const std::string& dir,
+                                                   size_t n_rows) {
+  // row i: label i%3, (i%5)+1 nonzeros with distinct indices/values
+  std::string f = dir + "/varied.libsvm";
+  std::ostringstream os;
+  for (size_t i = 0; i < n_rows; ++i) {
+    os << (i % 3);
+    size_t nnz = (i % 5) + 1;
+    for (size_t k = 0; k < nnz; ++k)
+      os << ' ' << (i * 7 + k) % 1000 << ':' << (0.5f * static_cast<float>(i + k));
+    os << '\n';
+  }
+  WriteFile(f, os.str());
+  return Parser<uint32_t>::Create(f.c_str(), 0, 1, "libsvm");
+}
+
+}  // namespace
+
+TESTCASE(staged_batcher_unbounded_buckets_and_content) {
+  TemporaryDirectory tmp;
+  const size_t kRows = 333, kBatch = 64, kBucket = 32;
+  data::StagedBatcher b(MakeVariedLibsvm(tmp.path, kRows), kBatch, kBucket,
+                        /*with_field=*/false);
+  auto got = DrainStaged(&b, kBatch);
+  EXPECT_EQV(got.labels.size(), kRows);
+  for (size_t p : got.batch_nnz_pads) EXPECT_EQV(p % kBucket, 0u);
+  // full batches except the tail
+  for (size_t i = 0; i + 1 < got.batch_rows.size(); ++i)
+    EXPECT_EQV(got.batch_rows[i], kBatch);
+  // content parity with a direct parse
+  auto ref = DrainParser(MakeVariedLibsvm(tmp.path, kRows).get());
+  for (size_t i = 0; i < kRows; ++i) {
+    EXPECT_EQV(got.labels[i], ref.label[i]);
+    size_t nnz = ref.offset[i + 1] - ref.offset[i];
+    EXPECT_EQV(got.rows[i].size(), nnz);
+    for (size_t k = 0; k < nnz; ++k) {
+      EXPECT_EQV(got.rows[i][k].first,
+                 static_cast<int32_t>(ref.index[ref.offset[i] + k]));
+      EXPECT_EQV(got.rows[i][k].second, ref.value[ref.offset[i] + k]);
+    }
+  }
+  // BeforeFirst restarts the epoch identically
+  b.BeforeFirst();
+  auto again = DrainStaged(&b, kBatch);
+  EXPECT_EQV(again.labels.size(), kRows);
+  EXPECT_TRUE(again.rows == got.rows);
+}
+
+TESTCASE(staged_batcher_nnz_max_fixed_shapes_and_spill) {
+  TemporaryDirectory tmp;
+  const size_t kRows = 200, kBatch = 32, kNnzMax = 24;
+  // rows have 1..5 nonzeros, so a 32-row batch wants ~3*32=96 > 24: packing
+  // must stop early (row spill) and every batch must emit nnz_pad == 24
+  data::StagedBatcher b(MakeVariedLibsvm(tmp.path, kRows), kBatch,
+                        /*nnz_bucket=*/8, /*with_field=*/false,
+                        /*nnz_max=*/kNnzMax);
+  auto got = DrainStaged(&b, kBatch);
+  EXPECT_EQV(got.labels.size(), kRows);               // exactly-once despite spill
+  EXPECT_TRUE(got.batch_rows.size() > (kRows + kBatch - 1) / kBatch);  // spilled
+  for (size_t p : got.batch_nnz_pads) EXPECT_EQV(p, kNnzMax);  // fixed shape
+  for (uint32_t r : got.batch_rows) EXPECT_TRUE(r > 0 && r <= kBatch);
+  // content parity across spill boundaries
+  auto ref = DrainParser(MakeVariedLibsvm(tmp.path, kRows).get());
+  for (size_t i = 0; i < kRows; ++i) {
+    EXPECT_EQV(got.labels[i], ref.label[i]);
+    EXPECT_EQV(got.rows[i].size(), ref.offset[i + 1] - ref.offset[i]);
+  }
+}
+
+TESTCASE(staged_batcher_single_row_over_cap_throws) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/wide.libsvm";
+  // a 10-nonzero row can never fit nnz_max=5: must FATAL, not loop or wedge
+  std::ostringstream os;
+  os << "1";
+  for (int k = 0; k < 10; ++k) os << ' ' << k << ":1";
+  os << "\n";
+  WriteFile(f, os.str());
+  auto parser = Parser<uint32_t>::Create(f.c_str(), 0, 1, "libsvm");
+  data::StagedBatcher b(std::move(parser), 4, 4, false, /*nnz_max=*/5);
+  data::OwnedStagedBatch ob;
+  EXPECT_THROWS(while (b.NextOwned(&ob)) ob.Reset());
 }
 
 TESTMAIN()

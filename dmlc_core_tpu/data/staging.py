@@ -124,6 +124,14 @@ class PaddedBatch:
     rows have ``weight == 0`` and empty spans; padding nonzero lanes (k >=
     row_ptr[batch_size]) have ``value == 0``.  Use :meth:`row_ids` inside a
     jitted consumer for the flattened COO view.
+
+    Multi-host batches (assembled from per-process nnz_max segments) keep
+    every invariant above except one: each segment's pad gap falls inside
+    the span of that segment's last row — a weight-0 padding row unless the
+    process batch was full, in which case a real row's span gains trailing
+    (index 0, value 0) pairs.  Value-weighted reductions are unaffected;
+    consumers counting ``row_ptr[r+1]-row_ptr[r]`` should mask by value
+    or weight in multi-host mode.
     """
 
     label: jax.Array    # f32 [batch]
@@ -386,6 +394,8 @@ class DeviceStagingIter:
             uri.encode(), part, num_parts, format.encode(),
             batch_size, nnz_bucket, nnz_max, int(with_field),
             ctypes.byref(self._handle)))
+        self._batch_size = batch_size
+        self._nnz_max = nnz_max
         self._sharding = sharding
         self._prefetch = max(prefetch, 1)
         self._with_field = with_field
@@ -437,57 +447,30 @@ class DeviceStagingIter:
             return self._stage_inner(c)
 
     def _stage_inner(self, c: _StagedBatchOwnedC) -> PaddedBatch:
-        B = int(c.batch_size)
-        nnz = int(c.nnz_pad)
-        # Zero-copy wrap of the owned arena: every array is a view into one
-        # buffer object; when the last view (or device_put alias) dies, the
-        # finalizer returns the arena to the native pool.  No per-array copy.
-        buf = (ctypes.c_uint8 * int(c.arena_bytes)).from_address(c.arena)
-        weakref.finalize(buf, self._lib.DmlcTpuStagedBatchFree,
-                         ctypes.c_void_p(c.batch))
-
-        def arr(off, count, dtype):
-            return np.frombuffer(buf, dtype=dtype, count=count, offset=int(off))
-
-        label = arr(c.label_off, B, np.float32)
-        weight = arr(c.weight_off, B, np.float32)
-        row_ptr = arr(c.row_ptr_off, B + 1, np.int32)
-        index = arr(c.index_off, nnz, np.int32)
-        value = arr(c.value_off, nnz, np.float32)
-        with_field = self._with_field and c.field_off != _NO_FIELD
-        field = arr(c.field_off, nnz, np.int32) if with_field else None
-        num_rows = np.int32(c.num_rows)
-
+        w = self._wrap_owned(c)
+        with_field = w["field"] is not None
+        num_rows = np.int32(w["num_rows"])
+        leaves = (w["label"], w["weight"], w["row_ptr"], w["index"],
+                  w["value"], num_rows) + ((w["field"],) if with_field else ())
         if self._sharding is None:
             # one batched dispatch for the whole pytree
-            leaves = (label, weight, row_ptr, index, value, num_rows) + (
-                (field,) if with_field else ())
             staged = jax.device_put(leaves)
-        elif jax.process_count() > 1:
-            # multi-host: each process contributes its local shard of the
-            # data-sharded leaves; row_ptr/num_rows are replicated
-            repl = self._replicated_sharding()
-            put_s = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
-                self._sharding, a)
-            staged = (put_s(label), put_s(weight),
-                      jax.device_put(row_ptr, repl),
-                      put_s(index), put_s(value),
-                      jax.device_put(num_rows, repl)) + (
-                          (put_s(field),) if with_field else ())
         else:
             repl = self._replicated_sharding()
             shardings = (self._sharding, self._sharding, repl,
                          self._sharding, self._sharding, repl) + (
                              (self._sharding,) if with_field else ())
-            leaves = (label, weight, row_ptr, index, value, num_rows) + (
-                (field,) if with_field else ())
             staged = jax.device_put(leaves, shardings)
 
         batch = PaddedBatch(
             label=staged[0], weight=staged[1], row_ptr=staged[2],
             index=staged[3], value=staged[4], num_rows=staged[5],
             field=staged[6] if with_field else None)
-        self._max_index = max(self._max_index, int(c.max_index))
+        self._max_index = max(self._max_index, w["max_index"])
+        self._note_staged()
+        return batch
+
+    def _note_staged(self) -> None:
         self.batches_staged += 1
         epoch_batches = self.batches_staged - self._epoch_batches0
         if self._log_every and epoch_batches % self._log_every == 0:
@@ -495,7 +478,6 @@ class DeviceStagingIter:
             epoch_mb = (self.bytes_read - self._epoch_bytes0) / (1 << 20)
             LOGGER.info("staged %d batches, %.2f MB/sec -> device",
                         epoch_batches, epoch_mb / secs)
-        return batch
 
     def _replicated_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec
@@ -503,12 +485,170 @@ class DeviceStagingIter:
             return NamedSharding(self._sharding.mesh, PartitionSpec())
         return self._sharding  # best effort for exotic sharding types
 
+    # ---- multi-host staging --------------------------------------------------
+    # Each process runs its own DeviceStagingIter over its shard of the data
+    # (part=process_index, num_parts=process_count) with the SAME batch_size
+    # and a nonzero nnz_max, and the per-process (batch_size,)/(nnz_max,)
+    # arrays assemble into one global jax.Array per leaf.  Per global batch,
+    # ONE tiny host allgather carries (status, num_rows, max_index, row_ptr)
+    # — batch_size+4 ints — from every process; that single collective
+    #   * rebuilds the exact global CSR row pointer (each process's rows are
+    #     shifted into its fixed nnz_max segment of the concatenated
+    #     index/value arrays),
+    #   * sums the true global row count, and
+    #   * keeps collective counts identical across processes even when the
+    #     input split is ragged: a process that ran out of rows keeps
+    #     contributing all-padding batches (num_rows=0, weight 0) until every
+    #     process is done, so every row is delivered exactly once and nobody
+    #     deadlocks.  (Contrast reference src/io/input_split_base.cc, whose
+    #     multi-rank contract is per-rank exactly-once but leaves cross-rank
+    #     batch-count agreement to the caller.)
+
+    def _wrap_owned(self, c: _StagedBatchOwnedC) -> dict:
+        """Zero-copy numpy views over an owned arena; the buf object owns the
+        arena (finalizer releases it back to the native pool).  Host-only —
+        safe on the producer thread (no jax dispatch, see _iter_multihost)."""
+        buf = (ctypes.c_uint8 * int(c.arena_bytes)).from_address(c.arena)
+        weakref.finalize(buf, self._lib.DmlcTpuStagedBatchFree,
+                         ctypes.c_void_p(c.batch))
+        B, nnz = self._batch_size, int(c.nnz_pad)
+
+        def arr(off, count, dtype):
+            return np.frombuffer(buf, dtype=dtype, count=count, offset=int(off))
+
+        with_field = self._with_field and c.field_off != _NO_FIELD
+        return {
+            "label": arr(c.label_off, B, np.float32),
+            "weight": arr(c.weight_off, B, np.float32),
+            "row_ptr": arr(c.row_ptr_off, B + 1, np.int32),
+            "index": arr(c.index_off, nnz, np.int32),
+            "value": arr(c.value_off, nnz, np.float32),
+            "field": arr(c.field_off, nnz, np.int32) if with_field else None,
+            "num_rows": int(c.num_rows),
+            "max_index": int(c.max_index),
+        }
+
+    def _iter_multihost(self) -> Iterator[PaddedBatch]:
+        """Multi-host epoch: the background thread runs ONLY the native
+        parse/pack (+ host-side zero-copy wrap); every jax dispatch — the
+        per-batch allgather and the global-array assembly — happens here on
+        the consumer thread.  That keeps cross-process collectives in one
+        deterministic program order per process; issuing them from the
+        prefetch thread raced the consumer's own jit collectives and
+        deadlocked the Gloo/ICI channel (collective order must match across
+        processes)."""
+        from jax.experimental import multihost_utils
+        if self._nnz_max == 0:
+            raise ValueError(
+                "multi-process staging needs fixed shapes: pass nnz_max=... "
+                "so every process contributes identically-shaped shards")
+        B, nnz, nprocs = self._batch_size, self._nnz_max, jax.process_count()
+
+        def produce(emit):
+            with self._lock:
+                check(self._lib.DmlcTpuStagedBatcherBeforeFirst(self._handle))
+                while True:
+                    c = _StagedBatchOwnedC()
+                    if check(self._lib.DmlcTpuStagedBatcherNextOwned(
+                            self._handle, ctypes.byref(c))) != 1:
+                        return
+                    if not emit(self._wrap_owned(c)):
+                        return
+
+        native = _staged_iter(produce, self._prefetch)
+        local_end = False
+        try:
+            while True:
+                local, local_err = None, None
+                if not local_end:
+                    try:
+                        local = next(native, None)
+                        local_end = local is None
+                    except Exception as e:  # parse/pack failed on this process
+                        local_err, local_end = e, True
+                # packet: [status, num_rows, max_index, row_ptr[B+1]].
+                # status -1 broadcasts a local failure so peers raise instead
+                # of wedging in the next collective waiting for us.
+                packed = np.zeros(B + 4, np.int64)
+                packed[2] = -1
+                if local_err is not None:
+                    packed[0] = -1
+                elif local is not None:
+                    packed[0] = 1
+                    packed[1] = local["num_rows"]
+                    packed[2] = local["max_index"]
+                    packed[3:] = local["row_ptr"]
+                gathered = np.asarray(multihost_utils.process_allgather(packed))
+                if local_err is not None:
+                    raise local_err
+                failed = np.nonzero(gathered[:, 0] < 0)[0]
+                if failed.size:
+                    raise RuntimeError(
+                        "multi-host staging failed on process(es) "
+                        f"{failed.tolist()}; aborting epoch on all processes")
+                if gathered[:, 0].sum() == 0:
+                    return  # every process exhausted; collective counts matched
+                # Global CSR: each process's row boundaries shift into its
+                # fixed nnz_max segment of the concatenated index/value
+                # arrays.  The pad gap [local_nnz, nnz_max) of segment p falls
+                # into the span of that segment's LAST row — a weight-0
+                # padding row whenever the process batch wasn't full; only a
+                # full local batch attaches its pad gap (value-0, index-0
+                # pairs, inert in value-weighted ops) to a real row's span.
+                shifts = np.arange(nprocs, dtype=np.int64) * nnz
+                shifted = gathered[:, 3:] + shifts[:, None]
+                global_rp = np.concatenate(
+                    [shifted[:, :-1].reshape(-1),
+                     [np.int64(nnz) * nprocs]]).astype(np.int32)
+                total_rows = np.int32(gathered[:, 1].sum())
+                # every process folds every peer's max id, so the documented
+                # "num_features-1 after a full epoch" property holds globally
+                self._max_index = max(self._max_index,
+                                      int(gathered[:, 2].max()))
+                yield self._assemble_multihost(local, global_rp, total_rows)
+        finally:
+            native.close()
+
+    def _assemble_multihost(self, local: dict | None, global_rp: np.ndarray,
+                            total_rows: np.int32) -> PaddedBatch:
+        """One global batch from this process's shard (``local``; None once
+        this process is out of rows — it contributes inert zero padding)."""
+        B, nnz = self._batch_size, self._nnz_max
+        if local is not None:
+            label, weight = local["label"], local["weight"]
+            index, value = local["index"], local["value"]
+            field = local["field"]
+            with_field = field is not None
+        else:
+            label = weight = np.zeros(B, np.float32)
+            value = np.zeros(nnz, np.float32)
+            index = np.zeros(nnz, np.int32)
+            with_field = self._with_field
+            field = np.zeros(nnz, np.int32) if with_field else None
+
+        repl = self._replicated_sharding()
+        put_s = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+            self._sharding, a)
+        put_r = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+            repl, np.asarray(a))
+        batch = PaddedBatch(
+            label=put_s(label), weight=put_s(weight), row_ptr=put_r(global_rp),
+            index=put_s(index), value=put_s(value),
+            num_rows=put_r(total_rows),
+            field=put_s(field) if with_field else None)
+        self._note_staged()
+        return batch
+
     def __iter__(self) -> Iterator[PaddedBatch]:
         """Yield device-resident batches; parse/pack (C++) and device_put
         (a background thread) run ahead of the consumer."""
         self._epoch_t0 = time.monotonic()
         self._epoch_bytes0 = self.bytes_read
         self._epoch_batches0 = self.batches_staged
+
+        if self._sharding is not None and jax.process_count() > 1:
+            yield from self._iter_multihost()
+            return
 
         def produce(emit):
             with self._lock:

@@ -1,0 +1,148 @@
+"""Real 2-process jax.distributed staging through DeviceStagingIter's
+multi-host path (make_array_from_process_local_data + the per-batch
+(has_data, num_rows, row_ptr) host allgather).
+
+Each process parses ITS OWN file with deliberately uneven row counts, so the
+local batch counts differ and the exhausted process must keep contributing
+all-padding batches — the exactly-once / no-deadlock contract this path
+exists for (the process-level lift of the reference's multi-rank
+exactly-once split, test/unittest/unittest_inputsplit.cc:116-158).
+
+CPU cross-process collectives ride jaxlib's Gloo backend; each process hosts
+4 virtual CPU devices (8 global).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, f0, f1 = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlc_core_tpu.data import DeviceStagingIter
+
+B, NNZ_MAX = 16, 32
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+
+# missing nnz_max must fail loudly, not deadlock later
+bad = DeviceStagingIter(f0, batch_size=B, nnz_bucket=8, sharding=sharding,
+                        format="libsvm")
+try:
+    next(iter(bad))
+    raise SystemExit("expected ValueError without nnz_max")
+except ValueError:
+    pass
+bad.close()
+
+it = DeviceStagingIter(f0 if pid == 0 else f1, batch_size=B, nnz_bucket=8,
+                       nnz_max=NNZ_MAX, sharding=sharding, format="libsvm")
+
+@jax.jit
+def batch_sum(label, weight):
+    return jnp.sum(label * weight)
+
+total = 0.0
+rows = None
+batches = 0
+for b in it:
+    assert b.label.shape == (2 * B,), b.label.shape
+    assert b.value.shape == (2 * NNZ_MAX,), b.value.shape
+    assert b.index.shape == (2 * NNZ_MAX,)
+    assert b.row_ptr.shape == (2 * B + 1,), b.row_ptr.shape
+    rp = np.asarray(b.row_ptr)
+    assert rp[0] == 0 and (np.diff(rp) >= 0).all(), "global CSR not monotone"
+    assert rp[-1] == 2 * NNZ_MAX
+    total += float(batch_sum(b.label, b.weight))
+    rows = int(b.num_rows)  # replicated global real-row count of this batch
+    batches += 1
+print("RESULT " + json.dumps({"pid": pid, "batches": batches,
+                              "label_sum": total}), flush=True)
+
+# failure propagation: process 0's stream FATALs mid-epoch (feature id >=
+# 2^31 trips the staged int32 check); process 1 must raise promptly via the
+# status=-1 broadcast instead of wedging in its next collective
+import pathlib
+bad = pathlib.Path(f0).parent / f"bad{pid}.libsvm"
+rows = ["1 1:1"] * 40 + (["1 3000000000:1"] if pid == 0 else ["1 2:1"] * 40)
+bad.write_text("\n".join(rows) + "\n")
+it_bad = DeviceStagingIter(str(bad), batch_size=B, nnz_bucket=8,
+                           nnz_max=NNZ_MAX, sharding=sharding, format="libsvm")
+try:
+    for b in it_bad:
+        pass
+    raise SystemExit("expected staging failure to propagate")
+except RuntimeError as e:
+    if pid == 0:  # the original native parse error
+        assert "feature id" in str(e), e
+    else:  # the status=-1 broadcast from the failing peer
+        assert "process(es) [0]" in str(e), e
+print("ERRPROP_OK", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_staging_uneven_parts(tmp_path):
+    # uneven: 60 rows vs 25 rows -> process 1 exhausts first and must pad
+    files, sums = [], []
+    for p, n_rows in ((0, 60), (1, 25)):
+        f = tmp_path / f"part{p}.libsvm"
+        lines, s = [], 0
+        for j in range(n_rows):
+            label = p * 1000 + j
+            nnz = (j % 5) + 1
+            feats = " ".join(f"{(j * 7 + k) % 97}:{k + 1}" for k in range(nnz))
+            lines.append(f"{label} {feats}")
+            s += label
+        f.write_text("\n".join(lines) + "\n")
+        files.append(str(f))
+        sums.append(s)
+
+    port = str(_free_port())
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(p), port, files[0], files[1]],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(REPO)) for p in (0, 1)]
+    results = {}
+    for p, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"process {p} hung (multi-host deadlock?)")
+        assert proc.returncode == 0, f"process {p} failed:\n{err[-2000:]}"
+        assert "ERRPROP_OK" in out, f"process {p} missed error propagation"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results[p] = json.loads(line[len("RESULT "):])
+    assert set(results) == {0, 1}
+    # both processes observe the identical global stream
+    assert results[0]["batches"] == results[1]["batches"]
+    assert results[0]["label_sum"] == results[1]["label_sum"]
+    # exactly-once: weighted label sum equals the sum over BOTH files
+    # (padding rows carry weight 0, so they are inert)
+    assert results[0]["label_sum"] == float(sums[0] + sums[1])
+    # ragged tail really happened: 60 rows cannot fit the batches 25 rows
+    # needs, so the global batch count exceeds process 1's local need
+    assert results[0]["batches"] >= 4
