@@ -8,14 +8,16 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
-import threading
 
 from ..submit import submit
+from ._threads import RankThreads
 
 LOGGER = logging.getLogger("dmlc_tpu.local")
 
 
 def run(args) -> None:
+    ranks = RankThreads()
+
     def spawn_all(num_workers: int, num_servers: int, envs: dict) -> None:
         def one(role: str, task_id: int) -> None:
             env = os.environ.copy()
@@ -34,14 +36,11 @@ def run(args) -> None:
                                proc.returncode, attempt + 1, attempts)
             raise RuntimeError(f"{role} {task_id} failed after {attempts} attempts")
 
-        threads = []
         for i in range(num_servers):
-            threads.append(threading.Thread(target=one, args=("server", i), daemon=True))
+            ranks.spawn(one, "server", i)
         for i in range(num_workers):
-            threads.append(threading.Thread(target=one, args=("worker", i), daemon=True))
-        for t in threads:
-            t.start()
+            ranks.spawn(one, "worker", i)
 
     tracker = submit(args.num_workers, args.num_servers, spawn_all,
                      host_ip="127.0.0.1", pscmd=None, extra_envs=args.extra_env)
-    tracker.join()
+    ranks.join_tracker(tracker)

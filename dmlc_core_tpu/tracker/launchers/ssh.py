@@ -8,9 +8,9 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
-import threading
 
 from ..submit import submit
+from ._threads import RankThreads
 
 LOGGER = logging.getLogger("dmlc_tpu.ssh")
 
@@ -45,6 +45,7 @@ def run(args) -> None:
     if not args.host_file:
         raise SystemExit("--cluster=ssh requires --host-file")
     hosts = parse_host_file(args.host_file)
+    ranks = RankThreads()
 
     def spawn_all(num_workers: int, num_servers: int, envs: dict) -> None:
         def one(role: str, task_id: int, host: str, port: int) -> None:
@@ -67,13 +68,13 @@ def run(args) -> None:
         idx = 0
         for i in range(num_servers):
             host, port = hosts[idx % len(hosts)]
-            threading.Thread(target=one, args=("server", i, host, port), daemon=True).start()
+            ranks.spawn(one, "server", i, host, port)
             idx += 1
         for i in range(num_workers):
             host, port = hosts[idx % len(hosts)]
-            threading.Thread(target=one, args=("worker", i, host, port), daemon=True).start()
+            ranks.spawn(one, "worker", i, host, port)
             idx += 1
 
     tracker = submit(args.num_workers, args.num_servers, spawn_all,
                      host_ip=args.host_ip, extra_envs=args.extra_env)
-    tracker.join()
+    ranks.join_tracker(tracker)

@@ -20,9 +20,9 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
-import threading
 
 from ..submit import submit
+from ._threads import RankThreads
 
 LOGGER = logging.getLogger("dmlc_tpu.tpu")
 
@@ -43,6 +43,7 @@ def run(args) -> None:
     if args.num_workers > len(hosts):
         LOGGER.info("%d workers on %d hosts: multiple ranks per host",
                     args.num_workers, len(hosts))
+    ranks = RankThreads()
 
     def spawn_all(num_workers: int, num_servers: int, envs: dict) -> None:
         assert num_servers == 0, "--cluster=tpu is rabit/collective mode only"
@@ -71,8 +72,8 @@ def run(args) -> None:
 
         for task_id in range(num_workers):
             host, port = hosts[task_id % len(hosts)]
-            threading.Thread(target=one, args=(task_id, host, port), daemon=True).start()
+            ranks.spawn(one, task_id, host, port)
 
     tracker = submit(args.num_workers, 0, spawn_all, host_ip=args.host_ip,
                      extra_envs=args.extra_env)
-    tracker.join()
+    ranks.join_tracker(tracker)

@@ -279,14 +279,26 @@ class RabitTracker:
                 LOGGER.debug("rank %d shutdown", w.rank)
                 continue
             assert w.cmd in ("start", "recover"), w.cmd
+            if w.cmd == "recover":
+                # a recovering worker identifies by prior rank or by jobid
+                # (a restarted process has lost its rank but kept its jobid).
+                # An unresolvable recover — before any cohort exists, or with
+                # no prior rank and an unknown jobid — is REJECTED: falling
+                # through to the batch-assignment pending list would strand
+                # worker and tracker forever (todo is empty after the
+                # initial cohort, and an assert here would kill the serve
+                # loop with every connected worker still blocked).
+                if tree is None or w.requested_rank(job_map) < 0:
+                    LOGGER.warning(
+                        "unresolvable recover (jobid %r, before-start=%s); "
+                        "rejected", w.jobid, tree is None)
+                    w.conn.sock.close()
+                    continue
             if tree is None:
-                assert w.cmd == "start"
                 if w.world_size > 0:
                     num_workers = w.world_size
                 tree, parent, ring = link_map(num_workers)
                 todo = list(range(num_workers))
-            if w.cmd == "recover":
-                assert w.rank >= 0
             rank = w.requested_rank(job_map)
             if rank == -1:
                 # batch assignment: wait for the full cohort, sort by host so

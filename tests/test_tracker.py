@@ -86,6 +86,99 @@ def test_full_rendezvous_eight_workers():
             assert r in links[p]
 
 
+def test_recover_reclaims_rank_and_relinks():
+    """Kill a worker mid-job; it reconnects with cmd='recover' (same jobid)
+    and must get its old rank back with a working peer link (reference
+    tracker.py:279-291 treats rank recovery as first-class protocol).  The
+    surviving worker re-brokers through the tracker too, as real rabit peers
+    do when a link breaks."""
+    world = 2
+    tracker = RabitTracker("127.0.0.1", world)
+    tracker.start()
+    clients = {}
+
+    def worker(idx):
+        c = WorkerClient(tracker_uri="127.0.0.1", tracker_port=tracker.port,
+                         jobid=f"job-{idx}")
+        c.start(world_size=world)
+        clients[idx] = c
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(clients) == 2
+    old_ranks = {i: clients[i].rank for i in clients}
+
+    # worker job-1 dies: peer sockets and listener vanish, no shutdown sent
+    dead = clients[1]
+    for s in dead.peer_socks.values():
+        s.close()
+    dead._listener.close()
+
+    recovered = {}
+
+    def recover(idx):
+        c = WorkerClient(tracker_uri="127.0.0.1", tracker_port=tracker.port,
+                         jobid=f"job-{idx}")
+        c.start(cmd="recover")
+        recovered[idx] = c
+
+    # the dead rank recovers; the survivor re-brokers its broken link
+    t1 = threading.Thread(target=recover, args=(1,))
+    t1.start()
+    t0 = threading.Thread(target=recover, args=(0,))
+    t0.start()
+    t1.join(timeout=15)
+    t0.join(timeout=15)
+    assert set(recovered) == {0, 1}, "recover rendezvous did not complete"
+    assert recovered[1].rank == old_ranks[1], "rank not reclaimed by jobid"
+    assert recovered[0].rank == old_ranks[0]
+    # the re-brokered link really carries bytes
+    a, b = recovered[0], recovered[1]
+    a.peer_socks[b.rank].sendall(b"x")
+    assert b.peer_socks[a.rank].recv(1) == b"x"
+    a.shutdown()
+    b.shutdown()
+    tracker.join(timeout=10)
+
+
+def test_recover_unknown_jobid_rejected_not_stranded():
+    """A recover the tracker cannot resolve (no prior rank, unknown jobid)
+    must be rejected with a closed connection — falling into the pending
+    list would strand worker and tracker forever."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start()
+    c = WorkerClient(tracker_uri="127.0.0.1", tracker_port=tracker.port,
+                     jobid="never-registered")
+    with pytest.raises(Exception):  # EOF on the closed tracker conn
+        c.start(cmd="recover")
+    tracker.stop()
+
+
+def test_launcher_failure_fails_job_fast():
+    """A rank that dies pre-rendezvous must fail the launcher instead of
+    leaving tracker.join() hanging forever (r3 weak #6: the daemon-thread
+    raise died silently)."""
+    from dmlc_core_tpu.tracker.opts import parse
+    from dmlc_core_tpu.tracker.launchers import tpu as tpu_launcher
+
+    args = parse(["--cluster=tpu", "-n", "1", "--host-ip", "127.0.0.1",
+                  "--", "false"])
+    with pytest.raises(RuntimeError, match="worker rank failed"):
+        tpu_launcher.run(args)
+
+
+def test_local_launcher_failure_fails_job_fast():
+    from dmlc_core_tpu.tracker.opts import parse
+    from dmlc_core_tpu.tracker.launchers import local as local_launcher
+
+    args = parse(["--cluster=local", "-n", "1", "--", "false"])
+    with pytest.raises(RuntimeError, match="worker rank failed"):
+        local_launcher.run(args)
+
+
 def test_tracker_envs():
     tracker = RabitTracker("127.0.0.1", 2, extra_envs={"FOO": "bar"})
     envs = tracker.worker_envs()
