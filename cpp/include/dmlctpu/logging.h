@@ -61,6 +61,13 @@ inline const char* SeverityName(LogSeverity s) {
 
 void Emit(LogSeverity severity, const char* file, int line, const std::string& msg);
 
+/*! \brief demangled stack trace of the calling thread, one frame per line
+ *  (reference include/dmlc/logging.h:76-96 capability).  Controlled by env:
+ *  DMLCTPU_LOG_STACK_TRACE=0 disables (default on),
+ *  DMLCTPU_LOG_STACK_TRACE_DEPTH caps frames (default 10).
+ *  Returns "" when disabled. */
+std::string StackTrace(int skip = 1);
+
 /*! \brief stream-building message; emits on destruction. */
 class Message {
  public:
@@ -72,6 +79,8 @@ class Message {
       Emit(severity_, file_, line_, m);
       std::ostringstream full;
       full << "[" << file_ << ":" << line_ << "] " << m;
+      std::string trace = StackTrace(/*skip=*/2);  // skip StackTrace + dtor
+      if (!trace.empty()) full << "\nStack trace:\n" << trace;
       throw Error(full.str());
     }
     if (static_cast<int>(severity_) >= MinLevel()) {

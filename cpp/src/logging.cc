@@ -3,11 +3,57 @@
 // into the `logging` module).
 #include "dmlctpu/logging.h"
 
+#if __has_include(<execinfo.h>)
+#include <cxxabi.h>
+#include <execinfo.h>
+#define DMLCTPU_HAS_BACKTRACE 1
+#endif
+
+#include <algorithm>
 #include <cstdio>
 #include <ctime>
+#include <memory>
 
 namespace dmlctpu {
 namespace log {
+
+#ifndef DMLCTPU_HAS_BACKTRACE
+std::string StackTrace(int) { return ""; }  // musl/non-glibc: no backtrace()
+#else
+std::string StackTrace(int skip) {
+  const char* toggle = std::getenv("DMLCTPU_LOG_STACK_TRACE");
+  if (toggle != nullptr && std::strcmp(toggle, "0") == 0) return "";
+  int depth = 10;
+  if (const char* d = std::getenv("DMLCTPU_LOG_STACK_TRACE_DEPTH")) {
+    // clamp BEFORE adding skip: depth + skip must not overflow int
+    depth = std::clamp(std::atoi(d), 1, 62);
+  }
+  void* frames[64];
+  int total = ::backtrace(frames, std::min(depth + skip, 64));
+  std::unique_ptr<char*, void (*)(void*)> symbols(
+      ::backtrace_symbols(frames, total), std::free);
+  if (symbols == nullptr) return "";
+  std::ostringstream os;
+  for (int i = skip; i < total; ++i) {
+    std::string sym = symbols.get()[i];
+    // glibc format: module(mangled+0xoff) [addr] — demangle the middle
+    size_t lp = sym.find('(');
+    size_t plus = sym.find('+', lp == std::string::npos ? 0 : lp);
+    if (lp != std::string::npos && plus != std::string::npos && plus > lp + 1) {
+      std::string mangled = sym.substr(lp + 1, plus - lp - 1);
+      int status = 0;
+      std::unique_ptr<char, void (*)(void*)> demangled(
+          abi::__cxa_demangle(mangled.c_str(), nullptr, nullptr, &status),
+          std::free);
+      if (status == 0 && demangled != nullptr) {
+        sym = sym.substr(0, lp + 1) + demangled.get() + sym.substr(plus);
+      }
+    }
+    os << "  [" << (i - skip) << "] " << sym << "\n";
+  }
+  return os.str();
+}
+#endif  // DMLCTPU_HAS_BACKTRACE
 
 void Emit(LogSeverity severity, const char* file, int line, const std::string& msg) {
   Sink& sink = CustomSink();

@@ -556,4 +556,38 @@ TESTCASE(logging_env_level_control) {
   ::unsetenv("DMLCTPU_LOG_LEVEL");
 }
 
+// deliberately named + noinline so the demangled frame is recognizable in
+// the FATAL stack trace (the test binary links -rdynamic to export it)
+__attribute__((noinline)) void StackTraceCanaryFunction() {
+  TLOG(Fatal) << "trace me";
+}
+
+TESTCASE(fatal_error_carries_demangled_stack_trace) {
+  ::unsetenv("DMLCTPU_LOG_STACK_TRACE");
+  std::string what;
+  try {
+    StackTraceCanaryFunction();
+  } catch (const Error& e) {
+    what = e.what();
+  }
+  EXPECT_TRUE(what.find("trace me") != std::string::npos);
+  EXPECT_TRUE(what.find("Stack trace:") != std::string::npos);
+  // the canary frame is demangled by name (ref include/dmlc/logging.h:76-96).
+  // Assert the demangled-only form "Name()" — the mangled symbol
+  // _Z23StackTraceCanaryFunctionv would also contain the bare name.
+  EXPECT_TRUE(what.find("StackTraceCanaryFunction()") != std::string::npos);
+
+  // and the env kill-switch suppresses the trace entirely
+  ::setenv("DMLCTPU_LOG_STACK_TRACE", "0", 1);
+  std::string quiet;
+  try {
+    StackTraceCanaryFunction();
+  } catch (const Error& e) {
+    quiet = e.what();
+  }
+  ::unsetenv("DMLCTPU_LOG_STACK_TRACE");
+  EXPECT_TRUE(quiet.find("trace me") != std::string::npos);
+  EXPECT_TRUE(quiet.find("Stack trace:") == std::string::npos);
+}
+
 TESTMAIN()
