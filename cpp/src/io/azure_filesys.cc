@@ -136,13 +136,16 @@ AzureFileSystem::Endpoint AzureFileSystem::ResolveEndpoint() const {
   Endpoint ep;
   std::string raw = endpoint_env_;
   if (raw.empty()) {
-    TLOG(Fatal) << "azure: this build speaks plain http only (no TLS library "
-                   "in the image); set DMLCTPU_AZURE_ENDPOINT=http://host[:port] "
-                   "(Azurite or a TLS-terminating proxy)";
+    // no explicit endpoint: the real Azure blob https endpoint
+    raw = "https://" + signer_.account + ".blob.core.windows.net";
   }
-  TCHECK(raw.rfind("https://", 0) != 0)
-      << "azure: https endpoints unsupported; use http:// (see header docs)";
-  if (raw.rfind("http://", 0) == 0) raw = raw.substr(7);
+  if (raw.rfind("https://", 0) == 0) {
+    raw = raw.substr(8);
+    ep.tls = true;
+    ep.port = 443;
+  } else if (raw.rfind("http://", 0) == 0) {
+    raw = raw.substr(7);
+  }
   size_t colon = raw.find(':');
   if (colon == std::string::npos) {
     ep.host = raw;
@@ -150,7 +153,14 @@ AzureFileSystem::Endpoint AzureFileSystem::ResolveEndpoint() const {
     ep.host = raw.substr(0, colon);
     ep.port = std::atoi(raw.c_str() + colon + 1);
   }
-  ep.path_prefix = "/" + signer_.account;  // emulator path-style
+  // real service (virtual-hosted, account in the hostname) vs emulator /
+  // proxy (path-style, account as the first path segment) — keyed on the
+  // HOST SHAPE, so explicitly pinning the real URL in the env behaves
+  // identically to leaving it unset
+  bool real_service = ep.host.size() > sizeof(".blob.core.windows.net") &&
+                      ep.host.rfind(".blob.core.windows.net") ==
+                          ep.host.size() - (sizeof(".blob.core.windows.net") - 1);
+  ep.path_prefix = real_service ? "" : "/" + signer_.account;
   return ep;
 }
 
@@ -198,7 +208,7 @@ void AzureFileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out)
         signer_.Sign("GET", ep.path_prefix + resource, query, {}, 0, NowRfc1123());
     http::Response resp = http::Request(ep.host, ep.port, "GET",
                                         WirePath(ep, resource) + BuildQuery(query),
-                                        signed_req.headers);
+                                        signed_req.headers, "", ep.tls);
     TCHECK_EQ(resp.status, 200) << "azure List Blobs failed (" << resp.status
                                 << "): " << resp.body.substr(0, 256);
     std::vector<std::string> prefixes;
@@ -221,7 +231,8 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
   auto signed_req =
       signer_.Sign("HEAD", ep.path_prefix + resource, {}, {}, 0, NowRfc1123());
   http::Response resp = http::Request(ep.host, ep.port, "HEAD",
-                                      WirePath(ep, resource), signed_req.headers);
+                                      WirePath(ep, resource), signed_req.headers,
+                                      "", ep.tls);
   FileInfo info;
   info.path = path;
   if (resp.status == 404) {
@@ -237,7 +248,7 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
                                  {}, 0, NowRfc1123());
     http::Response list = http::Request(ep.host, ep.port, "GET",
                                         WirePath(ep, container_res) + BuildQuery(query),
-                                        list_req.headers);
+                                        list_req.headers, "", ep.tls);
     XMLScan scan(list.body);
     std::string any;
     TCHECK(list.status == 200 && scan.Next("Name", &any))
@@ -298,7 +309,7 @@ class AzureReadStream : public SeekStream {
     auto signed_req = signer_->Sign("GET", ep_.path_prefix + resource_, {},
                                     headers, 0, NowRfc1123());
     body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
-                                signed_req.headers);
+                                signed_req.headers, "", ep_.tls);
     // a server that ignores Range and replies 200 with the full body would
     // silently serve bytes from 0 — only 206 proves the offset was honored
     int want_partial = offset > 0 ? 206 : 0;
@@ -363,7 +374,7 @@ class AzureWriteStream : public Stream {
                                     {}, buffer_.size(), NowRfc1123());
     http::Response resp = http::Request(ep_.host, ep_.port, "PUT",
                                         req_path_ + BuildQuery(query),
-                                        signed_req.headers, buffer_);
+                                        signed_req.headers, buffer_, ep_.tls);
     TCHECK(resp.status == 201 || resp.status == 200)
         << "azure Put Block failed (" << resp.status << "): "
         << resp.body.substr(0, 256);
@@ -379,7 +390,7 @@ class AzureWriteStream : public Stream {
       auto signed_req = signer_->Sign("PUT", ep_.path_prefix + resource_, {},
                                       headers, buffer_.size(), NowRfc1123());
       http::Response resp = http::Request(ep_.host, ep_.port, "PUT", req_path_,
-                                          signed_req.headers, buffer_);
+                                          signed_req.headers, buffer_, ep_.tls);
       TCHECK(resp.status == 201 || resp.status == 200)
           << "azure Put Blob failed (" << resp.status << "): "
           << resp.body.substr(0, 256);
@@ -394,7 +405,7 @@ class AzureWriteStream : public Stream {
                                     {}, body.size(), NowRfc1123());
     http::Response resp = http::Request(ep_.host, ep_.port, "PUT",
                                         req_path_ + BuildQuery(query),
-                                        signed_req.headers, body);
+                                        signed_req.headers, body, ep_.tls);
     TCHECK(resp.status == 201 || resp.status == 200)
         << "azure Put Block List failed (" << resp.status << "): "
         << resp.body.substr(0, 256);

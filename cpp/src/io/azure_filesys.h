@@ -72,6 +72,7 @@ class AzureFileSystem : public FileSystem {
     std::string host;
     int port = 80;
     std::string path_prefix;  // "/{account}" for path-style emulator endpoints
+    bool tls = false;         // https:// endpoint
   };
 
  private:

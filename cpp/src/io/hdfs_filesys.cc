@@ -115,15 +115,19 @@ std::string ParseLocation(const std::string& body) {
 struct ParsedUrl {
   std::string host;
   int port = 80;
+  bool tls = false;
   std::string path_and_query;  // begins with '/'
 };
 ParsedUrl ParseUrl(const std::string& url) {
   ParsedUrl out;
   std::string rest = url;
-  if (rest.rfind("http://", 0) == 0) rest = rest.substr(7);
-  TCHECK(rest.rfind("https://", 0) != 0)
-      << "WebHDFS: https datanode URLs unsupported in this build (no TLS); "
-         "configure dfs.http.policy=HTTP_ONLY or front with a proxy";
+  if (rest.rfind("http://", 0) == 0) {
+    rest = rest.substr(7);
+  } else if (rest.rfind("https://", 0) == 0) {
+    rest = rest.substr(8);
+    out.tls = true;
+    out.port = 443;
+  }
   size_t slash = rest.find('/');
   std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
   out.path_and_query = slash == std::string::npos ? "/" : rest.substr(slash);
@@ -150,7 +154,7 @@ std::string OpPath(const HdfsFileSystem::Endpoint& ep, const std::string& path,
 /*! \brief namenode request; follows one noredirect/307 hop when asked */
 http::Response NamenodeRequest(const HdfsFileSystem::Endpoint& ep,
                                const std::string& method, const std::string& path) {
-  return http::Request(ep.host, ep.port, method, path, {});
+  return http::Request(ep.host, ep.port, method, path, {}, "", ep.tls);
 }
 
 /*! \brief ranged-OPEN seekable read stream (reopens on seek / drop) */
@@ -199,7 +203,8 @@ class WebHdfsReadStream : public SeekStream {
     TCHECK(!location.empty()) << "WebHDFS OPEN " << path_ << " failed ("
                               << hop.status << "): " << hop.body.substr(0, 200);
     ParsedUrl dn = ParseUrl(location);
-    body_ = http::RequestStream(dn.host, dn.port, "GET", dn.path_and_query, {});
+    body_ = http::RequestStream(dn.host, dn.port, "GET", dn.path_and_query,
+                                {}, "", dn.tls);
     TCHECK(body_->status() == 200 || body_->status() == 206)
         << "WebHDFS datanode GET failed (" << body_->status() << ")";
   }
@@ -263,7 +268,7 @@ class WebHdfsWriteStream : public Stream {
     ParsedUrl dn = ParseUrl(location);
     http::Response resp = http::Request(
         dn.host, dn.port, method, dn.path_and_query,
-        {{"Content-Type", "application/octet-stream"}}, buffer_);
+        {{"Content-Type", "application/octet-stream"}}, buffer_, dn.tls);
     TCHECK(resp.status == 200 || resp.status == 201)
         << "WebHDFS datanode write failed (" << resp.status << ")";
     created_ = true;
@@ -288,6 +293,13 @@ HdfsFileSystem::Endpoint HdfsFileSystem::ResolveEndpoint(const URI& uri) {
   Endpoint ep;
   std::string addr = GetEnv("DMLCTPU_WEBHDFS_ADDR", std::string());
   if (addr.empty()) addr = uri.host;
+  if (addr.rfind("https://", 0) == 0) {
+    addr = addr.substr(8);
+    ep.tls = true;
+    ep.port = 9871;  // Hadoop 3 dfs.namenode.https-address default
+  } else if (addr.rfind("http://", 0) == 0) {
+    addr = addr.substr(7);
+  }
   size_t colon = addr.find(':');
   if (colon == std::string::npos) {
     ep.host = addr;

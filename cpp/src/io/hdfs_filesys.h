@@ -35,7 +35,8 @@ class HdfsFileSystem : public FileSystem {
 
   struct Endpoint {
     std::string host;
-    int port = 9870;  // Hadoop 3 WebHDFS default
+    int port = 9870;   // Hadoop 3 WebHDFS default
+    bool tls = false;  // https namenode (DMLCTPU_WEBHDFS_ADDR=https://...)
     std::string user;  // empty → no user.name param
   };
   /*! \brief resolve the WebHDFS address for a URI (exposed for tests) */

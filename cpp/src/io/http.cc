@@ -9,15 +9,18 @@
 #include <cstring>
 #include <sstream>
 
+#include "./tls.h"
 #include "dmlctpu/logging.h"
 
 namespace dmlctpu {
 namespace http {
 namespace {
 
+/*! \brief connected TCP socket; optionally upgraded to TLS (https).  The
+ *  request/response machinery above is transport-agnostic. */
 class Socket {
  public:
-  Socket(const std::string& host, int port) {
+  Socket(const std::string& host, int port, bool use_tls) {
     addrinfo hints{};
     hints.ai_family = AF_UNSPEC;
     hints.ai_socktype = SOCK_STREAM;
@@ -33,11 +36,27 @@ class Socket {
     }
     ::freeaddrinfo(res);
     TCHECK_GE(fd_, 0) << "http: cannot connect to " << host << ":" << port;
+    if (use_tls) {
+      try {
+        tls_ = std::make_unique<tls::Connection>(fd_, host);
+      } catch (...) {
+        // constructor failure skips ~Socket: close here or leak the fd on
+        // every rejected handshake (retry loops would hit EMFILE)
+        ::close(fd_);
+        fd_ = -1;
+        throw;
+      }
+    }
   }
   ~Socket() {
+    tls_.reset();  // close_notify before the fd goes away
     if (fd_ >= 0) ::close(fd_);
   }
   void SendAll(const char* data, size_t len) {
+    if (tls_ != nullptr) {
+      tls_->WriteAll(data, len);
+      return;
+    }
     while (len != 0) {
       ssize_t n = ::send(fd_, data, len, MSG_NOSIGNAL);
       TCHECK_GT(n, 0) << "http: send failed";
@@ -46,6 +65,7 @@ class Socket {
     }
   }
   size_t Recv(void* buf, size_t len) {
+    if (tls_ != nullptr) return tls_->Read(buf, len);
     ssize_t n = ::recv(fd_, buf, len, 0);
     TCHECK_GE(n, 0) << "http: recv failed";
     return static_cast<size_t>(n);
@@ -53,6 +73,7 @@ class Socket {
 
  private:
   int fd_ = -1;
+  std::unique_ptr<tls::Connection> tls_;
 };
 
 std::string BuildRequest(const std::string& host, const std::string& method,
@@ -76,8 +97,8 @@ class BodyStreamImpl : public BodyStream {
   BodyStreamImpl(const std::string& host, int port, const std::string& method,
                  const std::string& path,
                  const std::map<std::string, std::string>& headers,
-                 const std::string& body)
-      : sock_(host, port) {
+                 const std::string& body, bool use_tls)
+      : sock_(host, port, use_tls) {
     std::string req = BuildRequest(host, method, path, headers, body);
     sock_.SendAll(req.data(), req.size());
     ParseHead();
@@ -190,15 +211,16 @@ class BodyStreamImpl : public BodyStream {
 std::unique_ptr<BodyStream> RequestStream(
     const std::string& host, int port, const std::string& method,
     const std::string& path, const std::map<std::string, std::string>& headers,
-    const std::string& body) {
-  return std::make_unique<BodyStreamImpl>(host, port, method, path, headers, body);
+    const std::string& body, bool use_tls) {
+  return std::make_unique<BodyStreamImpl>(host, port, method, path, headers,
+                                          body, use_tls);
 }
 
 Response Request(const std::string& host, int port, const std::string& method,
                  const std::string& path,
                  const std::map<std::string, std::string>& headers,
-                 const std::string& body) {
-  auto stream = RequestStream(host, port, method, path, headers, body);
+                 const std::string& body, bool use_tls) {
+  auto stream = RequestStream(host, port, method, path, headers, body, use_tls);
   Response resp;
   resp.status = stream->status();
   resp.headers = stream->headers();

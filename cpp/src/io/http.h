@@ -1,7 +1,8 @@
 // http.h — minimal HTTP/1.1 client over POSIX sockets (no libcurl headers in
 // this image).  One request per connection (Connection: close), streaming
-// body reads; plain TCP only — TLS endpoints need an https-terminating proxy
-// (S3_ENDPOINT), which is also how zero-egress test rigs stub S3.
+// body reads.  https:// rides the same client over a TLS transport (tls.h:
+// dlopen'd system OpenSSL 3, SNI + hostname verification; DMLCTPU_TLS_VERIFY
+// / DMLCTPU_TLS_CA_FILE control trust).
 #ifndef DMLCTPU_SRC_IO_HTTP_H_
 #define DMLCTPU_SRC_IO_HTTP_H_
 
@@ -28,17 +29,19 @@ class BodyStream {
   virtual size_t Read(void* buf, size_t size) = 0;
 };
 
-/*! \brief blocking request; throws dmlctpu::Error on transport failure */
+/*! \brief blocking request; throws dmlctpu::Error on transport failure.
+ *  use_tls wraps the connection in TLS (https). */
 Response Request(const std::string& host, int port, const std::string& method,
                  const std::string& path_and_query,
                  const std::map<std::string, std::string>& headers,
-                 const std::string& body = "");
+                 const std::string& body = "", bool use_tls = false);
 
 /*! \brief as Request but hands back a stream over the response body */
 std::unique_ptr<BodyStream> RequestStream(
     const std::string& host, int port, const std::string& method,
     const std::string& path_and_query,
-    const std::map<std::string, std::string>& headers, const std::string& body = "");
+    const std::map<std::string, std::string>& headers,
+    const std::string& body = "", bool use_tls = false);
 
 /*! \brief percent-encode a URL path, keeping '/' separators */
 std::string PercentEncodePath(const std::string& path);

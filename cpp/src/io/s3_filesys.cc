@@ -110,19 +110,20 @@ S3FileSystem* S3FileSystem::GetInstance() {
   return &inst;
 }
 
-S3FileSystem::Endpoint S3FileSystem::ResolveEndpoint(const std::string& bucket) const {
+S3FileSystem::Endpoint S3FileSystem::ResolveEndpoint(const std::string& /*bucket*/) const {
   Endpoint ep;
   std::string raw = endpoint_env_;
   if (raw.empty()) {
-    TLOG(Fatal) << "S3: this build speaks plain http only (no TLS library in the "
-                   "image); set S3_ENDPOINT=http://host[:port] (minio/localstack/"
-                   "TLS-terminating proxy) — bucket " << bucket;
+    // no explicit endpoint: the real AWS virtual-hosted https endpoint
+    raw = "https://s3." + signer_.region + ".amazonaws.com";
   }
   if (raw.rfind("https://", 0) == 0) {
-    TLOG(Fatal) << "S3: https endpoints are not supported in this build; "
-                   "use an http:// S3_ENDPOINT or a TLS-terminating proxy";
+    raw = raw.substr(8);
+    ep.tls = true;
+    ep.port = 443;
+  } else if (raw.rfind("http://", 0) == 0) {
+    raw = raw.substr(7);
   }
-  if (raw.rfind("http://", 0) == 0) raw = raw.substr(7);
   size_t colon = raw.find(':');
   if (colon == std::string::npos) {
     ep.host = raw;
@@ -174,7 +175,8 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
   auto signed_req = signer_.Sign("GET", ep.host, req_path, query, {},
                                  kUnsignedPayload, NowAmzDate());
   std::string full = req_path + "?" + SigV4::CanonicalQuery(query);
-  http::Response resp = http::Request(ep.host, ep.port, "GET", full, signed_req.headers);
+  http::Response resp = http::Request(ep.host, ep.port, "GET", full,
+                                      signed_req.headers, "", ep.tls);
   TCHECK_EQ(resp.status, 200) << "S3 ListObjects failed (" << resp.status << "): "
                               << resp.body.substr(0, 256);
   std::vector<std::string> prefixes;
@@ -197,7 +199,8 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
   auto signed_req = signer_.Sign("GET", ep.host, req_path, query, {},
                                  kUnsignedPayload, NowAmzDate());
   std::string full = req_path + "?" + SigV4::CanonicalQuery(query);
-  http::Response resp = http::Request(ep.host, ep.port, "GET", full, signed_req.headers);
+  http::Response resp = http::Request(ep.host, ep.port, "GET", full,
+                                      signed_req.headers, "", ep.tls);
   TCHECK_EQ(resp.status, 200) << "S3 list failed (" << resp.status << ")";
   std::vector<FileInfo> files;
   std::vector<std::string> prefixes;
@@ -256,7 +259,7 @@ class S3ReadStream : public SeekStream {
     auto signed_req = signer_->Sign("GET", ep_.host, req_path_, {}, headers,
                                     kUnsignedPayload, NowAmzDate());
     body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
-                                signed_req.headers);
+                                signed_req.headers, "", ep_.tls);
     // only 206 proves a nonzero offset was honored (a 200 would silently
     // serve the object from byte 0)
     TCHECK(body_->status() == 206 || (offset == 0 && body_->status() == 200))
@@ -311,7 +314,8 @@ class S3WriteStream : public Stream {
                                     payload_hash, NowAmzDate());
     std::string full = req_path_ + "?" + SigV4::CanonicalQuery(query);
     http::Response resp =
-        http::Request(ep_.host, ep_.port, "PUT", full, signed_req.headers, buffer_);
+        http::Request(ep_.host, ep_.port, "PUT", full, signed_req.headers,
+                      buffer_, ep_.tls);
     TCHECK_EQ(resp.status, 200) << "S3 UploadPart failed (" << resp.status << ")";
     auto it = resp.headers.find("etag");
     etags_.push_back(it == resp.headers.end() ? "" : it->second);
@@ -322,7 +326,8 @@ class S3WriteStream : public Stream {
     auto signed_req = signer_->Sign("POST", ep_.host, req_path_, query, {},
                                     kUnsignedPayload, NowAmzDate());
     http::Response resp = http::Request(ep_.host, ep_.port, "POST",
-                                        req_path_ + "?uploads=", signed_req.headers);
+                                        req_path_ + "?uploads=",
+                                        signed_req.headers, "", ep_.tls);
     TCHECK_EQ(resp.status, 200) << "S3 InitiateMultipartUpload failed ("
                                 << resp.status << ")";
     XMLScan scan(resp.body);
@@ -337,7 +342,7 @@ class S3WriteStream : public Stream {
       auto signed_req = signer_->Sign("PUT", ep_.host, req_path_, {}, {},
                                       payload_hash, NowAmzDate());
       http::Response resp = http::Request(ep_.host, ep_.port, "PUT", req_path_,
-                                          signed_req.headers, buffer_);
+                                          signed_req.headers, buffer_, ep_.tls);
       TCHECK(resp.status == 200) << "S3 PUT failed (" << resp.status << ")";
       return;
     }
@@ -355,7 +360,8 @@ class S3WriteStream : public Stream {
                                     crypto::Hex(crypto::SHA256(body)), NowAmzDate());
     std::string full = req_path_ + "?" + SigV4::CanonicalQuery(query);
     http::Response resp =
-        http::Request(ep_.host, ep_.port, "POST", full, signed_req.headers, body);
+        http::Request(ep_.host, ep_.port, "POST", full, signed_req.headers,
+                      body, ep_.tls);
     TCHECK_EQ(resp.status, 200) << "S3 CompleteMultipartUpload failed ("
                                 << resp.status << ")";
   }
@@ -401,8 +407,27 @@ HttpFileSystem* HttpFileSystem::GetInstance() {
   return &inst;
 }
 
+namespace {
+/*! \brief http(s) URI -> (host, port, tls); the URI host may carry ":port" */
+S3FileSystem::Endpoint HttpEndpoint(const URI& path) {
+  S3FileSystem::Endpoint ep;
+  ep.tls = path.protocol == "https://";
+  ep.port = ep.tls ? 443 : 80;
+  size_t colon = path.host.find(':');
+  if (colon == std::string::npos) {
+    ep.host = path.host;
+  } else {
+    ep.host = path.host.substr(0, colon);
+    ep.port = std::atoi(path.host.c_str() + colon + 1);
+  }
+  return ep;
+}
+}  // namespace
+
 FileInfo HttpFileSystem::GetPathInfo(const URI& path) {
-  http::Response resp = http::Request(path.host, 80, "HEAD", path.name, {});
+  S3FileSystem::Endpoint ep = HttpEndpoint(path);
+  http::Response resp = http::Request(ep.host, ep.port, "HEAD", path.name, {},
+                                      "", ep.tls);
   TCHECK_LT(resp.status, 400) << "HTTP HEAD " << path.str() << " -> " << resp.status;
   FileInfo info;
   info.path = path;
@@ -420,9 +445,7 @@ std::unique_ptr<SeekStream> HttpFileSystem::OpenForRead(const URI& path, bool al
     FileInfo info = GetPathInfo(path);
     // reuse the S3 read stream machinery without signing via a null signer
     static SigV4 anonymous;  // empty credentials → unsigned headers still fine for GET
-    S3FileSystem::Endpoint ep;
-    ep.host = path.host;
-    ep.port = 80;
+    S3FileSystem::Endpoint ep = HttpEndpoint(path);
     return std::make_unique<S3ReadStream>(ep, &anonymous, path.name, info.size);
   } catch (const Error&) {
     if (allow_null) return nullptr;
@@ -445,6 +468,9 @@ struct RegisterRemoteBackends {
       return static_cast<FileSystem*>(S3FileSystem::GetInstance());
     });
     FileSystem::RegisterBackend("http://", [] {
+      return static_cast<FileSystem*>(HttpFileSystem::GetInstance());
+    });
+    FileSystem::RegisterBackend("https://", [] {
       return static_cast<FileSystem*>(HttpFileSystem::GetInstance());
     });
   }

@@ -68,6 +68,7 @@ class S3FileSystem : public FileSystem {
     std::string host;
     int port = 80;
     bool path_style = true;
+    bool tls = false;  // https:// endpoint (tls.h transport)
   };
 
  private:

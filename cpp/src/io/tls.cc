@@ -1,0 +1,188 @@
+#include "./tls.h"
+
+#include <dlfcn.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+
+#include "dmlctpu/logging.h"
+
+namespace dmlctpu {
+namespace tls {
+namespace {
+
+// OpenSSL 3 C ABI, self-declared (no headers in the image).  Opaque types
+// stay void*.  Constants from the stable public API:
+constexpr int kSslVerifyNone = 0x00;           // SSL_VERIFY_NONE
+constexpr int kSslVerifyPeer = 0x01;           // SSL_VERIFY_PEER
+constexpr int kCtrlSetTlsextHostname = 55;     // SSL_CTRL_SET_TLSEXT_HOSTNAME
+constexpr long kTlsextNametypeHostName = 0;    // TLSEXT_NAMETYPE_host_name  NOLINT
+constexpr int kSslErrorZeroReturn = 6;         // SSL_ERROR_ZERO_RETURN
+
+struct Api {
+  bool ok = false;
+  // libssl
+  const void* (*TLS_client_method)() = nullptr;
+  void* (*SSL_CTX_new)(const void*) = nullptr;
+  void (*SSL_CTX_free)(void*) = nullptr;
+  int (*SSL_CTX_set_default_verify_paths)(void*) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*) = nullptr;
+  void (*SSL_CTX_set_verify)(void*, int, void*) = nullptr;
+  void* (*SSL_new)(void*) = nullptr;
+  void (*SSL_free)(void*) = nullptr;
+  int (*SSL_set_fd)(void*, int) = nullptr;
+  long (*SSL_ctrl)(void*, int, long, void*) = nullptr;  // NOLINT
+  int (*SSL_set1_host)(void*, const char*) = nullptr;
+  int (*SSL_connect)(void*) = nullptr;
+  int (*SSL_read)(void*, void*, int) = nullptr;
+  int (*SSL_write)(void*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(void*) = nullptr;
+  int (*SSL_get_error)(const void*, int) = nullptr;
+  // libcrypto
+  unsigned long (*ERR_get_error)() = nullptr;  // NOLINT
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;  // NOLINT
+};
+
+template <typename F>
+bool Load(void* lib, const char* name, F* out) {
+  *out = reinterpret_cast<F>(::dlsym(lib, name));
+  return *out != nullptr;
+}
+
+const Api& GetApi() {
+  static Api api = [] {
+    Api a;
+    // RTLD_LOCAL: all access goes through dlsym on these handles; promoting
+    // OpenSSL symbols to global scope could cross-bind against another
+    // OpenSSL copy in the host process (CPython's _ssl, other extensions)
+    void* ssl = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (ssl == nullptr) ssl = ::dlopen("libssl.so", RTLD_NOW | RTLD_LOCAL);
+    void* crypto = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (crypto == nullptr) crypto = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+    if (ssl == nullptr || crypto == nullptr) return a;
+    a.ok = Load(ssl, "TLS_client_method", &a.TLS_client_method) &&
+           Load(ssl, "SSL_CTX_new", &a.SSL_CTX_new) &&
+           Load(ssl, "SSL_CTX_free", &a.SSL_CTX_free) &&
+           Load(ssl, "SSL_CTX_set_default_verify_paths",
+                &a.SSL_CTX_set_default_verify_paths) &&
+           Load(ssl, "SSL_CTX_load_verify_locations",
+                &a.SSL_CTX_load_verify_locations) &&
+           Load(ssl, "SSL_CTX_set_verify", &a.SSL_CTX_set_verify) &&
+           Load(ssl, "SSL_new", &a.SSL_new) &&
+           Load(ssl, "SSL_free", &a.SSL_free) &&
+           Load(ssl, "SSL_set_fd", &a.SSL_set_fd) &&
+           Load(ssl, "SSL_ctrl", &a.SSL_ctrl) &&
+           Load(ssl, "SSL_set1_host", &a.SSL_set1_host) &&
+           Load(ssl, "SSL_connect", &a.SSL_connect) &&
+           Load(ssl, "SSL_read", &a.SSL_read) &&
+           Load(ssl, "SSL_write", &a.SSL_write) &&
+           Load(ssl, "SSL_shutdown", &a.SSL_shutdown) &&
+           Load(ssl, "SSL_get_error", &a.SSL_get_error) &&
+           Load(crypto, "ERR_get_error", &a.ERR_get_error) &&
+           Load(crypto, "ERR_error_string_n", &a.ERR_error_string_n);
+    return a;
+  }();
+  return api;
+}
+
+std::string LastError() {
+  const Api& a = GetApi();
+  char buf[256] = "unknown";
+  if (a.ERR_get_error != nullptr) {
+    unsigned long code = a.ERR_get_error();  // NOLINT
+    if (code != 0) a.ERR_error_string_n(code, buf, sizeof(buf));
+  }
+  return buf;
+}
+
+/*! \brief one process-wide client context; verification settings from env
+ *  are resolved once at first TLS use */
+void* ClientCtx() {
+  static void* ctx = [] {
+    const Api& a = GetApi();
+    TCHECK(a.ok) << "TLS: libssl.so.3/libcrypto.so.3 not loadable in this "
+                 << "environment";
+    void* c = a.SSL_CTX_new(a.TLS_client_method());
+    TCHECK(c != nullptr) << "TLS: SSL_CTX_new failed: " << LastError();
+    const char* verify = std::getenv("DMLCTPU_TLS_VERIFY");
+    if (verify != nullptr && std::strcmp(verify, "0") == 0) {
+      a.SSL_CTX_set_verify(c, kSslVerifyNone, nullptr);
+    } else {
+      a.SSL_CTX_set_verify(c, kSslVerifyPeer, nullptr);
+      const char* ca = std::getenv("DMLCTPU_TLS_CA_FILE");
+      if (ca != nullptr && *ca != '\0') {
+        TCHECK_EQ(a.SSL_CTX_load_verify_locations(c, ca, nullptr), 1)
+            << "TLS: cannot load CA file " << ca << ": " << LastError();
+      } else {
+        a.SSL_CTX_set_default_verify_paths(c);
+      }
+    }
+    return c;
+  }();
+  return ctx;
+}
+
+}  // namespace
+
+bool Available() { return GetApi().ok; }
+
+Connection::Connection(int fd, const std::string& host) {
+  const Api& a = GetApi();
+  void* ctx = ClientCtx();
+  ssl_ = a.SSL_new(ctx);
+  TCHECK(ssl_ != nullptr) << "TLS: SSL_new failed: " << LastError();
+  // from here on a failure must free the SSL object (the destructor will
+  // not run if the constructor throws)
+  try {
+    TCHECK_EQ(a.SSL_set_fd(ssl_, fd), 1) << "TLS: SSL_set_fd failed";
+    // SNI (SSL_set_tlsext_host_name is a macro over SSL_ctrl) + hostname
+    // verification binding
+    a.SSL_ctrl(ssl_, kCtrlSetTlsextHostname, kTlsextNametypeHostName,
+               const_cast<char*>(host.c_str()));
+    a.SSL_set1_host(ssl_, host.c_str());
+    int rc = a.SSL_connect(ssl_);
+    TCHECK_EQ(rc, 1) << "TLS: handshake with " << host
+                     << " failed: " << LastError();
+  } catch (...) {
+    a.SSL_free(ssl_);
+    ssl_ = nullptr;
+    throw;
+  }
+}
+
+Connection::~Connection() {
+  if (ssl_ != nullptr) {
+    const Api& a = GetApi();
+    a.SSL_shutdown(ssl_);  // best-effort close_notify
+    a.SSL_free(ssl_);
+  }
+}
+
+size_t Connection::Read(void* buf, size_t len) {
+  const Api& a = GetApi();
+  int n = a.SSL_read(ssl_, buf, static_cast<int>(std::min(
+      len, static_cast<size_t>(1) << 30)));
+  if (n > 0) return static_cast<size_t>(n);
+  int err = a.SSL_get_error(ssl_, n);
+  if (err == kSslErrorZeroReturn) return 0;  // clean TLS EOF
+  // servers that close TCP without close_notify (common) read as EOF too;
+  // report anything else
+  if (n == 0) return 0;
+  TLOG(Fatal) << "TLS: read failed (ssl error " << err << "): " << LastError();
+  return 0;  // unreachable
+}
+
+void Connection::WriteAll(const char* data, size_t len) {
+  const Api& a = GetApi();
+  while (len != 0) {
+    int n = a.SSL_write(ssl_, data, static_cast<int>(std::min(
+        len, static_cast<size_t>(1) << 30)));
+    TCHECK_GT(n, 0) << "TLS: write failed: " << LastError();
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+}
+
+}  // namespace tls
+}  // namespace dmlctpu

@@ -1,0 +1,44 @@
+// tls.h — TLS client transport over an established socket fd.
+//
+// The image ships OpenSSL 3 RUNTIME libraries (libssl.so.3/libcrypto.so.3)
+// but no development headers, so the binding is dlopen + self-declared
+// prototypes for the handful of stable C-ABI entry points a client needs —
+// the same "own transport, system crypto" split as the SigV4 signer in
+// crypto.cc.  Capability parity target: the reference's libcurl+OpenSSL
+// https path (reference src/io/s3_filesys.cc:422-740).
+//
+// Env contract:
+//   DMLCTPU_TLS_VERIFY=0     disable certificate verification (test rigs)
+//   DMLCTPU_TLS_CA_FILE=...  trust this CA bundle instead of system paths
+#ifndef DMLCTPU_SRC_IO_TLS_H_
+#define DMLCTPU_SRC_IO_TLS_H_
+
+#include <cstddef>
+#include <memory>
+#include <string>
+
+namespace dmlctpu {
+namespace tls {
+
+/*! \brief true when libssl.so.3/libcrypto.so.3 loaded successfully */
+bool Available();
+
+/*! \brief a TLS session over a connected fd; handshakes in the constructor
+ *  (SNI + hostname verification against `host`), FATALs on failure */
+class Connection {
+ public:
+  Connection(int fd, const std::string& host);
+  ~Connection();
+  Connection(const Connection&) = delete;
+  Connection& operator=(const Connection&) = delete;
+
+  size_t Read(void* buf, size_t len);           // 0 at close_notify/EOF
+  void WriteAll(const char* data, size_t len);  // FATALs on failure
+
+ private:
+  void* ssl_ = nullptr;  // SSL*
+};
+
+}  // namespace tls
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_TLS_H_

@@ -1,0 +1,110 @@
+"""https:// reads through the native TLS transport (VERDICT r3 missing #1).
+
+A local TLS server (python ssl over http.server, self-signed cert with
+SAN=IP:127.0.0.1) serves a file; the C++ client (tls.cc: dlopen'd system
+OpenSSL 3 behind http.cc's socket layer) must
+  * FAIL closed against the untrusted self-signed cert by default,
+  * succeed with DMLCTPU_TLS_VERIFY=0,
+  * succeed with verification ON when DMLCTPU_TLS_CA_FILE trusts the cert.
+
+Each scenario runs in a subprocess because the TLS trust settings latch at
+first use per process (one SSL_CTX).  The https:// read path reuses the S3
+read-stream machinery, so this also exercises the transport the s3:// /
+azure:// / hdfs:// https endpoints ride.
+"""
+import os
+import socket
+import ssl
+import subprocess
+import sys
+import threading
+from functools import partial
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+_CHILD = r"""
+import sys
+from dmlc_core_tpu.io import InputSplit
+uri = sys.argv[1]
+try:
+    lines = list(InputSplit(uri, split_type="text"))
+except Exception as e:  # noqa: BLE001
+    print("CHILD_ERROR " + type(e).__name__ + ": " + str(e)[:200])
+    raise SystemExit(3)
+print("CHILD_OK " + repr([l.decode() for l in lines]))
+"""
+
+
+@pytest.fixture(scope="module")
+def tls_server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tls_root")
+    (root / "data.txt").write_text("alpha\nbeta\ngamma\n")
+    cert = root / "cert.pem"
+    key = root / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "2",
+         "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True)
+    handler = partial(SimpleHTTPRequestHandler, directory=str(root))
+    httpd = HTTPServer(("127.0.0.1", 0), handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(cert), str(key))
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield {"port": port, "cert": str(cert)}
+    httpd.shutdown()
+
+
+def _read(uri: str, extra_env: dict) -> subprocess.CompletedProcess:
+    env = {**os.environ, **extra_env}
+    env.pop("DMLCTPU_TLS_VERIFY", None)
+    env.pop("DMLCTPU_TLS_CA_FILE", None)
+    env.update(extra_env)
+    return subprocess.run([sys.executable, "-c", _CHILD, uri],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd=str(REPO))
+
+
+def test_https_untrusted_cert_fails_closed(tls_server):
+    proc = _read(f"https://127.0.0.1:{tls_server['port']}/data.txt", {})
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "CHILD_ERROR" in proc.stdout
+    assert "TLS" in proc.stdout or "handshake" in proc.stdout.lower()
+
+
+def test_https_read_with_verify_disabled(tls_server):
+    proc = _read(f"https://127.0.0.1:{tls_server['port']}/data.txt",
+                 {"DMLCTPU_TLS_VERIFY": "0"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CHILD_OK ['alpha', 'beta', 'gamma']" in proc.stdout
+
+
+def test_https_read_with_trusted_ca_and_verification_on(tls_server):
+    proc = _read(f"https://127.0.0.1:{tls_server['port']}/data.txt",
+                 {"DMLCTPU_TLS_CA_FILE": tls_server["cert"]})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CHILD_OK ['alpha', 'beta', 'gamma']" in proc.stdout
+
+
+def test_https_wrong_hostname_fails_with_trusted_ca(tls_server):
+    """The cert's SAN covers 127.0.0.1/localhost but not this alias: the
+    hostname binding (SSL_set1_host) must reject it even though the CA is
+    trusted."""
+    # an extra loopback name that resolves but is absent from the SAN
+    alias = socket.gethostname()
+    try:
+        if socket.gethostbyname(alias) != "127.0.0.1":
+            pytest.skip(f"hostname {alias} does not resolve to loopback")
+    except OSError:
+        pytest.skip("hostname does not resolve")
+    proc = _read(f"https://{alias}:{tls_server['port']}/data.txt",
+                 {"DMLCTPU_TLS_CA_FILE": tls_server["cert"]})
+    assert proc.returncode == 3, proc.stdout + proc.stderr
