@@ -1,0 +1,39 @@
+# Convenience Make front-end (capability parity with the reference's Make
+# build path; the canonical build system is CMake + Ninja — these targets
+# delegate so `make`, `make test`, `make lint`, `make docs` all work).
+BUILD_DIR ?= build
+BUILD_TYPE ?= Release
+SANITIZER ?=
+
+CMAKE_FLAGS := -G Ninja -DCMAKE_BUILD_TYPE=$(BUILD_TYPE)
+ifneq ($(SANITIZER),)
+CMAKE_FLAGS += -DDMLCTPU_ENABLE_SANITIZER=ON -DDMLCTPU_SANITIZER=$(SANITIZER)
+endif
+
+.PHONY: all configure lib test test-native test-python lint docs clean
+
+all: lib
+
+configure:
+	cmake -S . -B $(BUILD_DIR) $(CMAKE_FLAGS)
+
+lib: configure
+	ninja -C $(BUILD_DIR)
+
+test: lib
+	bash scripts/check.sh
+
+test-native: lib
+	DMLCTPU_CHECK_FAST=1 bash scripts/check.sh
+
+test-python: lib
+	python -m pytest tests/ -x -q
+
+lint:
+	python scripts/lint.py
+
+docs:
+	python scripts/gen_api_docs.py
+
+clean:
+	rm -rf $(BUILD_DIR)

@@ -1,0 +1,18 @@
+/* Declaration-only stub (see lua.h in this directory). */
+#ifndef DMLCTPU_TEST_LAUXLIB_STUB_H_
+#define DMLCTPU_TEST_LAUXLIB_STUB_H_
+#include "lua.h"
+
+extern "C" {
+lua_Integer luaL_len(lua_State* L, int idx);
+int luaL_loadstring(lua_State* L, const char* s);
+lua_State* luaL_newstate(void);
+void luaL_openlibs(lua_State* L);
+int luaL_ref(lua_State* L, int t);
+const char* luaL_tolstring(lua_State* L, int idx, size_t* len);
+void luaL_unref(lua_State* L, int t, int ref);
+}
+
+#define luaL_typename(L, i) lua_typename(L, lua_type(L, (i)))
+
+#endif  /* DMLCTPU_TEST_LAUXLIB_STUB_H_ */

@@ -1,0 +1,48 @@
+/* Declaration-only stub of the public Lua 5.3 C API, used EXCLUSIVELY to
+ * syntax/type-check the optional dmlctpu/lua.h bridge in an image that
+ * ships no liblua (see cpp/tests/lua_syntax_check.cc).  Prototypes follow
+ * the documented stable API (lua.org/manual/5.3); nothing here is
+ * implemented and nothing links against it. */
+#ifndef DMLCTPU_TEST_LUA_STUB_H_
+#define DMLCTPU_TEST_LUA_STUB_H_
+
+#include <stddef.h>
+
+#define LUA_OK 0
+#define LUA_REGISTRYINDEX (-1001000)
+#define LUA_NOREF (-2)
+#define LUA_MULTRET (-1)
+
+typedef struct lua_State lua_State;
+typedef long long lua_Integer;
+typedef double lua_Number;
+
+extern "C" {
+void lua_close(lua_State* L);
+void lua_createtable(lua_State* L, int narr, int nrec);
+int lua_getfield(lua_State* L, int idx, const char* k);
+int lua_getglobal(lua_State* L, const char* name);
+int lua_isnil(lua_State* L, int idx);
+int lua_istable(lua_State* L, int idx);
+int lua_pcall(lua_State* L, int nargs, int nresults, int errfunc);
+void lua_pushboolean(lua_State* L, int b);
+void lua_pushinteger(lua_State* L, lua_Integer n);
+const char* lua_pushlstring(lua_State* L, const char* s, size_t len);
+void lua_pushnumber(lua_State* L, lua_Number n);
+const char* lua_pushstring(lua_State* L, const char* s);
+int lua_rawgeti(lua_State* L, int idx, lua_Integer n);
+void lua_rawseti(lua_State* L, int idx, lua_Integer n);
+void lua_setglobal(lua_State* L, const char* name);
+void lua_settop(lua_State* L, int idx);
+int lua_gettop(lua_State* L);
+int lua_toboolean(lua_State* L, int idx);
+lua_Integer lua_tointegerx(lua_State* L, int idx, int* isnum);
+const char* lua_tolstring(lua_State* L, int idx, size_t* len);
+lua_Number lua_tonumberx(lua_State* L, int idx, int* isnum);
+int lua_type(lua_State* L, int idx);
+const char* lua_typename(lua_State* L, int tp);
+}
+
+#define lua_pop(L, n) lua_settop(L, -(n) - 1)
+
+#endif  /* DMLCTPU_TEST_LUA_STUB_H_ */

@@ -1,0 +1,5 @@
+/* Declaration-only stub (see lua.h in this directory). */
+#ifndef DMLCTPU_TEST_LUALIB_STUB_H_
+#define DMLCTPU_TEST_LUALIB_STUB_H_
+#include "lua.h"
+#endif
