@@ -115,6 +115,62 @@ def _staged_iter(produce, prefetch: int):
                            error[0])
 
 
+def _replicated_sharding(sharding):
+    """Fully-replicated sharding on the same mesh (best effort for exotic
+    non-Named sharding types: passes the data sharding through)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if isinstance(sharding, NamedSharding):
+        return NamedSharding(sharding.mesh, PartitionSpec())
+    return sharding
+
+
+def _multihost_rounds(native, payload_len: int, pack):
+    """Coordinate one epoch of multi-host staging: yield (local, gathered)
+    per GLOBAL batch, where ``local`` is this process's item (None once
+    exhausted) and ``gathered`` is the (process_count, payload_len+1) int64
+    allgather of every process's ``[status, payload...]``.
+
+    Status 1 = has data (payload valid, filled by ``pack(local, out)``),
+    0 = exhausted (keeps participating so collective counts stay matched),
+    -1 = local producer failure (peers raise instead of wedging in their
+    next collective; the failing process re-raises its original error).
+    Ends when every process reports exhausted.  Must run on the consumer
+    thread: collectives issued from a prefetch thread race the consumer's
+    own jit collectives and deadlock (cross-process collective order must
+    match program order on every process).
+    """
+    from jax.experimental import multihost_utils
+    local_end = False
+    try:
+        while True:
+            local, local_err = None, None
+            if not local_end:
+                try:
+                    local = next(native, None)
+                    local_end = local is None
+                except Exception as e:  # parse/pack failed on this process
+                    local_err, local_end = e, True
+            packed = np.zeros(payload_len + 1, np.int64)
+            if local_err is not None:
+                packed[0] = -1
+            elif local is not None:
+                packed[0] = 1
+                pack(local, packed[1:])
+            gathered = np.asarray(multihost_utils.process_allgather(packed))
+            if local_err is not None:
+                raise local_err
+            failed = np.nonzero(gathered[:, 0] < 0)[0]
+            if failed.size:
+                raise RuntimeError(
+                    "multi-host staging failed on process(es) "
+                    f"{failed.tolist()}; aborting epoch on all processes")
+            if gathered[:, 0].sum() == 0:
+                return  # every process exhausted; collective counts matched
+            yield local, gathered
+    finally:
+        native.close()
+
+
 @dataclass
 class PaddedBatch:
     """Static-shape CSR batch (a pytree; arrays live on device after staging).
@@ -226,23 +282,46 @@ def _declare_batcher_sig():
 class RecordBatch:
     """Static-shape packed RecordIO batch (device-resident after staging).
 
-    ``bytes`` is the concatenated payloads zero-padded to ``bytes_cap``;
-    record k spans ``bytes[offsets[k]:offsets[k+1]]``.  Padding offsets
-    repeat the end offset, so vectorized per-record compute over
-    ``records_cap`` lanes is numerically inert on padding lanes.
+    ``bytes`` is the concatenated payloads zero-padded to ``bytes_cap`` per
+    block.  A single-host batch has one block; a multi-host batch has one
+    block per process (``blocks == jax.process_count()``), each occupying a
+    fixed ``bytes_cap``-sized segment of ``bytes`` and a ``records_cap+1``
+    run of ``offsets``.  Use :meth:`spans` for the uniform per-record view:
+    record k (k = block*records_cap + j) spans
+    ``bytes[starts[k]:ends[k]]``.  Unlike the COO PaddedBatch, byte padding
+    must never leak INTO a record's span (appended zeros would corrupt the
+    payload), which is why every block boundary is kept exactly.
+
+    Padding records have empty spans; ``record_mask()`` distinguishes real
+    records per lane.
     """
 
-    bytes: jax.Array     # u8 [bytes_cap]
-    offsets: jax.Array   # i32 [records_cap + 1]
-    num_records: jax.Array  # i32 [] true record count
+    bytes: jax.Array     # u8 [blocks * bytes_cap]
+    offsets: jax.Array   # i32 [blocks * (records_cap + 1)]
+    num_records: jax.Array  # i32 [] true record count (global total)
+    block_num_records: jax.Array  # i32 [blocks] true records per block
+    blocks: int = 1      # static: process blocks in this batch
 
     @property
     def records_cap(self) -> int:
-        return self.offsets.shape[0] - 1
+        return self.offsets.shape[0] // self.blocks - 1
+
+    def spans(self):
+        """(starts, ends) i32 arrays of shape [blocks * records_cap]; record
+        k spans bytes[starts[k]:ends[k]].  Fuses under jit."""
+        per = self.offsets.reshape(self.blocks, self.records_cap + 1)
+        return per[:, :-1].reshape(-1), per[:, 1:].reshape(-1)
+
+    def record_mask(self) -> jax.Array:
+        """bool [blocks * records_cap]: True on real (non-padding) lanes."""
+        lane = jnp.arange(self.records_cap, dtype=jnp.int32)
+        return (lane[None, :] < self.block_num_records[:, None]).reshape(-1)
 
 
 jax.tree_util.register_dataclass(
-    RecordBatch, data_fields=["bytes", "offsets", "num_records"], meta_fields=[])
+    RecordBatch,
+    data_fields=["bytes", "offsets", "num_records", "block_num_records"],
+    meta_fields=["blocks"])
 
 
 class _RecordBatchC(ctypes.Structure):
@@ -299,6 +378,8 @@ class RecordStagingIter:
             ctypes.byref(self._handle)))
         self._sharding = sharding
         self._prefetch = max(prefetch, 1)
+        self._records_cap = records_cap
+        self._bytes_cap = bytes_cap
         self._lock = threading.Lock()
         self.batches_staged = 0
 
@@ -331,28 +412,88 @@ class RecordStagingIter:
         except Exception:
             pass
 
+    def _wrap_host(self, c: _RecordBatchC) -> dict:
+        """Host copies of one packed batch (the native buffers are borrowed
+        only until the next Next() call)."""
+        return {
+            "bytes": np.frombuffer(
+                ctypes.string_at(c.bytes, int(c.bytes_cap)), dtype=np.uint8),
+            "offsets": np.ctypeslib.as_array(
+                c.offsets, shape=(int(c.records_cap) + 1,)).copy(),
+            "num_records": int(c.num_records),
+        }
+
     def _stage(self, c: _RecordBatchC) -> RecordBatch:
         with jax.profiler.TraceAnnotation("dmlctpu.stage_records"):
             def put(arr):
                 if self._sharding is not None:
-                    if jax.process_count() > 1:
-                        return jax.make_array_from_process_local_data(
-                            self._sharding, arr)
                     return jax.device_put(arr, self._sharding)
                 return jax.device_put(arr)
 
-            raw = np.frombuffer(
-                ctypes.string_at(c.bytes, int(c.bytes_cap)), dtype=np.uint8)
-            offs = np.ctypeslib.as_array(
-                c.offsets, shape=(int(c.records_cap) + 1,)).copy()
+            w = self._wrap_host(c)
             batch = RecordBatch(
-                bytes=put(raw),
-                offsets=put(offs),
-                num_records=jnp.asarray(np.int32(c.num_records)))
+                bytes=put(w["bytes"]),
+                offsets=put(w["offsets"]),
+                num_records=jnp.asarray(np.int32(w["num_records"])),
+                block_num_records=jnp.asarray(
+                    np.array([w["num_records"]], np.int32)),
+                blocks=1)
             self.batches_staged += 1
             return batch
 
+    def _iter_multihost(self) -> Iterator[RecordBatch]:
+        """Multi-host epoch: every process contributes one fixed
+        (bytes_cap,) block per global batch; exact per-block offsets ride
+        the coordination allgather (see _multihost_rounds), so no byte of
+        padding ever falls inside a record span."""
+        nprocs = jax.process_count()
+        cap_r, cap_b = self._records_cap, self._bytes_cap
+        if nprocs * cap_b > np.iinfo(np.int32).max:
+            raise ValueError(
+                f"global byte offsets overflow int32: {nprocs} processes x "
+                f"bytes_cap={cap_b}; lower bytes_cap below "
+                f"{np.iinfo(np.int32).max // nprocs}")
+
+        def produce(emit):
+            with self._lock:
+                check(self._lib.DmlcTpuRecordBatcherBeforeFirst(self._handle))
+                c = _RecordBatchC()
+                while check(self._lib.DmlcTpuRecordBatcherNext(
+                        self._handle, ctypes.byref(c))) == 1:
+                    if not emit(self._wrap_host(c)):
+                        return
+
+        native = _staged_iter(produce, self._prefetch)
+
+        def pack(local, out):
+            out[0] = local["num_records"]
+            out[1:] = local["offsets"]
+
+        repl = _replicated_sharding(self._sharding)
+        for local, gathered in _multihost_rounds(native, 1 + cap_r + 1, pack):
+            shifts = np.arange(nprocs, dtype=np.int64) * cap_b
+            global_offs = (gathered[:, 2:] + shifts[:, None]).reshape(-1)
+            block_counts = gathered[:, 1].astype(np.int32)
+            raw = (local["bytes"] if local is not None
+                   else np.zeros(cap_b, np.uint8))
+            put_s = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+                self._sharding, a)
+            put_r = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+                repl, np.asarray(a))
+            batch = RecordBatch(
+                bytes=put_s(raw),
+                offsets=put_r(global_offs.astype(np.int32)),
+                num_records=put_r(np.int32(block_counts.sum())),
+                block_num_records=put_r(block_counts),
+                blocks=nprocs)
+            self.batches_staged += 1
+            yield batch
+
     def __iter__(self) -> Iterator[RecordBatch]:
+        if self._sharding is not None and jax.process_count() > 1:
+            yield from self._iter_multihost()
+            return
+
         def produce(emit):
             with self._lock:
                 check(self._lib.DmlcTpuRecordBatcherBeforeFirst(self._handle))
@@ -480,10 +621,7 @@ class DeviceStagingIter:
                         epoch_batches, epoch_mb / secs)
 
     def _replicated_sharding(self):
-        from jax.sharding import NamedSharding, PartitionSpec
-        if isinstance(self._sharding, NamedSharding):
-            return NamedSharding(self._sharding.mesh, PartitionSpec())
-        return self._sharding  # best effort for exotic sharding types
+        return _replicated_sharding(self._sharding)
 
     # ---- multi-host staging --------------------------------------------------
     # Each process runs its own DeviceStagingIter over its shard of the data
@@ -531,13 +669,8 @@ class DeviceStagingIter:
     def _iter_multihost(self) -> Iterator[PaddedBatch]:
         """Multi-host epoch: the background thread runs ONLY the native
         parse/pack (+ host-side zero-copy wrap); every jax dispatch — the
-        per-batch allgather and the global-array assembly — happens here on
-        the consumer thread.  That keeps cross-process collectives in one
-        deterministic program order per process; issuing them from the
-        prefetch thread raced the consumer's own jit collectives and
-        deadlocked the Gloo/ICI channel (collective order must match across
-        processes)."""
-        from jax.experimental import multihost_utils
+        per-batch allgather (_multihost_rounds) and the global-array
+        assembly — happens here on the consumer thread."""
         if self._nnz_max == 0:
             raise ValueError(
                 "multi-process staging needs fixed shapes: pass nnz_max=... "
@@ -556,58 +689,34 @@ class DeviceStagingIter:
                         return
 
         native = _staged_iter(produce, self._prefetch)
-        local_end = False
-        try:
-            while True:
-                local, local_err = None, None
-                if not local_end:
-                    try:
-                        local = next(native, None)
-                        local_end = local is None
-                    except Exception as e:  # parse/pack failed on this process
-                        local_err, local_end = e, True
-                # packet: [status, num_rows, max_index, row_ptr[B+1]].
-                # status -1 broadcasts a local failure so peers raise instead
-                # of wedging in the next collective waiting for us.
-                packed = np.zeros(B + 4, np.int64)
-                packed[2] = -1
-                if local_err is not None:
-                    packed[0] = -1
-                elif local is not None:
-                    packed[0] = 1
-                    packed[1] = local["num_rows"]
-                    packed[2] = local["max_index"]
-                    packed[3:] = local["row_ptr"]
-                gathered = np.asarray(multihost_utils.process_allgather(packed))
-                if local_err is not None:
-                    raise local_err
-                failed = np.nonzero(gathered[:, 0] < 0)[0]
-                if failed.size:
-                    raise RuntimeError(
-                        "multi-host staging failed on process(es) "
-                        f"{failed.tolist()}; aborting epoch on all processes")
-                if gathered[:, 0].sum() == 0:
-                    return  # every process exhausted; collective counts matched
-                # Global CSR: each process's row boundaries shift into its
-                # fixed nnz_max segment of the concatenated index/value
-                # arrays.  The pad gap [local_nnz, nnz_max) of segment p falls
-                # into the span of that segment's LAST row — a weight-0
-                # padding row whenever the process batch wasn't full; only a
-                # full local batch attaches its pad gap (value-0, index-0
-                # pairs, inert in value-weighted ops) to a real row's span.
-                shifts = np.arange(nprocs, dtype=np.int64) * nnz
-                shifted = gathered[:, 3:] + shifts[:, None]
-                global_rp = np.concatenate(
-                    [shifted[:, :-1].reshape(-1),
-                     [np.int64(nnz) * nprocs]]).astype(np.int32)
-                total_rows = np.int32(gathered[:, 1].sum())
-                # every process folds every peer's max id, so the documented
-                # "num_features-1 after a full epoch" property holds globally
-                self._max_index = max(self._max_index,
-                                      int(gathered[:, 2].max()))
-                yield self._assemble_multihost(local, global_rp, total_rows)
-        finally:
-            native.close()
+
+        # payload: [num_rows, max_index, row_ptr[B+1]]
+        def pack(local, out):
+            out[0] = local["num_rows"]
+            out[1] = local["max_index"]
+            out[2:] = local["row_ptr"]
+
+        for local, gathered in _multihost_rounds(native, B + 3, pack):
+            # Global CSR: each process's row boundaries shift into its
+            # fixed nnz_max segment of the concatenated index/value
+            # arrays.  The pad gap [local_nnz, nnz_max) of segment p falls
+            # into the span of that segment's LAST row — a weight-0
+            # padding row whenever the process batch wasn't full; only a
+            # full local batch attaches its pad gap (value-0, index-0
+            # pairs, inert in value-weighted ops) to a real row's span.
+            shifts = np.arange(nprocs, dtype=np.int64) * nnz
+            shifted = gathered[:, 3:] + shifts[:, None]
+            global_rp = np.concatenate(
+                [shifted[:, :-1].reshape(-1),
+                 [np.int64(nnz) * nprocs]]).astype(np.int32)
+            total_rows = np.int32(gathered[:, 1].sum())
+            # every process folds every peer's max id, so the documented
+            # "num_features-1 after a full epoch" property holds globally
+            # (only status==1 rows carry a valid payload)
+            has_data = gathered[:, 0] == 1
+            self._max_index = max(
+                self._max_index, int(gathered[has_data, 2].max(initial=-1)))
+            yield self._assemble_multihost(local, global_rp, total_rows)
 
     def _assemble_multihost(self, local: dict | None, global_rp: np.ndarray,
                             total_rows: np.int32) -> PaddedBatch:

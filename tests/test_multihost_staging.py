@@ -92,6 +92,93 @@ print("ERRPROP_OK", flush=True)
 """
 
 
+_RECORD_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, f0, f1 = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlc_core_tpu.data import RecordStagingIter
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+it = RecordStagingIter(f0 if pid == 0 else f1, records_cap=8,
+                       bytes_cap=1024, sharding=sharding)
+
+@jax.jit
+def chk(b):
+    starts, ends = b.spans()
+    mask = b.record_mask()
+    first = b.bytes[jnp.clip(starts, 0, b.bytes.shape[0] - 1)].astype(jnp.int32)
+    return (jnp.sum(jnp.where(mask, first, 0)),
+            jnp.sum(jnp.where(mask, ends - starts, 0)))
+
+first_sum = size_sum = records = batches = 0
+for b in it:
+    assert b.blocks == 2 and b.bytes.shape == (2 * 1024,), (b.blocks, b.bytes.shape)
+    assert b.offsets.shape == (2 * 9,)
+    f, s = chk(b)
+    first_sum += int(f); size_sum += int(s)
+    records += int(b.num_records)
+    batches += 1
+print("RESULT " + json.dumps({"pid": pid, "batches": batches,
+                              "first_sum": first_sum, "size_sum": size_sum,
+                              "records": records}), flush=True)
+"""
+
+
+def test_two_process_record_staging(tmp_path):
+    """RecordStagingIter multi-host path: byte-exact record spans across
+    per-process blocks (padding must never leak into a record's payload),
+    uneven files exercising the padding-block tail."""
+    import sys as _sys
+    _sys.path.insert(0, str(REPO))
+    from dmlc_core_tpu.io import RecordIOWriter
+
+    files, first_sums, size_sums, counts = [], 0, 0, 0
+    for p, n_rec in ((0, 37), (1, 11)):
+        f = tmp_path / f"rec{p}.rec"
+        with RecordIOWriter(str(f)) as w:
+            for j in range(n_rec):
+                body = bytes([(p * 100 + j) % 251]) + b"x" * (j % 17)
+                w.write(body)
+                first_sums += body[0]
+                size_sums += len(body)
+                counts += 1
+        files.append(str(f))
+
+    port = str(_free_port())
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _RECORD_CHILD, str(p), port, files[0], files[1]],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(REPO)) for p in (0, 1)]
+    results = {}
+    for p, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"record process {p} hung")
+        assert proc.returncode == 0, f"process {p} failed:\n{err[-2000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results[p] = json.loads(line[len("RESULT "):])
+    # identical global stream on both processes (modulo the pid tag)
+    assert ({k: v for k, v in results[0].items() if k != "pid"}
+            == {k: v for k, v in results[1].items() if k != "pid"})
+    assert results[0]["records"] == counts
+    assert results[0]["first_sum"] == first_sums
+    assert results[0]["size_sum"] == size_sums
+    assert results[0]["batches"] >= 5  # 37 records / 8-cap blocks
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
