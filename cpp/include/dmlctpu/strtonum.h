@@ -13,6 +13,7 @@
 #include <limits>
 #include <type_traits>
 
+#include "./base.h"
 #include "./logging.h"
 
 namespace dmlctpu {
@@ -41,7 +42,7 @@ namespace detail {
  *         documented [p, end) contract stays safe for external callers
  *         (e.g. an mmap ending exactly at a digit on a page boundary). */
 template <bool Bounded>
-inline void ParseDigitRun(const char** s, const char* end, uint64_t* mantissa,
+DMLCTPU_ALWAYS_INLINE void ParseDigitRun(const char** s, const char* end, uint64_t* mantissa,
                           int* digits) {
   const char* q = *s;
   if constexpr (Bounded) {
@@ -69,7 +70,7 @@ inline void ParseDigitRun(const char** s, const char* end, uint64_t* mantissa,
  *        correctly-rounded std::from_chars.
  */
 template <typename T, bool Bounded = true>
-inline bool FastParseFloat(const char** p, const char* end, T* out) {
+DMLCTPU_ALWAYS_INLINE bool FastParseFloat(const char** p, const char* end, T* out) {
   const char* s = *p;
   bool neg = false;
   if (s != end && (*s == '-' || *s == '+')) {
@@ -123,7 +124,7 @@ namespace detail {
 /*! \brief shared implementation of TryParseNumToken[Unsafe]; see the public
  *         wrappers below for the contract of each. */
 template <typename T, bool Bounded>
-inline bool TryParseNumTokenImpl(const char** p, const char* end, T* out) {
+DMLCTPU_ALWAYS_INLINE bool TryParseNumTokenImpl(const char** p, const char* end, T* out) {
   const char* s = *p;
   if (s == end) return false;
   std::from_chars_result r;
@@ -221,7 +222,7 @@ inline bool TryParseNumToken(const char** p, const char* end, T* out) {
  *        use TryParseNumToken there.
  */
 template <typename T>
-inline bool TryParseNumTokenUnsafe(const char** p, const char* end, T* out) {
+DMLCTPU_ALWAYS_INLINE bool TryParseNumTokenUnsafe(const char** p, const char* end, T* out) {
   return detail::TryParseNumTokenImpl<T, /*Bounded=*/false>(p, end, out);
 }
 

@@ -54,6 +54,8 @@ class CSVParser : public TextParserBase<IndexType, DType> {
   void ParseBlock(const char* begin, const char* end,
                   RowBlockContainer<IndexType, DType>* out) override {
     out->Clear();
+    // register accumulator (see libsvm_parser.h ParseBlock)
+    IndexType max_index = 0;
     const char* p = begin;
     while (p != end) {
       while (p != end && (*p == '\n' || *p == '\r' || *p == '\0')) ++p;
@@ -82,7 +84,7 @@ class CSVParser : public TextParserBase<IndexType, DType> {
           if (has_value) {
             out->value.push_back(v);
             out->index.push_back(feat);
-            out->max_index = std::max(out->max_index, feat);
+            max_index = std::max(max_index, feat);
           }
           ++feat;  // missing cells still advance the feature position
           any_field = true;
@@ -106,6 +108,7 @@ class CSVParser : public TextParserBase<IndexType, DType> {
       }
       out->offset.push_back(out->index.size());
     }
+    out->max_index = max_index;  // Clear() zeroed it above
     // pad the weight tail (see libsvm_parser.h: shortfall = OOB row reads)
     if (!out->weight.empty() && out->weight.size() < out->label.size()) {
       out->weight.resize(out->label.size(), 1.0f);

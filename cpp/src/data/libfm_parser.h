@@ -45,6 +45,9 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
     out->Clear();
     IndexType min_field = std::numeric_limits<IndexType>::max();
     IndexType min_index = std::numeric_limits<IndexType>::max();
+    // register accumulators (see libsvm_parser.h ParseBlock)
+    IndexType max_field = 0;
+    IndexType max_index = 0;
     const char* p = begin;
     while (p != end) {
       // blank lines, terminators, and NUL padding all skip (a NUL must be
@@ -74,13 +77,15 @@ class LibFMParser : public TextParserBase<IndexType, DType> {
         out->field.push_back(field);
         out->index.push_back(index);
         out->value.push_back(value);
-        out->max_field = std::max(out->max_field, field);
-        out->max_index = std::max(out->max_index, index);
+        max_field = std::max(max_field, field);
+        max_index = std::max(max_index, index);
         min_field = std::min(min_field, field);
         min_index = std::min(min_index, index);
       }
       out->offset.push_back(out->index.size());
     }
+    out->max_field = max_field;  // Clear() zeroed both above
+    out->max_index = max_index;
     if (param_.indexing_mode > 0 ||
         (param_.indexing_mode < 0 && !out->index.empty() && min_field > 0 &&
          min_index > 0)) {

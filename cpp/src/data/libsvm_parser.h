@@ -49,6 +49,9 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
                   RowBlockContainer<IndexType, DType>* out) override {
     out->Clear();
     IndexType min_index = std::numeric_limits<IndexType>::max();
+    // accumulate the max in a register instead of updating out->max_index
+    // per token through the container pointer (the push_backs may alias it)
+    IndexType max_index = 0;
     const char* p = begin;
     while (p != end) {
       // skip blank space between rows (blank lines, terminators, NUL pad)
@@ -114,12 +117,13 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
           has_val = true;
         }
         out->index.push_back(idx);
-        out->max_index = std::max(out->max_index, idx);
+        max_index = std::max(max_index, idx);
         min_index = std::min(min_index, idx);
         if (has_val) out->value.push_back(val);
       }
       out->offset.push_back(out->index.size());
     }
+    out->max_index = max_index;  // Clear() zeroed it above
     // rows after the last weighted/qid row carry defaults — the per-row
     // lazy resize only back-fills, so pad the tail too (RowBlock views
     // index these arrays per row; a shortfall is an out-of-bounds read)
