@@ -1,0 +1,63 @@
+// Parameter-system walkthrough (capability parity with reference
+// example/parameter.cc): declarative fields with ranges/enums/aliases,
+// generated docstrings, and did-you-mean errors.
+//
+// Build:  ninja -C build example_parameter_demo   (or: make lib)
+// Run:    ./build/example_parameter_demo num_hidden=100 act=relu name=demo
+#include <cstdio>
+#include <map>
+#include <string>
+
+#include "dmlctpu/logging.h"
+#include "dmlctpu/parameter.h"
+
+struct DemoParam : public dmlctpu::Parameter<DemoParam> {
+  int num_hidden;
+  float learning_rate;
+  int activation;
+  std::string name;
+  DMLCTPU_DECLARE_PARAMETER(DemoParam) {
+    DMLCTPU_DECLARE_FIELD(num_hidden)
+        .set_range(0, 1000)
+        .describe("Number of hidden units in the fully connected layer.");
+    DMLCTPU_DECLARE_FIELD(learning_rate)
+        .set_default(0.01f)
+        .describe("Learning rate of SGD optimization.");
+    DMLCTPU_DECLARE_FIELD(activation)
+        .add_enum("relu", 1)
+        .add_enum("sigmoid", 2)
+        .describe("Activation function type.");
+    DMLCTPU_DECLARE_FIELD(name).set_default("mnet").describe("Name of the net.");
+    DMLCTPU_DECLARE_ALIAS(num_hidden, nhidden);
+    DMLCTPU_DECLARE_ALIAS(activation, act);
+  }
+};
+
+int main(int argc, char* argv[]) {
+  std::map<std::string, std::string> kwargs;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    size_t eq = arg.find('=');
+    if (eq != std::string::npos) kwargs[arg.substr(0, eq)] = arg.substr(eq + 1);
+  }
+  std::printf("Docstring\n---------\n%s\n", DemoParam::__DOC__().c_str());
+  if (kwargs.empty()) {
+    std::printf("Usage: %s key=value ...   (try num_hidden=100 act=relu)\n",
+                argv[0]);
+    return 0;
+  }
+  DemoParam param;
+  try {
+    param.Init(kwargs);
+  } catch (const dmlctpu::Error& e) {
+    // typos produce did-you-mean suggestions; out-of-range values name the
+    // field and its bounds
+    std::printf("Init failed:\n%s\n", e.what());
+    return 1;
+  }
+  std::printf("param.num_hidden    = %d\n", param.num_hidden);
+  std::printf("param.learning_rate = %f\n", param.learning_rate);
+  std::printf("param.activation    = %d\n", param.activation);
+  std::printf("param.name          = %s\n", param.name.c_str());
+  return 0;
+}

@@ -1,0 +1,40 @@
+"""Smoke: the shipped examples must actually run (the reference's example/
+programs are its only executable documentation; same contract here)."""
+import os
+import subprocess
+import sys
+from pathlib import Path  # noqa: F401
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_train_linear_example_runs(tmp_path):
+    data = tmp_path / "tiny.libsvm"
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_example_train_linear", REPO / "examples" / "train_linear.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.synth_dataset(str(data), rows=2000, dim=100)
+    proc = subprocess.run(
+        [sys.executable, "examples/train_linear.py", "--data", str(data),
+         "--epochs", "2", "--batch-size", "512"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "final:" in proc.stdout and "loss" in proc.stdout
+
+
+def test_parameter_demo_builds_and_runs():
+    exe = REPO / "build" / "example_parameter_demo"
+    if not exe.exists():
+        subprocess.run(["ninja", "-C", "build", "example_parameter_demo"],
+                       check=True, capture_output=True, cwd=str(REPO))
+    out = subprocess.run([str(exe), "num_hidden=10", "act=sigmoid"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    assert "param.activation    = 2" in out.stdout
+    bad = subprocess.run([str(exe), "nhiden=5"], capture_output=True,
+                         text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "did you mean" in bad.stdout
