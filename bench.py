@@ -519,6 +519,35 @@ def h2d():
             "platform": platform}
 phase("h2d", h2d)
 
+def pallas_seg():
+    # the tiled one-hot segment-sum kernel, natively compiled on TPU
+    # (interpret mode elsewhere — tiny sizes, correctness + a timing note)
+    import numpy as np
+    import jax.numpy as jnp
+    from dmlc_core_tpu.ops.pallas_segment import segment_sum
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    nnz, rows = (1 << 20, 4096) if on_tpu else (1 << 12, 256)
+    rng = np.random.default_rng(0)
+    row_id = jnp.asarray(np.sort(rng.integers(0, rows, nnz)).astype(np.int32))
+    contrib = jnp.asarray(rng.standard_normal(nnz).astype(np.float32))
+    want = segment_sum(contrib, row_id, rows)
+    got = segment_sum(contrib, row_id, rows, force="pallas")
+    err = float(jnp.max(jnp.abs(got - want)))
+    out = {"platform": platform, "max_abs_err": round(err, 7), "nnz": nnz}
+    if on_tpu:
+        for name, force in (("pallas", "pallas"), ("xla", None)):
+            f = lambda: segment_sum(contrib, row_id, rows, force=force)  # noqa: E731
+            f().block_until_ready()
+            t0 = time.monotonic()
+            for _ in range(20):
+                r = f()
+            r.block_until_ready()
+            out[f"{name}_us_per_call"] = round(
+                (time.monotonic() - t0) / 20 * 1e6, 1)
+    return out
+phase("pallas_segment", pallas_seg)
+
 def real_allreduce():
     # only meaningful with >=2 real devices (a multi-chip TPU VM); this rig
     # has one tunneled chip, so the phase reports and the parent falls back
@@ -592,7 +621,7 @@ def run_device_phases() -> dict:
     if probe_tpu()["ok"]:
         run_child("tpu", timeout=360)
     missing = {"staging", "csv_staging", "recordio_staging",
-               "h2d"} - set(phases)
+               "h2d", "pallas_segment"} - set(phases)
     if missing:
         log(f"[bench] filling {sorted(missing)} on the CPU backend")
         run_child("cpu", timeout=300)
@@ -689,6 +718,7 @@ def main() -> None:
         "allreduce_note": allreduce.get("note") or allreduce.get("error"),
         "h2d_gbps_single_chip": phases.get("h2d", {}).get("gbps"),
         "h2d_platform": phases.get("h2d", {}).get("platform"),
+        "pallas_segment": phases.get("pallas_segment"),
         "tpu_probe": probe_summary,
         "data_mb": data.stat().st_size >> 20,
     }))
