@@ -581,9 +581,11 @@ def record_tpu_best(name: str, result: dict) -> None:
             best = json.loads(path.read_text())
         except json.JSONDecodeError:
             best = {}
-    key = result.get("mb_s") or result.get("gbps") or 0
-    if name not in best or key > (best[name].get("mb_s")
-                                  or best[name].get("gbps") or 0):
+    key = result.get("mb_s") or result.get("gbps")
+    prev = best.get(name, {})
+    prev_key = prev.get("mb_s") or prev.get("gbps")
+    # phases without a throughput metric (e.g. pallas timings): latest wins
+    if name not in best or key is None or key > (prev_key or 0):
         best[name] = {**result, "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                     time.gmtime())}
         path.write_text(json.dumps(best, indent=1))

@@ -41,26 +41,13 @@ print("CHILD_OK " + repr([l.decode() for l in lines]))
 
 @pytest.fixture(scope="module")
 def tls_server(tmp_path_factory):
+    from conftest import make_tls_server
     root = tmp_path_factory.mktemp("tls_root")
     (root / "data.txt").write_text("alpha\nbeta\ngamma\n")
-    cert = root / "cert.pem"
-    key = root / "key.pem"
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-         "-keyout", str(key), "-out", str(cert), "-days", "2",
-         "-subj", "/CN=localhost",
-         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
-        check=True, capture_output=True)
     handler = partial(SimpleHTTPRequestHandler, directory=str(root))
-    httpd = HTTPServer(("127.0.0.1", 0), handler)
-    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    ctx.load_cert_chain(str(cert), str(key))
-    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
-    port = httpd.server_address[1]
-    t = threading.Thread(target=httpd.serve_forever, daemon=True)
-    t.start()
-    yield {"port": port, "cert": str(cert)}
-    httpd.shutdown()
+    srv = make_tls_server(root, handler)
+    yield srv
+    srv["httpd"].shutdown()
 
 
 def _read(uri: str, extra_env: dict) -> subprocess.CompletedProcess:
