@@ -8,9 +8,11 @@ bytes) written through the native Stream — so `save(params, "s3://...")`
 works against any registered filesystem backend, and the format is
 splittable/seekable like every other .rec artifact.
 
-For sharded arrays this gathers to host (process 0) — fine for the model
-sizes this framework targets (sparse linear/FM); orbax remains the right
-tool for giant sharded checkpoints.
+Multi-host: globally-sharded leaves allgather their full value on EVERY
+process during save (size host RAM accordingly); process 0 writes, and all
+processes synchronize on the write outcome.  Fine for the model sizes this
+framework targets (sparse linear/FM); orbax remains the right tool for
+giant sharded checkpoints.
 """
 from __future__ import annotations
 
@@ -37,20 +39,60 @@ def _resolve_dtype(name: str) -> np.dtype:
 
 
 def save(pytree: Any, uri: str) -> int:
-    """Write a pytree checkpoint; returns the number of array leaves."""
+    """Write a pytree checkpoint; returns the number of array leaves
+    (0 on multi-host non-writer processes).
+
+    Multi-host contract: every process calls save() in the same order
+    (globally-sharded leaves allgather — a collective — and the final
+    status sync is one too, so issue from the consumer thread).  Only
+    process 0 writes the file; all processes then synchronize on the
+    write's OUTCOME, so a non-writer can never observe a missing or
+    half-written file while the writer thinks it failed (or vice versa).
+    Every process that holds a non-fully-addressable leaf materializes
+    that leaf's GLOBAL value during the allgather; fully-addressable
+    leaves are copied to host on the writer only."""
     leaves, treedef = jax.tree.flatten(pytree)
-    host_leaves = [np.asarray(leaf) for leaf in leaves]
-    meta = {
-        "version": _FORMAT_VERSION,
-        "treedef": str(treedef),
-        "leaves": [{"dtype": str(a.dtype), "shape": list(a.shape)}
-                   for a in host_leaves],
-    }
-    with RecordIOWriter(uri) as writer:
-        writer.write(json.dumps(meta).encode())
-        for arr in host_leaves:
-            writer.write(np.ascontiguousarray(arr).tobytes())
-    return len(host_leaves)
+    nprocs = jax.process_count()
+    is_writer = nprocs == 1 or jax.process_index() == 0
+
+    host_leaves = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            host_leaves.append(np.asarray(
+                multihost_utils.process_allgather(leaf, tiled=True)))
+        elif is_writer:
+            host_leaves.append(np.asarray(leaf))
+        else:
+            host_leaves.append(None)  # never written on this rank
+
+    write_err: Exception | None = None
+    if is_writer:
+        try:
+            meta = {
+                "version": _FORMAT_VERSION,
+                "treedef": str(treedef),
+                "leaves": [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                           for a in host_leaves],
+            }
+            with RecordIOWriter(uri) as writer:
+                writer.write(json.dumps(meta).encode())
+                for arr in host_leaves:
+                    writer.write(np.ascontiguousarray(arr).tobytes())
+        except Exception as e:  # noqa: BLE001 — re-raised after the sync
+            write_err = e
+    if nprocs > 1:
+        from jax.experimental import multihost_utils
+        ok = np.asarray(multihost_utils.process_allgather(
+            np.asarray([0 if write_err is not None else 1], np.int64)))
+        if write_err is not None:
+            raise write_err
+        if int(ok.min()) == 0:
+            raise RuntimeError(
+                f"checkpoint write failed on the writer process: {uri}")
+    elif write_err is not None:
+        raise write_err
+    return len(host_leaves) if is_writer else 0
 
 
 def load(uri: str, like: Any = None):

@@ -233,3 +233,59 @@ def test_two_process_staging_uneven_parts(tmp_path):
     # ragged tail really happened: 60 rows cannot fit the batches 25 rows
     # needs, so the global batch count exceeds process 1's local need
     assert results[0]["batches"] >= 4
+
+
+_CKPT_CHILD = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlc_core_tpu import checkpoint
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharded = NamedSharding(mesh, P("data"))
+local = np.arange(8, dtype=np.float32) + 100 * pid
+tree = {"w": jax.make_array_from_process_local_data(sharded, local),
+        "b": jnp.float32(3.5)}
+n = checkpoint.save(tree, out)
+print(f"SAVED pid={pid} leaves={n}", flush=True)
+if pid == 0:
+    arrays, meta = checkpoint.load(out)
+    by_shape = {a.shape: a for a in arrays}
+    w = by_shape[(16,)]
+    expect = np.concatenate([np.arange(8, dtype=np.float32),
+                             np.arange(8, dtype=np.float32) + 100])
+    np.testing.assert_array_equal(w, expect)
+    print("CKPT_OK", flush=True)
+"""
+
+
+def test_two_process_checkpoint_save(tmp_path):
+    """checkpoint.save of a multi-host global array: all processes join the
+    allgather, only process 0 writes, and the file holds the GLOBAL data."""
+    out = str(tmp_path / "ckpt.rec")
+    port = str(_free_port())
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CKPT_CHILD, str(p), port, out],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(REPO)) for p in (0, 1)]
+    outs = {}
+    for p, proc in enumerate(procs):
+        try:
+            o, e = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"checkpoint process {p} hung")
+        assert proc.returncode == 0, f"process {p} failed:\n{e[-2000:]}"
+        outs[p] = o
+    assert "SAVED pid=0 leaves=2" in outs[0]
+    assert "SAVED pid=1 leaves=0" in outs[1]  # non-zero rank writes nothing
+    assert "CKPT_OK" in outs[0]
