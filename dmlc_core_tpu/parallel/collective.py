@@ -38,7 +38,10 @@ def allreduce(x: jax.Array, op: str = "sum", axis_name: str = "data") -> jax.Arr
 
 def _bench_step(mesh: Mesh, nfloats_per_dev: int):
     """Build a jitted shard_map that psums one f32 buffer per device."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8 stable location
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
 
     def reduce_fn(x):
         return jax.lax.psum(x, "data")
