@@ -9,6 +9,7 @@
 
 #include <any>
 #include <cctype>
+#include <cstdint>
 #include <charconv>
 #include <cmath>
 #include <cstdio>
@@ -67,14 +68,22 @@ class JSONReader {
           case '\\': out->push_back('\\'); break;
           case '/': out->push_back('/'); break;
           case 'u': {
-            // minimal \uXXXX: decode latin-1 subset, else '?'
-            int code = 0;
-            for (int i = 0; i < 4; ++i) {
-              int h = NextChar();
-              Expect(std::isxdigit(h), "bad \\u escape");
-              code = code * 16 + (std::isdigit(h) ? h - '0' : (std::tolower(h) - 'a' + 10));
+            // \uXXXX escapes, emitted as UTF-8; surrogate pairs
+            // (\uD800-\uDBFF followed by \uDC00-\uDFFF) combine into one
+            // supplementary-plane code point per RFC 8259 §7
+            uint32_t code = ReadHex4();
+            if (code >= 0xD800 && code <= 0xDBFF) {
+              Expect(NextChar() == '\\' && NextChar() == 'u',
+                     "unpaired UTF-16 surrogate in \\u escape");
+              uint32_t lo = ReadHex4();
+              Expect(lo >= 0xDC00 && lo <= 0xDFFF,
+                     "invalid low surrogate in \\u escape");
+              code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+            } else {
+              Expect(!(code >= 0xDC00 && code <= 0xDFFF),
+                     "unpaired low surrogate in \\u escape");
             }
-            out->push_back(code < 256 ? static_cast<char>(code) : '?');
+            AppendUtf8(code, out);
             break;
           }
           default:
@@ -239,6 +248,33 @@ class JSONReader {
   }
   void Expect(bool ok, const char* what) {
     if (!ok) Fail(what);
+  }
+  uint32_t ReadHex4() {
+    uint32_t code = 0;
+    for (int i = 0; i < 4; ++i) {
+      int h = NextChar();
+      Expect(std::isxdigit(h), "bad \\u escape");
+      code = code * 16 + static_cast<uint32_t>(
+          std::isdigit(h) ? h - '0' : (std::tolower(h) - 'a' + 10));
+    }
+    return code;
+  }
+  static void AppendUtf8(uint32_t cp, std::string* out) {
+    if (cp < 0x80) {
+      out->push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
   }
   [[noreturn]] void Fail(const char* what) {
     TLOG(Fatal) << "JSON parse error at line " << line_ << ": " << what;

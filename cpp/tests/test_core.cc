@@ -180,6 +180,30 @@ TESTCASE(json_bool_int64_controlchar_roundtrip) {
   EXPECT_EQV(ctrl, std::string("a\x08\x1f") + "b");
 }
 
+TESTCASE(json_unicode_escapes_utf8) {
+  // \uXXXX escapes decode to UTF-8: 2-byte, 3-byte, and a surrogate pair
+  // (4-byte, RFC 8259 section 7); unpaired surrogates are rejected
+  {
+    std::istringstream is("\"caf\\u00e9 \\u4e2d \\ud83d\\ude00\"");
+    JSONReader r(&is);
+    std::string out;
+    r.ReadString(&out);
+    EXPECT_EQV(out, std::string("caf\xc3\xa9 \xe4\xb8\xad \xf0\x9f\x98\x80"));
+  }
+  {
+    std::istringstream is("\"\\ud83d oops\"");  // high surrogate, no low
+    JSONReader r(&is);
+    std::string out;
+    EXPECT_THROWS(r.ReadString(&out));
+  }
+  {
+    std::istringstream is("\"\\ude00\"");  // bare low surrogate
+    JSONReader r(&is);
+    std::string out;
+    EXPECT_THROWS(r.ReadString(&out));
+  }
+}
+
 TESTCASE(json_object_helper) {
   std::istringstream is(R"({"name": "tpu", "count": 8, "scale": 1.5})");
   JSONReader r(&is);
