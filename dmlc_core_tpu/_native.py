@@ -46,11 +46,17 @@ def _candidate_paths():
 
 def _build_native() -> Path:
     build_dir = _REPO_ROOT / "build"
-    subprocess.run(
-        ["cmake", "-B", str(build_dir), "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
-        cwd=_REPO_ROOT, check=True, capture_output=True)
-    subprocess.run(["ninja", "-C", str(build_dir), "dmlctpu"],
-                   cwd=_REPO_ROOT, check=True, capture_output=True)
+    for cmd in (["cmake", "-B", str(build_dir), "-G", "Ninja",
+                 "-DCMAKE_BUILD_TYPE=Release"],
+                ["ninja", "-C", str(build_dir), "dmlctpu"]):
+        proc = subprocess.run(cmd, cwd=_REPO_ROOT, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            # surface the compiler/linker output: an opaque import failure
+            # here makes EVERY Python entry point undiagnosable
+            raise RuntimeError(
+                f"native build failed ({' '.join(cmd[:2])}, "
+                f"rc={proc.returncode}):\n{proc.stderr[-2000:]}")
     return build_dir / "libdmlctpu.so"
 
 
