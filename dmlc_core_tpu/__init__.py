@@ -11,7 +11,7 @@ Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
     the DMLC_* env bootstrap onto jax.distributed;
   * `tracker`: dmlc-submit job launch + rabit-compatible rendezvous.
 """
-from . import data, io, models, ops, parallel
+from . import checkpoint, data, io, models, ops, parallel, timer
 from ._native import NativeError, version as native_version
 from .data import (DeviceStagingIter, PaddedBatch, Parser, RecordBatch,
                    RecordStagingIter, RowBlock)
@@ -19,7 +19,7 @@ from .io import InputSplit, RecordIOReader, RecordIOWriter
 
 __version__ = "0.1.0"
 __all__ = [
-    "data", "io", "models", "ops", "parallel",
+    "checkpoint", "data", "io", "models", "ops", "parallel", "timer",
     "NativeError", "native_version",
     "DeviceStagingIter", "PaddedBatch", "Parser", "RowBlock",
     "RecordBatch", "RecordStagingIter",
