@@ -3,5 +3,7 @@ reference delegates to downstream DMLC projects (XGBoost/MXNet), rebuilt as
 jittable JAX models over PaddedBatch pytrees."""
 from .linear import SparseLinearModel
 from .fm import FactorizationMachine
+from .gbdt import GBDT, QuantileBinner
 
-__all__ = ["SparseLinearModel", "FactorizationMachine"]
+__all__ = ["SparseLinearModel", "FactorizationMachine", "GBDT",
+           "QuantileBinner"]

@@ -1,0 +1,290 @@
+"""Histogram gradient-boosted decision trees, TPU-native.
+
+The reference library exists to feed XGBoost: its data layer produces the
+RowBlocks XGBoost's hist algorithm consumes, and its tracker brokers the
+rabit allreduce XGBoost uses to combine per-worker **gradient histograms**
+(reference tracker/dmlc_tracker/tracker.py:185-252 builds that tree+ring
+topology; BASELINE target 5 is "XGBoost-hist Higgs-11M").  This module is
+the TPU-native closure of that loop: the same hist algorithm, designed for
+XLA —
+
+* features are quantile-binned once into uint8 (``QuantileBinner``), so a
+  dataset is a dense ``[rows, features]`` byte matrix — static shapes,
+  VPU-friendly gathers, 4-32x smaller than f32 in HBM;
+* each tree level is ONE jitted pass: a fused segment-sum builds the
+  ``[nodes, features, bins]`` (grad, hess) histograms, split finding is a
+  dense cumsum + argmax over that array, and row→child routing is a gather
+  — no per-node recursion, no data-dependent control flow;
+* under a mesh with rows sharded over ``data`` and tree state replicated,
+  XLA lowers the histogram reduction to a psum over ICI — the rabit
+  histogram-allreduce, as a compiler-inserted collective (SURVEY §5's
+  "distributed communication backend" mapping);
+* trees are fixed-depth complete binary heaps in flat arrays
+  (``feature/threshold`` per internal node, ``leaf`` per leaf), so
+  prediction is ``max_depth`` vectorized gathers — XLA-friendly and
+  checkpointable as a plain pytree via dmlc_core_tpu.checkpoint.
+
+Sibling-histogram subtraction (build the smaller child, subtract from the
+parent) is deliberately not used: it halves FLOPs on serial CPUs but makes
+the level pass stateful; on TPU the full-level segment-sum is a single
+bandwidth-bound fused op and the simpler schedule wins.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantileBinner:
+    """Per-feature quantile binning to uint8 codes (XGBoost-hist's sketch).
+
+    ``fit`` computes per-feature quantile cut points on a host sample
+    (numpy; the sketch is a once-per-dataset preprocessing step);
+    ``transform`` is jittable and maps values to bin codes in
+    ``[0, num_bins)`` via searchsorted over the cuts.
+    """
+
+    def __init__(self, num_bins: int = 256):
+        if not 2 <= num_bins <= 256:
+            raise ValueError("num_bins must be in [2, 256] (uint8 codes)")
+        self.num_bins = num_bins
+        self.cuts: Optional[jax.Array] = None  # f32 [features, num_bins-1]
+
+    def fit(self, sample: np.ndarray) -> "QuantileBinner":
+        sample = np.asarray(sample, np.float32)
+        if sample.ndim != 2:
+            raise ValueError("fit expects [rows, features]")
+        qs = np.linspace(0.0, 1.0, self.num_bins + 1)[1:-1]
+        cuts = np.quantile(sample, qs, axis=0).T  # [features, num_bins-1]
+        # strictly increasing cuts keep searchsorted stable on ties
+        cuts = np.maximum.accumulate(cuts, axis=1)
+        self.cuts = jnp.asarray(cuts)
+        return self
+
+    def transform(self, x: jax.Array) -> jax.Array:
+        """[rows, features] float -> [rows, features] uint8 bin codes."""
+        if self.cuts is None:
+            raise RuntimeError("QuantileBinner.transform before fit")
+        codes = jax.vmap(
+            lambda col, cut: jnp.searchsorted(cut, col, side="right"),
+            in_axes=(1, 0), out_axes=1)(x, self.cuts)
+        return codes.astype(jnp.uint8)
+
+    def fit_transform(self, x: np.ndarray) -> jax.Array:
+        return self.fit(x).transform(jnp.asarray(x, jnp.float32))
+
+
+def _logistic_grad_hess(margin: jax.Array, label: jax.Array
+                        ) -> Tuple[jax.Array, jax.Array]:
+    p = jax.nn.sigmoid(margin)
+    y = jnp.where(label > 0.5, 1.0, 0.0)
+    return p - y, jnp.maximum(p * (1.0 - p), 1e-16)
+
+
+def _squared_grad_hess(margin: jax.Array, label: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    return margin - label, jnp.ones_like(margin)
+
+
+class GBDT:
+    """Gradient-boosted complete binary trees over binned features.
+
+    Parameters mirror the XGBoost-hist essentials: ``num_trees``,
+    ``max_depth`` (trees are complete; a node that finds no positive-gain
+    split stores a null split routing every row left, so its whole subtree
+    degenerates to the leftmost leaf and unreachable nodes stay zero),
+    ``learning_rate`` (shrinkage), ``lambda_`` (L2
+    on leaf weights), ``min_child_weight`` (minimum hessian mass per
+    child), ``objective`` ("logistic" or "squared").
+
+    The forest is a pytree of flat arrays::
+
+        feature   i32 [num_trees, 2**max_depth - 1]   per internal node
+        threshold i32 [num_trees, 2**max_depth - 1]   go right if bin > thr
+        leaf      f32 [num_trees, 2**max_depth]       shrunken leaf weights
+        base      f32 []                              initial margin
+
+    Null splits use ``threshold == num_bins`` (no uint8 code exceeds it).
+    """
+
+    def __init__(self, num_features: int, num_trees: int = 20,
+                 max_depth: int = 6, num_bins: int = 256,
+                 learning_rate: float = 0.3, lambda_: float = 1.0,
+                 min_child_weight: float = 1e-3,
+                 objective: str = "logistic"):
+        if objective not in ("logistic", "squared"):
+            raise ValueError(f"unknown objective '{objective}'")
+        self.num_features = num_features
+        self.num_trees = num_trees
+        self.max_depth = max_depth
+        self.num_bins = num_bins
+        self.learning_rate = learning_rate
+        self.lambda_ = lambda_
+        self.min_child_weight = min_child_weight
+        self.objective = objective
+        self._grad_hess = (_logistic_grad_hess if objective == "logistic"
+                           else _squared_grad_hess)
+
+    # ---- forest construction ------------------------------------------------
+
+    def init(self) -> dict:
+        n_internal = 2 ** self.max_depth - 1
+        return {
+            "feature": jnp.zeros((self.num_trees, n_internal), jnp.int32),
+            "threshold": jnp.full((self.num_trees, n_internal),
+                                  self.num_bins, jnp.int32),
+            "leaf": jnp.zeros((self.num_trees, 2 ** self.max_depth),
+                              jnp.float32),
+            "base": jnp.zeros((), jnp.float32),
+        }
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """One tree from per-row (grad, hess); levels unrolled under jit.
+
+        bins: u8 [rows, features]; grad/hess: f32 [rows] (weight-scaled,
+        padding rows carry 0 mass).  Returns (feature, threshold, leaf,
+        leaf_rel) where leaf_rel is each row's final leaf index.
+        """
+        F, B = self.num_features, self.num_bins
+        rows = bins.shape[0]
+        bins_i = bins.astype(jnp.int32)
+        feat_cols = jnp.arange(F, dtype=jnp.int32)
+
+        node = jnp.zeros(rows, jnp.int32)  # heap id of each row's node
+        features = []
+        thresholds = []
+        for depth in range(self.max_depth):
+            first = 2 ** depth - 1          # heap id of the level's first node
+            n_nodes = 2 ** depth
+            rel = node - first              # [rows] in [0, n_nodes)
+            # fused histogram build: ONE segment-sum over rows x features
+            # carrying (grad, hess) lanes together — the key array (the
+            # bandwidth bottleneck) is read once, not once per statistic.
+            # keys: ((node * F) + f) * B + bin  ->  [n_nodes, F, B, 2]
+            keys = ((rel[:, None] * F + feat_cols[None, :]) * B + bins_i
+                    ).reshape(-1)
+            seg = n_nodes * F * B
+            gh = jnp.stack([grad, hess], axis=-1)  # [rows, 2]
+            hist = jax.ops.segment_sum(
+                jnp.broadcast_to(gh[:, None, :], (rows, F, 2)).reshape(-1, 2),
+                keys, num_segments=seg).reshape(n_nodes, F, B, 2)
+            hist_g = hist[..., 0]
+            hist_h = hist[..., 1]
+            # left cumulative mass for "go right if bin > b" at each cut b
+            gl = jnp.cumsum(hist_g, axis=2)
+            hl = jnp.cumsum(hist_h, axis=2)
+            g_tot = gl[:, :, -1:]
+            h_tot = hl[:, :, -1:]
+            gr = g_tot - gl
+            hr = h_tot - hl
+            lam = self.lambda_
+            gain = (gl ** 2 / (hl + lam) + gr ** 2 / (hr + lam)
+                    - g_tot ** 2 / (h_tot + lam))          # [nodes, F, B]
+            valid = ((hl >= self.min_child_weight) &
+                     (hr >= self.min_child_weight))
+            gain = jnp.where(valid, gain, -jnp.inf)
+            flat = gain.reshape(n_nodes, F * B)
+            best = jnp.argmax(flat, axis=1)                 # [nodes]
+            best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+            split_f = (best // B).astype(jnp.int32)
+            split_b = (best % B).astype(jnp.int32)
+            null = best_gain <= 0.0                         # no useful split
+            split_f = jnp.where(null, 0, split_f)
+            split_b = jnp.where(null, B, split_b)           # everything left
+            features.append(split_f)
+            thresholds.append(split_b)
+            # route rows: children of heap node n are 2n+1 (left), 2n+2
+            row_bin = bins_i[jnp.arange(rows), split_f[rel]]
+            go_right = row_bin > split_b[rel]
+            node = 2 * node + 1 + go_right.astype(jnp.int32)
+
+        # leaf weights: -G/(H + lambda) per leaf, shrunken
+        n_leaves = 2 ** self.max_depth
+        leaf_rel = node - (n_leaves - 1)
+        gh_leaf = jax.ops.segment_sum(jnp.stack([grad, hess], axis=-1),
+                                      leaf_rel, num_segments=n_leaves)
+        leaf = (-self.learning_rate * gh_leaf[:, 0]
+                / (gh_leaf[:, 1] + self.lambda_))
+        # leaf_rel doubles as each row's final leaf assignment, so fit()
+        # can update margins without re-routing every row through the tree
+        return (jnp.concatenate(features), jnp.concatenate(thresholds),
+                leaf, leaf_rel)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _tree_margins(self, feature: jax.Array, threshold: jax.Array,
+                      leaf: jax.Array, bins: jax.Array) -> jax.Array:
+        """Route every row down one tree; returns its leaf weight per row."""
+        rows = bins.shape[0]
+        bins_i = bins.astype(jnp.int32)
+        node = jnp.zeros(rows, jnp.int32)
+        for _ in range(self.max_depth):
+            f = feature[node]
+            t = threshold[node]
+            go_right = bins_i[jnp.arange(rows), f] > t
+            node = 2 * node + 1 + go_right.astype(jnp.int32)
+        return leaf[node - (2 ** self.max_depth - 1)]
+
+    # ---- public API ---------------------------------------------------------
+
+    def fit(self, bins: jax.Array, label: jax.Array,
+            weight: Optional[jax.Array] = None) -> dict:
+        """Train the forest on binned features.
+
+        bins: u8 [rows, features] (``QuantileBinner.transform`` output; may
+        be sharded over a mesh's data axis — tree state stays replicated
+        and XLA inserts the histogram psum).  Returns the forest pytree.
+        """
+        label = label.astype(jnp.float32)
+        w = (jnp.ones_like(label) if weight is None
+             else weight.astype(jnp.float32))
+        params = self.init()
+        if self.objective == "logistic":
+            # base margin from the weighted prior, clamped away from 0/1
+            p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0))
+                         / jnp.maximum(jnp.sum(w), 1.0), 1e-6, 1 - 1e-6)
+            base = jnp.log(p / (1 - p))
+        else:
+            base = (jnp.sum(label * w) / jnp.maximum(jnp.sum(w), 1.0))
+        params["base"] = base.astype(jnp.float32)
+
+        margin = jnp.full(label.shape, params["base"])
+        feats, thrs, leaves = [], [], []
+        for _ in range(self.num_trees):
+            g, h = self._grad_hess(margin, label)
+            f, t, leaf, leaf_rel = self._build_tree(bins, g * w, h * w)
+            margin = margin + leaf[leaf_rel]
+            feats.append(f)
+            thrs.append(t)
+            leaves.append(leaf)
+        params["feature"] = jnp.stack(feats)
+        params["threshold"] = jnp.stack(thrs)
+        params["leaf"] = jnp.stack(leaves)
+        return params
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def margins(self, params: dict, bins: jax.Array) -> jax.Array:
+        def body(i, m):
+            return m + self._tree_margins(params["feature"][i],
+                                          params["threshold"][i],
+                                          params["leaf"][i], bins)
+        init = jnp.full(bins.shape[:1], params["base"])
+        return jax.lax.fori_loop(0, self.num_trees, body, init)
+
+    def predict(self, params: dict, bins: jax.Array) -> jax.Array:
+        m = self.margins(params, bins)
+        return jax.nn.sigmoid(m) if self.objective == "logistic" else m
+
+    def loss(self, params: dict, bins: jax.Array, label: jax.Array) -> jax.Array:
+        m = self.margins(params, bins)
+        if self.objective == "logistic":
+            y = jnp.where(label > 0.5, 1.0, 0.0)
+            per = jnp.maximum(m, 0) - m * y + jnp.log1p(jnp.exp(-jnp.abs(m)))
+        else:
+            per = 0.5 * (m - label) ** 2
+        return jnp.mean(per)

@@ -45,3 +45,16 @@ def padded_row_mean(per_row: jax.Array, weight: jax.Array) -> jax.Array:
     """Weighted mean over rows that treats padding rows (weight 0) as absent."""
     total = jnp.sum(weight)
     return jnp.sum(per_row * weight) / jnp.maximum(total, 1.0)
+
+
+def csr_to_dense(index: jax.Array, value: jax.Array, row_id: jax.Array,
+                 num_rows: int, num_features: int) -> jax.Array:
+    """Densify a COO batch: out[r, f] = Σ_{k: row_id[k]=r, index[k]=f} value[k].
+
+    The bridge from the staged sparse pipeline to dense consumers (the
+    binned GBDT path); a single scatter-add with static output shape.
+    Padding lanes (value 0) contribute nothing; entries with out-of-range
+    feature or row ids are dropped (not aliased into a real column).
+    """
+    out = jnp.zeros((num_rows, num_features), value.dtype)
+    return out.at[row_id, index].add(value, mode="drop")

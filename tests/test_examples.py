@@ -38,3 +38,22 @@ def test_parameter_demo_builds_and_runs():
                          text=True, timeout=60)
     assert bad.returncode == 1
     assert "did you mean" in bad.stdout
+
+
+def test_gbdt_example_runs(tmp_path):
+    """The XGBoost-hist workflow example: stage -> densify -> bin -> boost."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_example_gbdt", REPO / "examples" / "gbdt_train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    data = tmp_path / "tiny_gbdt.libsvm"
+    mod.synth_dataset(str(data), rows=4000, dim=16)
+    proc = subprocess.run(
+        [sys.executable, "examples/gbdt_train.py", "--data", str(data),
+         "--dim", "16", "--trees", "5", "--depth", "4", "--bins", "32",
+         "--batch-size", "1024"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "final:" in proc.stdout and "accuracy" in proc.stdout

@@ -104,3 +104,19 @@ def test_allreduce_bench_runs():
     result = allreduce_bench(mesh, mib_per_device=1.0, iters=2)
     assert result["devices"] == 8
     assert result["algo_gbps"] > 0
+
+
+def test_csr_to_dense_matches_scatter():
+    import numpy as np
+    from dmlc_core_tpu.ops.sparse import csr_to_dense
+    rng = np.random.default_rng(0)
+    nnz, rows, feats = 64, 8, 10
+    row_id = np.sort(rng.integers(0, rows, nnz)).astype(np.int32)
+    index = rng.integers(0, feats, nnz).astype(np.int32)
+    value = rng.standard_normal(nnz).astype(np.float32)
+    got = np.asarray(csr_to_dense(jnp.asarray(index), jnp.asarray(value),
+                                  jnp.asarray(row_id), rows, feats))
+    want = np.zeros((rows, feats), np.float32)
+    for r, i, v in zip(row_id, index, value):
+        want[r, i] += v
+    np.testing.assert_allclose(got, want, rtol=1e-6)
