@@ -432,6 +432,36 @@ def run_recordio_staging(path: Path) -> dict:
     return result
 
 
+def run_gbdt() -> dict:
+    """Value-add phase (no reference counterpart; BASELINE target 5's model):
+    histogram-GBDT training throughput over binned features — the
+    XGBoost-hist workload the reference's data layer exists to feed.
+    Reported as row-trees/s (rows x trees / fit seconds), steady-state
+    (second fit, so the per-shape jit compile is excluded)."""
+    jax, platform = pick_backend()
+    import numpy as np
+
+    from dmlc_core_tpu.models import GBDT, QuantileBinner
+
+    rows, features = (100_000, 28)
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((rows, features)).astype(np.float32)
+    y = ((x[:, 0] * x[:, 1] > 0) ^ (x[:, 2] > 0.4)).astype(np.float32)
+    bins = QuantileBinner(num_bins=256).fit_transform(x)
+    label = jax.numpy.asarray(y)
+    model = GBDT(num_features=features, num_trees=5, max_depth=6,
+                 num_bins=256, learning_rate=0.4)
+    jax.block_until_ready(model.fit(bins, label)["leaf"])  # compile warmup
+    t0 = time.monotonic()
+    params = model.fit(bins, label)
+    jax.block_until_ready(params["leaf"])
+    secs = time.monotonic() - t0
+    return {"rows": rows, "trees": model.num_trees,
+            "depth": model.max_depth, "secs": round(secs, 3),
+            "row_trees_s": round(rows * model.num_trees / secs),
+            "platform": platform}
+
+
 def run_staging(data: Path, fmt: str = "auto") -> dict:
     """Extra: the full native parse -> pad -> HBM staging path."""
     jax, platform = pick_backend()
@@ -506,6 +536,7 @@ rec = bench.make_recordio_dataset()
 phase("staging", lambda: bench.run_staging(data))
 phase("csv_staging", lambda: bench.run_staging(csv, fmt="csv"))
 phase("recordio_staging", lambda: bench.run_recordio_staging(rec))
+phase("gbdt", bench.run_gbdt)
 
 def h2d():
     import numpy as np
@@ -584,8 +615,11 @@ def _better_observation(entry: dict, prev: dict | None) -> bool:
         return True
     if entry.get("reconstructed") and not prev.get("reconstructed"):
         return False
-    key = entry.get("mb_s") or entry.get("gbps")
-    prev_key = prev.get("mb_s") or prev.get("gbps")
+    def throughput(e: dict):
+        return e.get("mb_s") or e.get("gbps") or e.get("row_trees_s")
+
+    key = throughput(entry)
+    prev_key = throughput(prev)
     if key is not None and prev_key is not None:
         return key > prev_key
     return entry.get("ts", "") >= prev.get("ts", "")
@@ -670,12 +704,12 @@ def run_device_phases() -> dict:
                     phases[name] = result
 
     if probe_tpu()["ok"]:
-        run_child("tpu", timeout=360)
+        run_child("tpu", timeout=480)
     missing = {"staging", "csv_staging", "recordio_staging",
-               "h2d", "pallas_segment"} - set(phases)
+               "h2d", "pallas_segment", "gbdt"} - set(phases)
     if missing:
         log(f"[bench] filling {sorted(missing)} on the CPU backend")
-        run_child("cpu", timeout=300)
+        run_child("cpu", timeout=420)
     return phases
 
 
@@ -764,6 +798,8 @@ def main() -> None:
         "allreduce_platform": allreduce.get("platform"),
         "allreduce_devices": allreduce.get("devices"),
         "allreduce_note": allreduce.get("note") or allreduce.get("error"),
+        "gbdt_row_trees_per_sec": phases.get("gbdt", {}).get("row_trees_s"),
+        "gbdt_platform": phases.get("gbdt", {}).get("platform"),
         "h2d_gbps_single_chip": phases.get("h2d", {}).get("gbps"),
         "h2d_platform": phases.get("h2d", {}).get("platform"),
         "pallas_segment": phases.get("pallas_segment"),

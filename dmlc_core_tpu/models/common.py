@@ -1,0 +1,16 @@
+"""Shared loss pieces for the model families (one stable implementation,
+used by linear / FM / GBDT alike)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def logistic_nll(margin: jax.Array, label: jax.Array) -> jax.Array:
+    """Per-row binary-cross-entropy from margins, overflow-stable.
+
+    Accepts labels in {0,1} or {-1,1} (anything > 0.5 is positive).
+    """
+    y = jnp.where(label > 0.5, 1.0, 0.0)
+    return (jnp.maximum(margin, 0) - margin * y
+            + jnp.log1p(jnp.exp(-jnp.abs(margin))))

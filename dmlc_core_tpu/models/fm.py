@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..data.staging import PaddedBatch
 from ..ops.sparse import csr_matmul, csr_matvec, csr_row_sumsq_matmul, padded_row_mean
+from .common import logistic_nll
 
 
 class FactorizationMachine:
@@ -53,8 +54,7 @@ class FactorizationMachine:
     def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
         m = self.margins(params, batch)
         if self.objective == "logistic":
-            y = jnp.where(batch.label > 0.5, 1.0, 0.0)
-            per_row = jnp.maximum(m, 0) - m * y + jnp.log1p(jnp.exp(-jnp.abs(m)))
+            per_row = logistic_nll(m, batch.label)
         else:
             per_row = 0.5 * (m - batch.label) ** 2
         data_loss = padded_row_mean(per_row, batch.weight)

@@ -78,6 +78,9 @@ class QuantileBinner:
         return self.fit(x).transform(jnp.asarray(x, jnp.float32))
 
 
+from .common import logistic_nll
+
+
 def _logistic_grad_hess(margin: jax.Array, label: jax.Array
                         ) -> Tuple[jax.Array, jax.Array]:
     p = jax.nn.sigmoid(margin)
@@ -144,7 +147,7 @@ class GBDT:
 
     @functools.partial(jax.jit, static_argnums=0)
     def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array
-                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
         """One tree from per-row (grad, hess); levels unrolled under jit.
 
         bins: u8 [rows, features]; grad/hess: f32 [rows] (weight-scaled,
@@ -244,13 +247,14 @@ class GBDT:
         w = (jnp.ones_like(label) if weight is None
              else weight.astype(jnp.float32))
         params = self.init()
+        sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
         if self.objective == "logistic":
             # base margin from the weighted prior, clamped away from 0/1
-            p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0))
-                         / jnp.maximum(jnp.sum(w), 1.0), 1e-6, 1 - 1e-6)
+            p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0)) / sum_w,
+                         1e-6, 1 - 1e-6)
             base = jnp.log(p / (1 - p))
         else:
-            base = (jnp.sum(label * w) / jnp.maximum(jnp.sum(w), 1.0))
+            base = jnp.sum(label * w) / sum_w
         params["base"] = base.astype(jnp.float32)
 
         margin = jnp.full(label.shape, params["base"])
@@ -280,11 +284,16 @@ class GBDT:
         m = self.margins(params, bins)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
 
-    def loss(self, params: dict, bins: jax.Array, label: jax.Array) -> jax.Array:
+    def loss(self, params: dict, bins: jax.Array, label: jax.Array,
+             weight: Optional[jax.Array] = None) -> jax.Array:
+        """Mean objective over rows; ``weight`` masks padding rows (weight
+        0) exactly as in ``fit`` and the other model families."""
         m = self.margins(params, bins)
         if self.objective == "logistic":
-            y = jnp.where(label > 0.5, 1.0, 0.0)
-            per = jnp.maximum(m, 0) - m * y + jnp.log1p(jnp.exp(-jnp.abs(m)))
+            per = logistic_nll(m, label)
         else:
             per = 0.5 * (m - label) ** 2
-        return jnp.mean(per)
+        if weight is None:
+            return jnp.mean(per)
+        w = weight.astype(jnp.float32)
+        return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-12)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..data.staging import PaddedBatch
 from ..ops.sparse import csr_matvec, padded_row_mean
+from .common import logistic_nll
 
 
 class SparseLinearModel:
@@ -47,8 +48,7 @@ class SparseLinearModel:
     def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
         m = self.margins(params, batch)
         if self.objective == "logistic":
-            y = jnp.where(batch.label > 0.5, 1.0, 0.0)  # accept {-1,1} or {0,1}
-            per_row = jnp.maximum(m, 0) - m * y + jnp.log1p(jnp.exp(-jnp.abs(m)))
+            per_row = logistic_nll(m, batch.label)  # accepts {-1,1} or {0,1}
         else:
             per_row = 0.5 * (m - batch.label) ** 2
         data_loss = padded_row_mean(per_row, batch.weight)
