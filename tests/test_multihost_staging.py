@@ -131,6 +131,42 @@ print("RESULT " + json.dumps({"pid": pid, "batches": batches,
 """
 
 
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_two(child_src: str, *argv: str, label: str = "process",
+             timeout: int = 300):
+    """Launch the given child source as BOTH jax.distributed processes
+    (pid, coordinator port, then *argv as argv[3:]), fail fast on hangs or
+    nonzero exits, and return ({pid: parsed RESULT json}, {pid: stdout})."""
+    port = str(_free_port())
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", child_src, str(p), port, *map(str, argv)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(REPO)) for p in (0, 1)]
+    results, outs = {}, {}
+    for p, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"{label} {p} hung (multi-host deadlock?)")
+        assert proc.returncode == 0, f"{label} {p} failed:\n{err[-2000:]}"
+        outs[p] = out
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results[p] = json.loads(line[len("RESULT "):])
+    return results, outs
+
+
 def test_two_process_record_staging(tmp_path):
     """RecordStagingIter multi-host path: byte-exact record spans across
     per-process blocks (padding must never leak into a record's payload),
@@ -151,25 +187,8 @@ def test_two_process_record_staging(tmp_path):
                 counts += 1
         files.append(str(f))
 
-    port = str(_free_port())
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", _RECORD_CHILD, str(p), port, files[0], files[1]],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-        cwd=str(REPO)) for p in (0, 1)]
-    results = {}
-    for p, proc in enumerate(procs):
-        try:
-            out, err = proc.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError(f"record process {p} hung")
-        assert proc.returncode == 0, f"process {p} failed:\n{err[-2000:]}"
-        for line in out.splitlines():
-            if line.startswith("RESULT "):
-                results[p] = json.loads(line[len("RESULT "):])
+    results, _ = _run_two(_RECORD_CHILD, files[0], files[1],
+                          label="record process")
     # identical global stream on both processes (modulo the pid tag)
     assert ({k: v for k, v in results[0].items() if k != "pid"}
             == {k: v for k, v in results[1].items() if k != "pid"})
@@ -177,14 +196,6 @@ def test_two_process_record_staging(tmp_path):
     assert results[0]["first_sum"] == first_sums
     assert results[0]["size_sum"] == size_sums
     assert results[0]["batches"] >= 5  # 37 records / 8-cap blocks
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def test_two_process_staging_uneven_parts(tmp_path):
@@ -203,26 +214,9 @@ def test_two_process_staging_uneven_parts(tmp_path):
         files.append(str(f))
         sums.append(s)
 
-    port = str(_free_port())
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", _CHILD, str(p), port, files[0], files[1]],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-        cwd=str(REPO)) for p in (0, 1)]
-    results = {}
-    for p, proc in enumerate(procs):
-        try:
-            out, err = proc.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError(f"process {p} hung (multi-host deadlock?)")
-        assert proc.returncode == 0, f"process {p} failed:\n{err[-2000:]}"
-        assert "ERRPROP_OK" in out, f"process {p} missed error propagation"
-        for line in out.splitlines():
-            if line.startswith("RESULT "):
-                results[p] = json.loads(line[len("RESULT "):])
+    results, outs = _run_two(_CHILD, files[0], files[1])
+    for p in (0, 1):
+        assert "ERRPROP_OK" in outs[p], f"process {p} missed error propagation"
     assert set(results) == {0, 1}
     # both processes observe the identical global stream
     assert results[0]["batches"] == results[1]["batches"]
@@ -269,23 +263,80 @@ def test_two_process_checkpoint_save(tmp_path):
     """checkpoint.save of a multi-host global array: all processes join the
     allgather, only process 0 writes, and the file holds the GLOBAL data."""
     out = str(tmp_path / "ckpt.rec")
-    port = str(_free_port())
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", _CKPT_CHILD, str(p), port, out],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-        cwd=str(REPO)) for p in (0, 1)]
-    outs = {}
-    for p, proc in enumerate(procs):
-        try:
-            o, e = proc.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError(f"checkpoint process {p} hung")
-        assert proc.returncode == 0, f"process {p} failed:\n{e[-2000:]}"
-        outs[p] = o
+    _, outs = _run_two(_CKPT_CHILD, out, label="checkpoint process")
     assert "SAVED pid=0 leaves=2" in outs[0]
     assert "SAVED pid=1 leaves=0" in outs[1]  # non-zero rank writes nothing
     assert "CKPT_OK" in outs[0]
+
+
+_GBDT_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port = int(sys.argv[1]), sys.argv[2]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+
+# both processes deterministically regenerate the GLOBAL dataset, bin with
+# shared global cuts, then contribute only their half of the rows
+halves = [np.random.default_rng(100 + p).uniform(-1, 1, (256, 4))
+          .astype(np.float32) for p in (0, 1)]
+x_all = np.concatenate(halves)
+y_all = ((x_all[:, 0] > 0) ^ (x_all[:, 1] * x_all[:, 2] > 0.1)).astype(np.float32)
+bins_all = np.asarray(QuantileBinner(num_bins=16).fit_transform(x_all))
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+lo, hi = pid * 256, (pid + 1) * 256
+bins_g = jax.make_array_from_process_local_data(sharding, bins_all[lo:hi])
+label_g = jax.make_array_from_process_local_data(sharding, y_all[lo:hi])
+
+model = GBDT(num_features=4, num_trees=2, max_depth=3, num_bins=16,
+             learning_rate=0.5)
+forest = model.fit(bins_g, label_g)
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "feature": np.asarray(forest["feature"]).tolist(),
+    "threshold": np.asarray(forest["threshold"]).tolist(),
+    "leaf": np.round(np.asarray(forest["leaf"]), 5).tolist(),
+    "base": round(float(forest["base"]), 6)}), flush=True)
+"""
+
+
+def test_two_process_gbdt_histogram_allreduce():
+    """GBDT fit over jax.distributed: each process contributes half the
+    rows; the per-level histogram segment-sum crosses the process boundary
+    (Gloo collectives standing in for ICI/DCN), and the forest must equal a
+    single-process fit on the full data — the multi-host lift of the rabit
+    histogram allreduce the reference's tracker brokers."""
+    import sys as _sys
+    _sys.path.insert(0, str(REPO))
+    import numpy as np
+
+    results, _ = _run_two(_GBDT_CHILD, label="gbdt process")
+    assert set(results) == {0, 1}
+    # both processes hold the identical replicated forest
+    assert ({k: v for k, v in results[0].items() if k != "pid"}
+            == {k: v for k, v in results[1].items() if k != "pid"})
+
+    # single-process reference on the concatenated data
+    from dmlc_core_tpu.models import GBDT, QuantileBinner
+    halves = [np.random.default_rng(100 + p).uniform(-1, 1, (256, 4))
+              .astype(np.float32) for p in (0, 1)]
+    x_all = np.concatenate(halves)
+    y_all = ((x_all[:, 0] > 0) ^ (x_all[:, 1] * x_all[:, 2] > 0.1)
+             ).astype(np.float32)
+    import jax.numpy as jnp
+    bins_all = QuantileBinner(num_bins=16).fit_transform(x_all)
+    model = GBDT(num_features=4, num_trees=2, max_depth=3, num_bins=16,
+                 learning_rate=0.5)
+    ref = model.fit(bins_all, jnp.asarray(y_all))
+    assert results[0]["feature"] == np.asarray(ref["feature"]).tolist()
+    assert results[0]["threshold"] == np.asarray(ref["threshold"]).tolist()
+    np.testing.assert_allclose(np.asarray(results[0]["leaf"]),
+                               np.asarray(ref["leaf"]), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(results[0]["base"], float(ref["base"]),
+                               atol=2e-6)
