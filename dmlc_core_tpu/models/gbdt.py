@@ -46,21 +46,39 @@ class QuantileBinner:
     (numpy; the sketch is a once-per-dataset preprocessing step);
     ``transform`` is jittable and maps values to bin codes in
     ``[0, num_bins)`` via searchsorted over the cuts.
+
+    With ``missing_aware=True`` bin 0 is RESERVED for missing values
+    (NaN); present values map to ``[1, num_bins)``.  Pair with
+    ``GBDT(missing_aware=True)``, which then learns a per-node default
+    direction for the missing bin (XGBoost's sparsity-aware splits,
+    the semantics sparse libsvm data wants: absent feature != 0).
     """
 
-    def __init__(self, num_bins: int = 256):
+    def __init__(self, num_bins: int = 256, missing_aware: bool = False):
         if not 2 <= num_bins <= 256:
             raise ValueError("num_bins must be in [2, 256] (uint8 codes)")
+        if missing_aware and num_bins < 3:
+            raise ValueError("missing_aware needs >= 3 bins")
         self.num_bins = num_bins
-        self.cuts: Optional[jax.Array] = None  # f32 [features, num_bins-1]
+        self.missing_aware = missing_aware
+        # f32 [features, value_bins - 1] where value_bins excludes bin 0
+        # in missing_aware mode
+        self.cuts: Optional[jax.Array] = None
 
     def fit(self, sample: np.ndarray) -> "QuantileBinner":
         sample = np.asarray(sample, np.float32)
         if sample.ndim != 2:
             raise ValueError("fit expects [rows, features]")
-        qs = np.linspace(0.0, 1.0, self.num_bins + 1)[1:-1]
-        cuts = np.quantile(sample, qs, axis=0).T  # [features, num_bins-1]
-        # strictly increasing cuts keep searchsorted stable on ties
+        value_bins = self.num_bins - 1 if self.missing_aware else self.num_bins
+        qs = np.linspace(0.0, 1.0, value_bins + 1)[1:-1]
+        import warnings
+        with warnings.catch_warnings():
+            # an all-NaN column (fully-missing feature) is legal input;
+            # nanquantile warns through the warnings module, not errstate
+            warnings.simplefilter("ignore", RuntimeWarning)
+            cuts = np.nanquantile(sample, qs, axis=0).T
+        cuts = np.nan_to_num(cuts)  # all-missing feature: degenerate cuts
+        # non-decreasing cuts keep searchsorted stable on ties
         cuts = np.maximum.accumulate(cuts, axis=1)
         self.cuts = jnp.asarray(cuts)
         return self
@@ -72,6 +90,8 @@ class QuantileBinner:
         codes = jax.vmap(
             lambda col, cut: jnp.searchsorted(cut, col, side="right"),
             in_axes=(1, 0), out_axes=1)(x, self.cuts)
+        if self.missing_aware:
+            codes = jnp.where(jnp.isnan(x), 0, codes + 1)
         return codes.astype(jnp.uint8)
 
     def fit_transform(self, x: np.ndarray) -> jax.Array:
@@ -106,19 +126,28 @@ class GBDT:
 
     The forest is a pytree of flat arrays::
 
-        feature   i32 [num_trees, 2**max_depth - 1]   per internal node
-        threshold i32 [num_trees, 2**max_depth - 1]   go right if bin > thr
-        leaf      f32 [num_trees, 2**max_depth]       shrunken leaf weights
-        base      f32 []                              initial margin
+        feature       i32 [num_trees, 2**max_depth - 1]  per internal node
+        threshold     i32 [num_trees, 2**max_depth - 1]  go right if bin > thr
+        default_right i32 [num_trees, 2**max_depth - 1]  missing-bin routing
+        leaf          f32 [num_trees, 2**max_depth]      shrunken leaf weights
+        base          f32 []                             initial margin
 
     Null splits use ``threshold == num_bins`` (no uint8 code exceeds it).
+
+    With ``missing_aware=True`` (pair with a missing-aware binner), bin 0
+    is the missing bin: split finding evaluates every cut with the missing
+    mass routed left AND right — from the same histograms, no extra pass —
+    and stores the winning direction per node (XGBoost's sparsity-aware
+    split enumeration).  Otherwise bin 0 is an ordinary ordered bin and
+    ``default_right`` stays 0.
     """
 
     def __init__(self, num_features: int, num_trees: int = 20,
                  max_depth: int = 6, num_bins: int = 256,
                  learning_rate: float = 0.3, lambda_: float = 1.0,
                  min_child_weight: float = 1e-3,
-                 objective: str = "logistic"):
+                 objective: str = "logistic",
+                 missing_aware: bool = False):
         if objective not in ("logistic", "squared"):
             raise ValueError(f"unknown objective '{objective}'")
         self.num_features = num_features
@@ -129,6 +158,7 @@ class GBDT:
         self.lambda_ = lambda_
         self.min_child_weight = min_child_weight
         self.objective = objective
+        self.missing_aware = missing_aware
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -140,6 +170,8 @@ class GBDT:
             "feature": jnp.zeros((self.num_trees, n_internal), jnp.int32),
             "threshold": jnp.full((self.num_trees, n_internal),
                                   self.num_bins, jnp.int32),
+            "default_right": jnp.zeros((self.num_trees, n_internal),
+                                       jnp.int32),
             "leaf": jnp.zeros((self.num_trees, 2 ** self.max_depth),
                               jnp.float32),
             "base": jnp.zeros((), jnp.float32),
@@ -147,12 +179,14 @@ class GBDT:
 
     @functools.partial(jax.jit, static_argnums=0)
     def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array
-                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+                               jax.Array]:
         """One tree from per-row (grad, hess); levels unrolled under jit.
 
         bins: u8 [rows, features]; grad/hess: f32 [rows] (weight-scaled,
-        padding rows carry 0 mass).  Returns (feature, threshold, leaf,
-        leaf_rel) where leaf_rel is each row's final leaf index.
+        padding rows carry 0 mass).  Returns (feature, threshold,
+        default_right, leaf, leaf_rel) where leaf_rel is each row's final
+        leaf index.
         """
         F, B = self.num_features, self.num_bins
         rows = bins.shape[0]
@@ -162,6 +196,7 @@ class GBDT:
         node = jnp.zeros(rows, jnp.int32)  # heap id of each row's node
         features = []
         thresholds = []
+        defaults = []
         for depth in range(self.max_depth):
             first = 2 ** depth - 1          # heap id of the level's first node
             n_nodes = 2 ** depth
@@ -184,27 +219,49 @@ class GBDT:
             hl = jnp.cumsum(hist_h, axis=2)
             g_tot = gl[:, :, -1:]
             h_tot = hl[:, :, -1:]
-            gr = g_tot - gl
-            hr = h_tot - hl
             lam = self.lambda_
-            gain = (gl ** 2 / (hl + lam) + gr ** 2 / (hr + lam)
-                    - g_tot ** 2 / (h_tot + lam))          # [nodes, F, B]
-            valid = ((hl >= self.min_child_weight) &
-                     (hr >= self.min_child_weight))
-            gain = jnp.where(valid, gain, -jnp.inf)
-            flat = gain.reshape(n_nodes, F * B)
-            best = jnp.argmax(flat, axis=1)                 # [nodes]
-            best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+
+            def split_gain(gl_, hl_):
+                gr_ = g_tot - gl_
+                hr_ = h_tot - hl_
+                g = (gl_ ** 2 / (hl_ + lam) + gr_ ** 2 / (hr_ + lam)
+                     - g_tot ** 2 / (h_tot + lam))          # [nodes, F, B]
+                ok = ((hl_ >= self.min_child_weight) &
+                      (hr_ >= self.min_child_weight))
+                return jnp.where(ok, g, -jnp.inf)
+
+            if self.missing_aware:
+                # evaluate every cut twice from the same histograms:
+                # missing (bin 0) mass on the left (its natural cumsum
+                # side) vs on the right.  dir axis: 0 = left, 1 = right
+                # (argmax ties resolve to left, the XGBoost default).
+                gain = jnp.stack(
+                    [split_gain(gl, hl),
+                     split_gain(gl - hist_g[:, :, 0:1],
+                                hl - hist_h[:, :, 0:1])], axis=3)
+            else:
+                gain = split_gain(gl, hl)[..., None]        # dir axis size 1
+            flat = gain.reshape(n_nodes, -1)
+            best_flat = jnp.argmax(flat, axis=1)            # [nodes]
+            best_gain = jnp.take_along_axis(flat, best_flat[:, None], 1)[:, 0]
+            n_dir = gain.shape[3]
+            split_d = (best_flat % n_dir).astype(jnp.int32)
+            best = best_flat // n_dir
             split_f = (best // B).astype(jnp.int32)
             split_b = (best % B).astype(jnp.int32)
             null = best_gain <= 0.0                         # no useful split
             split_f = jnp.where(null, 0, split_f)
             split_b = jnp.where(null, B, split_b)           # everything left
+            split_d = jnp.where(null, 0, split_d)
             features.append(split_f)
             thresholds.append(split_b)
+            defaults.append(split_d)
             # route rows: children of heap node n are 2n+1 (left), 2n+2
             row_bin = bins_i[jnp.arange(rows), split_f[rel]]
             go_right = row_bin > split_b[rel]
+            if self.missing_aware:
+                go_right = jnp.where(row_bin == 0,
+                                     split_d[rel] == 1, go_right)
             node = 2 * node + 1 + go_right.astype(jnp.int32)
 
         # leaf weights: -G/(H + lambda) per leaf, shrunken
@@ -217,11 +274,12 @@ class GBDT:
         # leaf_rel doubles as each row's final leaf assignment, so fit()
         # can update margins without re-routing every row through the tree
         return (jnp.concatenate(features), jnp.concatenate(thresholds),
-                leaf, leaf_rel)
+                jnp.concatenate(defaults), leaf, leaf_rel)
 
     @functools.partial(jax.jit, static_argnums=0)
     def _tree_margins(self, feature: jax.Array, threshold: jax.Array,
-                      leaf: jax.Array, bins: jax.Array) -> jax.Array:
+                      default_right: jax.Array, leaf: jax.Array,
+                      bins: jax.Array) -> jax.Array:
         """Route every row down one tree; returns its leaf weight per row."""
         rows = bins.shape[0]
         bins_i = bins.astype(jnp.int32)
@@ -229,7 +287,11 @@ class GBDT:
         for _ in range(self.max_depth):
             f = feature[node]
             t = threshold[node]
-            go_right = bins_i[jnp.arange(rows), f] > t
+            b = bins_i[jnp.arange(rows), f]
+            go_right = b > t
+            if self.missing_aware:
+                go_right = jnp.where(b == 0, default_right[node] == 1,
+                                     go_right)
             node = 2 * node + 1 + go_right.astype(jnp.int32)
         return leaf[node - (2 ** self.max_depth - 1)]
 
@@ -258,16 +320,18 @@ class GBDT:
         params["base"] = base.astype(jnp.float32)
 
         margin = jnp.full(label.shape, params["base"])
-        feats, thrs, leaves = [], [], []
+        feats, thrs, dirs, leaves = [], [], [], []
         for _ in range(self.num_trees):
             g, h = self._grad_hess(margin, label)
-            f, t, leaf, leaf_rel = self._build_tree(bins, g * w, h * w)
+            f, t, d, leaf, leaf_rel = self._build_tree(bins, g * w, h * w)
             margin = margin + leaf[leaf_rel]
             feats.append(f)
             thrs.append(t)
+            dirs.append(d)
             leaves.append(leaf)
         params["feature"] = jnp.stack(feats)
         params["threshold"] = jnp.stack(thrs)
+        params["default_right"] = jnp.stack(dirs)
         params["leaf"] = jnp.stack(leaves)
         return params
 
@@ -276,6 +340,7 @@ class GBDT:
         def body(i, m):
             return m + self._tree_margins(params["feature"][i],
                                           params["threshold"][i],
+                                          params["default_right"][i],
                                           params["leaf"][i], bins)
         init = jnp.full(bins.shape[:1], params["base"])
         return jax.lax.fori_loop(0, self.num_trees, body, init)

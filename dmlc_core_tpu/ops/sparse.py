@@ -58,3 +58,24 @@ def csr_to_dense(index: jax.Array, value: jax.Array, row_id: jax.Array,
     """
     out = jnp.zeros((num_rows, num_features), value.dtype)
     return out.at[row_id, index].add(value, mode="drop")
+
+
+def csr_to_dense_missing(index: jax.Array, value: jax.Array,
+                         row_id: jax.Array, num_rows: int,
+                         num_features: int) -> jax.Array:
+    """Densify with NaN for ABSENT cells instead of 0 — the sparse-data
+    semantics XGBoost uses (absent feature != zero-valued feature).  Feed
+    the result to a ``missing_aware`` QuantileBinner/GBDT pair.
+
+    Note the staging pad convention (value == 0 lanes) cannot mark
+    presence, so a real stored 0 at a padding lane's (row, col) target is
+    indistinguishable from padding; stage with nnz-exact buckets or accept
+    that explicit zeros in the data behave as missing.
+    """
+    # one fused two-lane scatter (value, presence): the (row_id, index)
+    # key arrays are read once, matching the histogram-build pattern
+    lanes = jnp.stack([value.astype(jnp.float32),
+                       (value != 0).astype(jnp.float32)], axis=-1)
+    acc = jnp.zeros((num_rows, num_features, 2), jnp.float32
+                    ).at[row_id, index].add(lanes, mode="drop")
+    return jnp.where(acc[..., 1] > 0, acc[..., 0], jnp.nan)
