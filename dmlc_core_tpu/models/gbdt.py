@@ -69,6 +69,13 @@ class QuantileBinner:
         sample = np.asarray(sample, np.float32)
         if sample.ndim != 2:
             raise ValueError("fit expects [rows, features]")
+        if not self.missing_aware and np.isnan(sample).any():
+            # without the reserved bin, searchsorted would silently map NaN
+            # to the TOP bin — a plausible-looking but wrong model
+            raise ValueError(
+                "sample contains NaN but missing_aware=False; construct "
+                "QuantileBinner(..., missing_aware=True) (and pair it with "
+                "GBDT(missing_aware=True)) to model missing values")
         value_bins = self.num_bins - 1 if self.missing_aware else self.num_bins
         qs = np.linspace(0.0, 1.0, value_bins + 1)[1:-1]
         import warnings
@@ -337,10 +344,16 @@ class GBDT:
 
     @functools.partial(jax.jit, static_argnums=0)
     def margins(self, params: dict, bins: jax.Array) -> jax.Array:
+        # forests checkpointed before default_right existed predict as
+        # missing-left everywhere (the exact pre-feature behavior)
+        default_right = params.get("default_right")
+        if default_right is None:
+            default_right = jnp.zeros_like(params["feature"])
+
         def body(i, m):
             return m + self._tree_margins(params["feature"][i],
                                           params["threshold"][i],
-                                          params["default_right"][i],
+                                          default_right[i],
                                           params["leaf"][i], bins)
         init = jnp.full(bins.shape[:1], params["base"])
         return jax.lax.fori_loop(0, self.num_trees, body, init)

@@ -56,6 +56,10 @@ def main() -> int:
     ap.add_argument("--batch-size", type=int, default=16384)
     ap.add_argument("--shard", action="store_true",
                     help="row-shard over all local devices (data parallel)")
+    ap.add_argument("--missing", action="store_true",
+                    help="sparsity-aware mode: absent libsvm features are "
+                         "MISSING (NaN -> reserved bin, learned per-node "
+                         "default direction), not zeros")
     args = ap.parse_args()
 
     import jax
@@ -64,7 +68,7 @@ def main() -> int:
 
     from dmlc_core_tpu.data import DeviceStagingIter
     from dmlc_core_tpu.models import GBDT, QuantileBinner
-    from dmlc_core_tpu.ops.sparse import csr_to_dense
+    from dmlc_core_tpu.ops.sparse import csr_to_dense, csr_to_dense_missing
 
     data = args.data
     if data is None:
@@ -77,7 +81,8 @@ def main() -> int:
     t0 = time.monotonic()
     it = DeviceStagingIter(data, batch_size=args.batch_size)
     dense_parts, label_parts = [], []
-    densify = jax.jit(csr_to_dense, static_argnums=(3, 4))
+    densify = jax.jit(csr_to_dense_missing if args.missing else csr_to_dense,
+                      static_argnums=(3, 4))
     for batch in it:
         d = densify(batch.index, batch.value, batch.row_ids(),
                     batch.batch_size, args.dim)
@@ -90,12 +95,12 @@ def main() -> int:
     print(f"staged+densified {x.shape[0]} rows x {args.dim} features "
           f"in {t_stage:.2f}s", flush=True)
 
-    binner = QuantileBinner(num_bins=args.bins)
+    binner = QuantileBinner(num_bins=args.bins, missing_aware=args.missing)
     bins_host = np.asarray(binner.fit_transform(x))
 
     model = GBDT(num_features=args.dim, num_trees=args.trees,
                  max_depth=args.depth, num_bins=args.bins,
-                 learning_rate=0.4)
+                 learning_rate=0.4, missing_aware=args.missing)
 
     if args.shard:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
