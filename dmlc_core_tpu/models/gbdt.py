@@ -104,6 +104,70 @@ class QuantileBinner:
     def fit_transform(self, x: np.ndarray) -> jax.Array:
         return self.fit(x).transform(jnp.asarray(x, jnp.float32))
 
+    # ---- sparse (COO-entry) surface -----------------------------------------
+
+    def fit_sparse(self, index: np.ndarray, value: np.ndarray,
+                   num_features: int) -> "QuantileBinner":
+        """Per-feature quantile cuts from a COO sample (host sketch), the
+        sparse analogue of ``fit`` — entries of feature f are that
+        feature's PRESENT values.  Requires ``missing_aware=True`` (absent
+        cells are missing by construction in sparse data)."""
+        if not self.missing_aware:
+            raise ValueError("fit_sparse requires missing_aware=True "
+                             "(absent cells are missing, not 0)")
+        index = np.asarray(index, np.int64)
+        value = np.asarray(value, np.float32)
+        # NaN entries are malformed COO (missing = absent entry); excluding
+        # them from the sketch mirrors the dense path's nanquantile
+        keep = ~np.isnan(value)
+        index, value = index[keep], value[keep]
+        order = np.lexsort((value, index))
+        idx_s, val_s = index[order], value[order]
+        feats = np.arange(num_features)
+        starts = np.searchsorted(idx_s, feats)
+        ends = np.searchsorted(idx_s, feats + 1)
+        lens = ends - starts
+        value_bins = self.num_bins - 1
+        qs = np.linspace(0.0, 1.0, value_bins + 1)[1:-1]
+        # nearest-rank quantiles per feature, fully vectorized over (F, q)
+        pos = starts[:, None] + np.round(
+            qs[None, :] * np.maximum(lens[:, None] - 1, 0)).astype(np.int64)
+        pos = np.minimum(pos, np.maximum(ends[:, None] - 1, starts[:, None]))
+        # empty trailing features have starts == ends == len(val_s); keep
+        # the gather in bounds (their cuts are overwritten below anyway)
+        pos = np.clip(pos, 0, max(val_s.size - 1, 0))
+        cuts = (val_s[pos] if val_s.size
+                else np.zeros((num_features, qs.size), np.float32))
+        cuts[lens == 0] = 0.0  # feature never present: degenerate cuts
+        self.cuts = jnp.asarray(np.maximum.accumulate(cuts, axis=1))
+        return self
+
+    def transform_entries(self, index: jax.Array, value: jax.Array
+                          ) -> jax.Array:
+        """Bin COO entries: code of ``value[k]`` under feature
+        ``index[k]``'s cuts, in ``[1, num_bins)`` (0 stays reserved for
+        missing = absent).  Jittable: a vectorized binary search —
+        ``ceil(log2(C+1))`` rounds of one gather each, instead of
+        materializing the [nnz, C] per-entry cut matrix."""
+        if not self.missing_aware:
+            raise ValueError("transform_entries requires missing_aware=True")
+        if self.cuts is None:
+            raise RuntimeError("transform_entries before fit")
+        cuts = self.cuts
+        C = cuts.shape[1]
+        fi = index.astype(jnp.int32)
+        v = value.astype(jnp.float32)
+        lo = jnp.zeros(v.shape, jnp.int32)
+        hi = jnp.full(v.shape, C, jnp.int32)
+        for _ in range(max(1, int(np.ceil(np.log2(C + 1))))):
+            mid = (lo + hi) // 2
+            cut = cuts[fi, jnp.minimum(mid, C - 1)]
+            go = (cut <= v) & (mid < hi)  # searchsorted side="right"
+            lo = jnp.where(go, mid + 1, lo)
+            hi = jnp.where(go, hi, mid)
+        # NaN entries read as missing (code 0), matching the dense transform
+        return jnp.where(jnp.isnan(v), 0, lo + 1).astype(jnp.int32)
+
 
 from .common import logistic_nll
 
@@ -184,6 +248,56 @@ class GBDT:
             "base": jnp.zeros((), jnp.float32),
         }
 
+    def _pick_splits(self, gain: jax.Array):
+        """Flat argmax over a [nodes, F, B, n_dir] gain array plus
+        null-split encoding; shared by the dense and sparse builders.
+        Returns (split_f, split_b, split_d)."""
+        n_nodes = gain.shape[0]
+        B = self.num_bins
+        n_dir = gain.shape[3]
+        flat = gain.reshape(n_nodes, -1)
+        best_flat = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best_flat[:, None], 1)[:, 0]
+        split_d = (best_flat % n_dir).astype(jnp.int32)
+        best = best_flat // n_dir
+        split_f = (best // B).astype(jnp.int32)
+        split_b = (best % B).astype(jnp.int32)
+        null = best_gain <= 0.0
+        return (jnp.where(null, 0, split_f),
+                jnp.where(null, B, split_b),   # everything routes left
+                jnp.where(null, 0, split_d))
+
+    def _boost(self, label: jax.Array, w: jax.Array, build_tree) -> dict:
+        """Shared boosting driver (base prior, tree loop, stacking) for the
+        dense (`fit`) and sparse-native (`fit_batch`) input paths.
+        ``build_tree(grad, hess)`` returns `_build_tree`'s 5-tuple."""
+        params = self.init()
+        sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
+        if self.objective == "logistic":
+            # base margin from the weighted prior, clamped away from 0/1
+            p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0)) / sum_w,
+                         1e-6, 1 - 1e-6)
+            base = jnp.log(p / (1 - p))
+        else:
+            base = jnp.sum(label * w) / sum_w
+        params["base"] = base.astype(jnp.float32)
+
+        margin = jnp.full(label.shape, params["base"])
+        feats, thrs, dirs, leaves = [], [], [], []
+        for _ in range(self.num_trees):
+            g, h = self._grad_hess(margin, label)
+            f, t, d, leaf, leaf_rel = build_tree(g * w, h * w)
+            margin = margin + leaf[leaf_rel]
+            feats.append(f)
+            thrs.append(t)
+            dirs.append(d)
+            leaves.append(leaf)
+        params["feature"] = jnp.stack(feats)
+        params["threshold"] = jnp.stack(thrs)
+        params["default_right"] = jnp.stack(dirs)
+        params["leaf"] = jnp.stack(leaves)
+        return params
+
     @functools.partial(jax.jit, static_argnums=0)
     def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array
                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
@@ -248,18 +362,7 @@ class GBDT:
                                 hl - hist_h[:, :, 0:1])], axis=3)
             else:
                 gain = split_gain(gl, hl)[..., None]        # dir axis size 1
-            flat = gain.reshape(n_nodes, -1)
-            best_flat = jnp.argmax(flat, axis=1)            # [nodes]
-            best_gain = jnp.take_along_axis(flat, best_flat[:, None], 1)[:, 0]
-            n_dir = gain.shape[3]
-            split_d = (best_flat % n_dir).astype(jnp.int32)
-            best = best_flat // n_dir
-            split_f = (best // B).astype(jnp.int32)
-            split_b = (best % B).astype(jnp.int32)
-            null = best_gain <= 0.0                         # no useful split
-            split_f = jnp.where(null, 0, split_f)
-            split_b = jnp.where(null, B, split_b)           # everything left
-            split_d = jnp.where(null, 0, split_d)
+            split_f, split_b, split_d = self._pick_splits(gain)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -302,6 +405,107 @@ class GBDT:
             node = 2 * node + 1 + go_right.astype(jnp.int32)
         return leaf[node - (2 ** self.max_depth - 1)]
 
+    @functools.partial(jax.jit, static_argnums=0)
+    def _build_tree_sparse(self, row_id: jax.Array, findex: jax.Array,
+                           ebin: jax.Array, emask: jax.Array,
+                           grad: jax.Array, hess: jax.Array):
+        """One tree from COO entries — O(nnz) histogram work per level.
+
+        The sparse formulation of `_build_tree`: present entries scatter
+        their row's (grad, hess) into [nodes, features, bins] keyed by
+        (node(row), feature, bin); each (node, feature)'s missing mass is
+        the node total minus its present sum, and the dual-direction gain
+        machinery is shared with the dense missing-aware path.  Requires
+        ``missing_aware=True`` bins from ``transform_entries`` (all codes
+        >= 1; bin 0 stays empty).
+
+        row_id/findex/ebin/emask: [nnz] (emask 0 for padding lanes);
+        grad/hess: [rows] weight-scaled.  Returns the same 5-tuple as
+        `_build_tree`.
+        """
+        F, B = self.num_features, self.num_bins
+        rows = grad.shape[0]
+        lam = self.lambda_
+        rid = row_id.astype(jnp.int32)
+        fi = findex.astype(jnp.int32)
+        # entry-level (grad, hess) lanes; padding lanes carry 0 mass
+        gh_k = (jnp.stack([grad, hess], axis=-1)[rid]
+                * emask.astype(jnp.float32)[:, None])
+        gh_row = jnp.stack([grad, hess], axis=-1)          # [rows, 2]
+
+        node = jnp.zeros(rows, jnp.int32)
+        features, thresholds, defaults = [], [], []
+        for depth in range(self.max_depth):
+            first = 2 ** depth - 1
+            n_nodes = 2 ** depth
+            rel = node - first
+            keys = (rel[rid] * F + fi) * B + ebin
+            hist = jax.ops.segment_sum(
+                gh_k, keys, num_segments=n_nodes * F * B
+            ).reshape(n_nodes, F, B, 2)                     # bin 0 is empty
+            gh_node = jax.ops.segment_sum(gh_row, rel,
+                                          num_segments=n_nodes)  # [n, 2]
+            miss = gh_node[:, None, :] - jnp.sum(hist, axis=2)   # [n, F, 2]
+            gl = jnp.cumsum(hist, axis=2)                   # present mass
+            g_tot = gh_node[:, 0][:, None, None]            # [n, 1, 1]
+            h_tot = gh_node[:, 1][:, None, None]
+
+            def split_gain(gl_, hl_):
+                gr_ = g_tot - gl_
+                hr_ = h_tot - hl_
+                g = (gl_ ** 2 / (hl_ + lam) + gr_ ** 2 / (hr_ + lam)
+                     - g_tot ** 2 / (h_tot + lam))
+                ok = ((hl_ >= self.min_child_weight) &
+                      (hr_ >= self.min_child_weight))
+                return jnp.where(ok, g, -jnp.inf)
+
+            # dir 0: missing left (GL gains the missing mass); dir 1: right
+            gain = jnp.stack(
+                [split_gain(gl[..., 0] + miss[:, :, None, 0],
+                            gl[..., 1] + miss[:, :, None, 1]),
+                 split_gain(gl[..., 0], gl[..., 1])], axis=3)
+            split_f, split_b, split_d = self._pick_splits(gain)
+            features.append(split_f)
+            thresholds.append(split_b)
+            defaults.append(split_d)
+            # routing: recover each row's bin for its node's split feature
+            # (segment-max over matching entries; 0 = no entry = missing)
+            match = (fi == split_f[rel][rid]) & (emask > 0)
+            # clamp: segment_max's empty-segment identity is INT_MIN, and a
+            # row with no entries at all must read as missing (0)
+            row_bin = jnp.maximum(jax.ops.segment_max(
+                jnp.where(match, ebin, 0), rid, num_segments=rows), 0)
+            go_right = jnp.where(row_bin == 0, split_d[rel] == 1,
+                                 row_bin > split_b[rel])
+            node = 2 * node + 1 + go_right.astype(jnp.int32)
+
+        n_leaves = 2 ** self.max_depth
+        leaf_rel = node - (n_leaves - 1)
+        gh_leaf = jax.ops.segment_sum(gh_row, leaf_rel,
+                                      num_segments=n_leaves)
+        leaf = (-self.learning_rate * gh_leaf[:, 0]
+                / (gh_leaf[:, 1] + self.lambda_))
+        return (jnp.concatenate(features), jnp.concatenate(thresholds),
+                jnp.concatenate(defaults), leaf, leaf_rel)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _tree_margins_sparse(self, feature, threshold, default_right, leaf,
+                             row_id, findex, ebin, emask, rows_arr):
+        """Route rows via COO entries (prediction-side of the sparse path)."""
+        rows = rows_arr.shape[0]
+        rid = row_id.astype(jnp.int32)
+        fi = findex.astype(jnp.int32)
+        node = jnp.zeros(rows, jnp.int32)
+        for _ in range(self.max_depth):
+            f = feature[node]
+            match = (fi == f[rid]) & (emask > 0)
+            row_bin = jnp.maximum(jax.ops.segment_max(
+                jnp.where(match, ebin, 0), rid, num_segments=rows), 0)
+            go_right = jnp.where(row_bin == 0, default_right[node] == 1,
+                                 row_bin > threshold[node])
+            node = 2 * node + 1 + go_right.astype(jnp.int32)
+        return leaf[node - (2 ** self.max_depth - 1)]
+
     # ---- public API ---------------------------------------------------------
 
     def fit(self, bins: jax.Array, label: jax.Array,
@@ -315,32 +519,70 @@ class GBDT:
         label = label.astype(jnp.float32)
         w = (jnp.ones_like(label) if weight is None
              else weight.astype(jnp.float32))
-        params = self.init()
-        sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
-        if self.objective == "logistic":
-            # base margin from the weighted prior, clamped away from 0/1
-            p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0)) / sum_w,
-                         1e-6, 1 - 1e-6)
-            base = jnp.log(p / (1 - p))
-        else:
-            base = jnp.sum(label * w) / sum_w
-        params["base"] = base.astype(jnp.float32)
+        return self._boost(label, w,
+                           lambda g, h: self._build_tree(bins, g, h))
 
-        margin = jnp.full(label.shape, params["base"])
-        feats, thrs, dirs, leaves = [], [], [], []
-        for _ in range(self.num_trees):
-            g, h = self._grad_hess(margin, label)
-            f, t, d, leaf, leaf_rel = self._build_tree(bins, g * w, h * w)
-            margin = margin + leaf[leaf_rel]
-            feats.append(f)
-            thrs.append(t)
-            dirs.append(d)
-            leaves.append(leaf)
-        params["feature"] = jnp.stack(feats)
-        params["threshold"] = jnp.stack(thrs)
-        params["default_right"] = jnp.stack(dirs)
-        params["leaf"] = jnp.stack(leaves)
-        return params
+    @staticmethod
+    def _entry_arrays(batch):
+        """(row_id, findex, emask) for a PaddedBatch.
+
+        Entries with ``value == 0`` are masked as missing — this covers
+        trailing padding lanes AND the mid-array pad gaps of multi-host
+        global batches (staging.py's PaddedBatch docstring), and matches
+        ``csr_to_dense_missing``'s documented semantics: under the
+        value-0 padding convention a stored explicit zero is
+        indistinguishable from padding, so both input paths treat it as
+        missing."""
+        emask = batch.value != 0
+        return batch.row_ids(), batch.index, emask
+
+    def fit_batch(self, batch, binner: QuantileBinner,
+                  weight: Optional[jax.Array] = None) -> dict:
+        """Train directly on a staged CSR ``PaddedBatch`` — no densify.
+
+        The sparse-native XGBoost-hist path: per-entry bins
+        (``binner.transform_entries``), O(nnz) histogram scatters per tree
+        level, and absent cells handled as missing via the learned default
+        directions.  Requires ``missing_aware=True`` on both this model
+        and the binner.  ``weight`` defaults to ``batch.weight`` (padding
+        rows already carry 0 there).  Entries with an explicit stored 0
+        are treated as missing — the value-0 padding convention makes them
+        indistinguishable from pad lanes, and ``csr_to_dense_missing``
+        (the dense route) documents the same semantics, so the two paths
+        build identical forests on any input.
+        """
+        if not (self.missing_aware and binner.missing_aware):
+            raise ValueError("fit_batch requires missing_aware=True on "
+                             "both the GBDT and the QuantileBinner")
+        label = batch.label.astype(jnp.float32)
+        w = (batch.weight if weight is None else weight).astype(jnp.float32)
+        row_id, findex, emask = self._entry_arrays(batch)
+        ebin = binner.transform_entries(findex, batch.value)
+        return self._boost(
+            label, w,
+            lambda g, h: self._build_tree_sparse(row_id, findex, ebin,
+                                                 emask, g, h))
+
+    def margins_batch(self, params: dict, batch,
+                      binner: QuantileBinner) -> jax.Array:
+        """Margins over a staged CSR batch (sparse-native routing)."""
+        row_id, findex, emask = self._entry_arrays(batch)
+        ebin = binner.transform_entries(findex, batch.value)
+        default_right = params.get("default_right")
+        if default_right is None:
+            default_right = jnp.zeros_like(params["feature"])
+        m = jnp.full(batch.label.shape, params["base"])
+        for i in range(self.num_trees):
+            m = m + self._tree_margins_sparse(
+                params["feature"][i], params["threshold"][i],
+                default_right[i], params["leaf"][i],
+                row_id, findex, ebin, emask, batch.label)
+        return m
+
+    def predict_batch(self, params: dict, batch,
+                      binner: QuantileBinner) -> jax.Array:
+        m = self.margins_batch(params, batch, binner)
+        return jax.nn.sigmoid(m) if self.objective == "logistic" else m
 
     @functools.partial(jax.jit, static_argnums=0)
     def margins(self, params: dict, bins: jax.Array) -> jax.Array:

@@ -248,3 +248,178 @@ def test_csr_to_dense_missing_nan_for_absent():
     out = np.asarray(csr_to_dense_missing(index, value, row_id, 2, 3))
     assert out[0, 0] == 1.5 and out[0, 2] == -2.0 and out[1, 1] == 3.0
     assert np.isnan(out[0, 1]) and np.isnan(out[1, 0]) and np.isnan(out[1, 2])
+
+
+def _random_padded_batch(rng, rows, feats, density=0.4):
+    """Hand-built single-host PaddedBatch with a few padding lanes."""
+    from dmlc_core_tpu.data.staging import PaddedBatch
+    entries = []
+    for r in range(rows):
+        present = np.flatnonzero(rng.random(feats) < density)
+        for f in present:
+            entries.append((r, f, float(rng.uniform(-2, 2)) or 0.5))
+    row_id = np.array([e[0] for e in entries], np.int32)
+    index = np.array([e[1] for e in entries], np.int32)
+    value = np.array([e[2] for e in entries], np.float32)
+    counts = np.bincount(row_id, minlength=rows)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    nnz_pad = len(entries) + 7  # trailing padding lanes
+    pad = nnz_pad - len(entries)
+    label = rng.integers(0, 2, rows).astype(np.float32)
+    return PaddedBatch(
+        label=jnp.asarray(label),
+        weight=jnp.ones(rows, jnp.float32),
+        row_ptr=jnp.asarray(row_ptr),
+        index=jnp.asarray(np.pad(index, (0, pad))),
+        value=jnp.asarray(np.pad(value, (0, pad))),
+        num_rows=jnp.asarray(np.int32(rows)),
+        field=None,
+    ), row_id, index, value
+
+
+def test_transform_entries_matches_dense_transform():
+    """The per-entry binary search must agree exactly with the dense
+    searchsorted on present cells."""
+    from dmlc_core_tpu.ops.sparse import csr_to_dense_missing
+    rng = np.random.default_rng(10)
+    batch, row_id, index, value = _random_padded_batch(rng, 64, 6)
+    dense = np.asarray(csr_to_dense_missing(
+        jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id), 64, 6))
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    codes_dense = np.asarray(binner.fit(dense).transform(jnp.asarray(dense)))
+    ebin = np.asarray(binner.transform_entries(jnp.asarray(index),
+                                               jnp.asarray(value)))
+    for k in range(len(index)):
+        assert ebin[k] == codes_dense[row_id[k], index[k]], (
+            k, ebin[k], codes_dense[row_id[k], index[k]])
+    assert (ebin >= 1).all()
+
+
+def test_sparse_fit_batch_matches_dense_missing_aware_fit():
+    """fit_batch (O(nnz) COO histograms) must build the same forest as the
+    dense missing-aware path on the equivalent NaN-densified matrix."""
+    from dmlc_core_tpu.ops.sparse import csr_to_dense_missing
+    rng = np.random.default_rng(11)
+    rows, feats = 512, 5
+    batch, row_id, index, value = _random_padded_batch(rng, rows, feats)
+    # label depends on presence + value of feature 0: both split kinds occur
+    dense = np.asarray(csr_to_dense_missing(
+        jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id),
+        rows, feats))
+    y = (np.where(np.isnan(dense[:, 0]), 1.0, dense[:, 0] > 0.3)
+         ).astype(np.float32)
+    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
+                                  ("weight", "row_ptr", "index", "value",
+                                   "num_rows", "field")},
+                               "label": jnp.asarray(y)})
+
+    binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
+    model = GBDT(num_features=feats, num_trees=3, max_depth=3, num_bins=16,
+                 learning_rate=0.5, missing_aware=True)
+
+    p_dense = model.fit(binner.transform(jnp.asarray(dense)), jnp.asarray(y))
+    p_sparse = model.fit_batch(batch, binner)
+
+    for k in ("feature", "threshold", "default_right"):
+        np.testing.assert_array_equal(np.asarray(p_dense[k]),
+                                      np.asarray(p_sparse[k]), err_msg=k)
+    np.testing.assert_allclose(np.asarray(p_dense["leaf"]),
+                               np.asarray(p_sparse["leaf"]),
+                               rtol=1e-4, atol=1e-6)
+    # prediction parity between the two routing implementations
+    pred_d = np.asarray(model.predict(p_dense,
+                                      binner.transform(jnp.asarray(dense))))
+    pred_s = np.asarray(model.predict_batch(p_sparse, batch, binner))
+    np.testing.assert_allclose(pred_d, pred_s, rtol=1e-4, atol=1e-6)
+    # and it actually learned the rule
+    acc = float(np.mean((pred_s > 0.5) == (y > 0.5)))
+    assert acc > 0.9, acc
+
+
+def test_sparse_binner_fit_sparse_quantiles():
+    """fit_sparse cuts come from per-feature present values only."""
+    rng = np.random.default_rng(12)
+    index = np.repeat(np.arange(3), 200)
+    value = np.concatenate([rng.uniform(0, 1, 200),
+                            rng.uniform(10, 11, 200),
+                            rng.uniform(-5, -4, 200)]).astype(np.float32)
+    binner = QuantileBinner(num_bins=8, missing_aware=True)
+    binner.fit_sparse(index, value, num_features=3)
+    cuts = np.asarray(binner.cuts)
+    assert cuts.shape == (3, 6)
+    assert (cuts[0] >= 0).all() and (cuts[0] <= 1).all()
+    assert (cuts[1] >= 10).all() and (cuts[1] <= 11).all()
+    assert (cuts[2] >= -5).all() and (cuts[2] <= -4).all()
+    # entries bin into well-spread codes under their own feature's cuts
+    ebin = np.asarray(binner.transform_entries(jnp.asarray(index),
+                                               jnp.asarray(value)))
+    for f in range(3):
+        codes = ebin[index == f]
+        assert codes.min() >= 1 and codes.max() <= 7
+        assert len(np.unique(codes)) >= 5
+
+
+def test_fit_sparse_trailing_empty_features_and_nan():
+    """Features past the sketch's max index must not crash fit_sparse, and
+    NaN handling matches the dense surface (excluded from cuts; entries
+    binned as missing)."""
+    binner = QuantileBinner(num_bins=8, missing_aware=True)
+    binner.fit_sparse(np.array([0, 0, 0]), np.array([1.0, 2.0, 3.0]),
+                      num_features=3)  # features 1,2 have no entries
+    cuts = np.asarray(binner.cuts)
+    assert cuts.shape == (3, 6)
+    assert (cuts[1] == 0).all() and (cuts[2] == 0).all()
+    # NaN in the sketch is excluded, not propagated into cuts
+    binner2 = QuantileBinner(num_bins=8, missing_aware=True)
+    binner2.fit_sparse(np.array([0, 0, 0, 0]),
+                       np.array([1.0, np.nan, 2.0, 3.0]), num_features=1)
+    assert np.isfinite(np.asarray(binner2.cuts)).all()
+    # NaN entries bin to 0 (missing), like the dense transform
+    ebin = np.asarray(binner2.transform_entries(
+        jnp.asarray([0, 0], jnp.int32),
+        jnp.asarray([np.nan, 2.0], jnp.float32)))
+    assert ebin[0] == 0 and ebin[1] >= 1
+
+
+def test_explicit_zero_entry_is_missing_on_both_paths():
+    """A stored value-0 entry is indistinguishable from padding, so both
+    the dense (csr_to_dense_missing) and sparse (fit_batch) routes treat
+    it as missing — and stay forest-identical."""
+    from dmlc_core_tpu.data.staging import PaddedBatch
+    from dmlc_core_tpu.ops.sparse import csr_to_dense_missing
+    rng = np.random.default_rng(13)
+    rows = 256
+    # feature 0: present nonzero for even rows, explicit 0 for rows % 4 == 1
+    entries = []
+    for r in range(rows):
+        if r % 2 == 0:
+            entries.append((r, 0, float(rng.uniform(0.5, 2.0))))
+        elif r % 4 == 1:
+            entries.append((r, 0, 0.0))   # explicit zero
+        entries.append((r, 1, float(rng.uniform(-1, 1)) or 0.25))
+    row_id = np.array([e[0] for e in entries], np.int32)
+    index = np.array([e[1] for e in entries], np.int32)
+    value = np.array([e[2] for e in entries], np.float32)
+    counts = np.bincount(row_id, minlength=rows)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    y = (np.arange(rows) % 2 == 0).astype(np.float32)
+    batch = PaddedBatch(label=jnp.asarray(y),
+                        weight=jnp.ones(rows, jnp.float32),
+                        row_ptr=jnp.asarray(row_ptr),
+                        index=jnp.asarray(index),
+                        value=jnp.asarray(value),
+                        num_rows=jnp.asarray(np.int32(rows)), field=None)
+    dense = np.asarray(csr_to_dense_missing(
+        jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id), rows, 2))
+    assert np.isnan(dense[1, 0]), "explicit zero must densify to NaN"
+    binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
+    model = GBDT(num_features=2, num_trees=2, max_depth=2, num_bins=16,
+                 learning_rate=0.5, missing_aware=True)
+    p_dense = model.fit(binner.transform(jnp.asarray(dense)), jnp.asarray(y))
+    p_sparse = model.fit_batch(batch, binner)
+    for k in ("feature", "threshold", "default_right"):
+        np.testing.assert_array_equal(np.asarray(p_dense[k]),
+                                      np.asarray(p_sparse[k]), err_msg=k)
+    np.testing.assert_allclose(np.asarray(p_dense["leaf"]),
+                               np.asarray(p_sparse["leaf"]),
+                               rtol=1e-4, atol=1e-6)
