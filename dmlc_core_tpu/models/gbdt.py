@@ -468,15 +468,9 @@ class GBDT:
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
-            # routing: recover each row's bin for its node's split feature
-            # (segment-max over matching entries; 0 = no entry = missing)
-            match = (fi == split_f[rel][rid]) & (emask > 0)
-            # clamp: segment_max's empty-segment identity is INT_MIN, and a
-            # row with no entries at all must read as missing (0)
-            row_bin = jnp.maximum(jax.ops.segment_max(
-                jnp.where(match, ebin, 0), rid, num_segments=rows), 0)
-            go_right = jnp.where(row_bin == 0, split_d[rel] == 1,
-                                 row_bin > split_b[rel])
+            go_right = self._route_sparse(fi, ebin, emask, rid,
+                                          split_f[rel], split_b[rel],
+                                          split_d[rel], rows)
             node = 2 * node + 1 + go_right.astype(jnp.int32)
 
         n_leaves = 2 ** self.max_depth
@@ -488,23 +482,39 @@ class GBDT:
         return (jnp.concatenate(features), jnp.concatenate(thresholds),
                 jnp.concatenate(defaults), leaf, leaf_rel)
 
+    @staticmethod
+    def _route_sparse(fi, ebin, emask, rid, row_feat, row_thr, row_dir,
+                      rows: int):
+        """One level of sparse routing, shared by training and inference:
+        recover each row's bin for its per-row split feature (segment-max
+        over matching entries; 0 = no matching entry = missing) and apply
+        the threshold / default-direction rule.  The max with 0 clamps
+        segment_max's empty-segment identity (INT_MIN) for rows with no
+        entries at all."""
+        match = (fi == row_feat[rid]) & emask
+        row_bin = jnp.maximum(jax.ops.segment_max(
+            jnp.where(match, ebin, 0), rid, num_segments=rows), 0)
+        return jnp.where(row_bin == 0, row_dir == 1, row_bin > row_thr)
+
     @functools.partial(jax.jit, static_argnums=0)
-    def _tree_margins_sparse(self, feature, threshold, default_right, leaf,
-                             row_id, findex, ebin, emask, rows_arr):
-        """Route rows via COO entries (prediction-side of the sparse path)."""
-        rows = rows_arr.shape[0]
+    def _margins_sparse(self, feature, threshold, default_right, leaf,
+                        base, row_id, findex, ebin, emask):
+        """All-trees sparse margins in ONE jitted fori_loop (the sparse
+        mirror of `margins`; one dispatch, XLA-fusable)."""
+        rows = base.shape[0]
         rid = row_id.astype(jnp.int32)
         fi = findex.astype(jnp.int32)
-        node = jnp.zeros(rows, jnp.int32)
-        for _ in range(self.max_depth):
-            f = feature[node]
-            match = (fi == f[rid]) & (emask > 0)
-            row_bin = jnp.maximum(jax.ops.segment_max(
-                jnp.where(match, ebin, 0), rid, num_segments=rows), 0)
-            go_right = jnp.where(row_bin == 0, default_right[node] == 1,
-                                 row_bin > threshold[node])
-            node = 2 * node + 1 + go_right.astype(jnp.int32)
-        return leaf[node - (2 ** self.max_depth - 1)]
+
+        def one_tree(i, m):
+            node = jnp.zeros(rows, jnp.int32)
+            for _ in range(self.max_depth):
+                go_right = self._route_sparse(
+                    fi, ebin, emask, rid, feature[i][node],
+                    threshold[i][node], default_right[i][node], rows)
+                node = 2 * node + 1 + go_right.astype(jnp.int32)
+            return m + leaf[i][node - (2 ** self.max_depth - 1)]
+
+        return jax.lax.fori_loop(0, self.num_trees, one_tree, base)
 
     # ---- public API ---------------------------------------------------------
 
@@ -532,8 +542,11 @@ class GBDT:
         ``csr_to_dense_missing``'s documented semantics: under the
         value-0 padding convention a stored explicit zero is
         indistinguishable from padding, so both input paths treat it as
-        missing."""
-        emask = batch.value != 0
+        missing.  NaN entries are likewise masked (the dense route
+        densifies them to NaN = missing; leaving them live would scatter
+        their mass into the reserved bin 0)."""
+        v = batch.value
+        emask = (v != 0) & ~jnp.isnan(v)
         return batch.row_ids(), batch.index, emask
 
     def fit_batch(self, batch, binner: QuantileBinner,
@@ -566,18 +579,21 @@ class GBDT:
     def margins_batch(self, params: dict, batch,
                       binner: QuantileBinner) -> jax.Array:
         """Margins over a staged CSR batch (sparse-native routing)."""
+        if not (self.missing_aware and binner.missing_aware):
+            # a dense missing_aware=False forest has every bin code shifted
+            # -1 relative to transform_entries; routing it here would be
+            # silently wrong, so mirror fit_batch's guard
+            raise ValueError("margins_batch requires missing_aware=True on "
+                             "both the GBDT and the QuantileBinner")
         row_id, findex, emask = self._entry_arrays(batch)
         ebin = binner.transform_entries(findex, batch.value)
         default_right = params.get("default_right")
         if default_right is None:
             default_right = jnp.zeros_like(params["feature"])
-        m = jnp.full(batch.label.shape, params["base"])
-        for i in range(self.num_trees):
-            m = m + self._tree_margins_sparse(
-                params["feature"][i], params["threshold"][i],
-                default_right[i], params["leaf"][i],
-                row_id, findex, ebin, emask, batch.label)
-        return m
+        base = jnp.full(batch.label.shape, params["base"])
+        return self._margins_sparse(params["feature"], params["threshold"],
+                                    default_right, params["leaf"], base,
+                                    row_id, findex, ebin, emask)
 
     def predict_batch(self, params: dict, batch,
                       binner: QuantileBinner) -> jax.Array:

@@ -340,3 +340,110 @@ def test_two_process_gbdt_histogram_allreduce():
                                np.asarray(ref["leaf"]), rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(results[0]["base"], float(ref["base"]),
                                atol=2e-6)
+
+
+_SPARSE_GBDT_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, f0, f1 = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlc_core_tpu.data import DeviceStagingIter
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+
+# THE real path: each process stages ITS OWN shard; the multi-host staging
+# layer assembles the global fixed-shape batch
+it = DeviceStagingIter(f0 if pid == 0 else f1, batch_size=64,
+                       nnz_bucket=64, nnz_max=512, sharding=sharding,
+                       format="libsvm")
+batches = list(it)
+assert len(batches) == 1, len(batches)
+batch = batches[0]
+
+# shared binner: per-feature cuts sketched from the UNION of both shards
+# (both processes read both tiny files, so the cuts are identical)
+idx_all, val_all = [], []
+for path in (f0, f1):
+    for line in open(path):
+        for tok in line.split()[1:]:
+            i, v = tok.split(":")
+            idx_all.append(int(i)); val_all.append(float(v))
+binner = QuantileBinner(num_bins=16, missing_aware=True)
+binner.fit_sparse(np.asarray(idx_all), np.asarray(val_all, np.float32),
+                  num_features=6)
+
+model = GBDT(num_features=6, num_trees=3, max_depth=3, num_bins=16,
+             learning_rate=0.5, missing_aware=True)
+forest = model.fit_batch(batch, binner)
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "feature": np.asarray(forest["feature"]).tolist(),
+    "threshold": np.asarray(forest["threshold"]).tolist(),
+    "default_right": np.asarray(forest["default_right"]).tolist(),
+    "leaf": np.round(np.asarray(forest["leaf"]), 5).tolist(),
+    "base": round(float(forest["base"]), 6)}), flush=True)
+"""
+
+
+def test_two_process_sparse_gbdt_end_to_end(tmp_path):
+    """The whole stack, multi-host: per-process libsvm shards -> multi-host
+    DeviceStagingIter (fixed-shape global batches over jax.distributed) ->
+    sparse-native fit_batch (O(nnz) histograms with cross-process psum) ->
+    forest equal to a single-process dense-reference fit on the union."""
+    import sys as _sys
+    _sys.path.insert(0, str(REPO))
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)
+    files, all_rows = [], []
+    for p, n_rows in ((0, 40), (1, 24)):
+        f = tmp_path / f"gshard{p}.libsvm"
+        lines = []
+        for _ in range(n_rows):
+            nnz = int(rng.integers(2, 6))
+            idx = np.sort(rng.choice(6, size=nnz, replace=False))
+            lut = {int(i): float(rng.uniform(0.2, 2.0)) for i in idx}
+            y = int((0 in lut) ^ (lut.get(1, 0.0) > 1.0))
+            lines.append((y, lut))
+            all_rows.append((y, lut))
+        f.write_text("\n".join(
+            f"{y} " + " ".join(f"{i}:{v:.6f}" for i, v in lut.items())
+            for y, lut in lines) + "\n")
+        files.append(str(f))
+
+    results, _ = _run_two(_SPARSE_GBDT_CHILD, files[0], files[1],
+                          label="sparse gbdt process")
+    assert set(results) == {0, 1}
+    assert ({k: v for k, v in results[0].items() if k != "pid"}
+            == {k: v for k, v in results[1].items() if k != "pid"})
+
+    # single-process reference: dense missing-aware fit on the union
+    from dmlc_core_tpu.models import GBDT, QuantileBinner
+    dense = np.full((len(all_rows), 6), np.nan, np.float32)
+    y = np.zeros(len(all_rows), np.float32)
+    idx_all, val_all = [], []
+    for r, (label, lut) in enumerate(all_rows):
+        y[r] = label
+        for i, v in lut.items():
+            dense[r, i] = v
+            idx_all.append(i)
+            val_all.append(v)
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    binner.fit_sparse(np.asarray(idx_all), np.asarray(val_all, np.float32),
+                      num_features=6)
+    model = GBDT(num_features=6, num_trees=3, max_depth=3, num_bins=16,
+                 learning_rate=0.5, missing_aware=True)
+    ref = model.fit(binner.transform(jnp.asarray(dense)), jnp.asarray(y))
+    for k in ("feature", "threshold", "default_right"):
+        assert results[0][k] == np.asarray(ref[k]).tolist(), k
+    np.testing.assert_allclose(np.asarray(results[0]["leaf"]),
+                               np.asarray(ref["leaf"]), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(results[0]["base"], float(ref["base"]),
+                               atol=2e-6)
