@@ -193,7 +193,12 @@ class GBDT:
     degenerates to the leftmost leaf and unreachable nodes stay zero),
     ``learning_rate`` (shrinkage), ``lambda_`` (L2
     on leaf weights), ``min_child_weight`` (minimum hessian mass per
-    child), ``objective`` ("logistic" or "squared").
+    child), ``objective`` ("logistic" or "squared"), ``subsample`` /
+    ``colsample_bytree`` in (0, 1] (stochastic boosting: a per-tree
+    Bernoulli row mask folded into the sample weights, and a per-tree
+    feature subset masking the split gains — both derived from ``seed``
+    and the tree index only, so sharded and multi-host runs sample
+    identically and fits are deterministic per seed).
 
     The forest is a pytree of flat arrays::
 
@@ -218,9 +223,16 @@ class GBDT:
                  learning_rate: float = 0.3, lambda_: float = 1.0,
                  min_child_weight: float = 1e-3,
                  objective: str = "logistic",
-                 missing_aware: bool = False):
+                 missing_aware: bool = False,
+                 subsample: float = 1.0,
+                 colsample_bytree: float = 1.0,
+                 seed: int = 0):
         if objective not in ("logistic", "squared"):
             raise ValueError(f"unknown objective '{objective}'")
+        if not 0.0 < subsample <= 1.0:
+            raise ValueError("subsample must be in (0, 1]")
+        if not 0.0 < colsample_bytree <= 1.0:
+            raise ValueError("colsample_bytree must be in (0, 1]")
         self.num_features = num_features
         self.num_trees = num_trees
         self.max_depth = max_depth
@@ -230,6 +242,9 @@ class GBDT:
         self.min_child_weight = min_child_weight
         self.objective = objective
         self.missing_aware = missing_aware
+        self.subsample = subsample
+        self.colsample_bytree = colsample_bytree
+        self.seed = seed
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -248,13 +263,15 @@ class GBDT:
             "base": jnp.zeros((), jnp.float32),
         }
 
-    def _pick_splits(self, gain: jax.Array):
+    def _pick_splits(self, gain: jax.Array, col_mask: jax.Array):
         """Flat argmax over a [nodes, F, B, n_dir] gain array plus
         null-split encoding; shared by the dense and sparse builders.
+        ``col_mask`` [F] disables unsampled features (colsample_bytree).
         Returns (split_f, split_b, split_d)."""
         n_nodes = gain.shape[0]
         B = self.num_bins
         n_dir = gain.shape[3]
+        gain = jnp.where(col_mask[None, :, None, None], gain, -jnp.inf)
         flat = gain.reshape(n_nodes, -1)
         best_flat = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best_flat[:, None], 1)[:, 0]
@@ -268,9 +285,11 @@ class GBDT:
                 jnp.where(null, 0, split_d))
 
     def _boost(self, label: jax.Array, w: jax.Array, build_tree) -> dict:
-        """Shared boosting driver (base prior, tree loop, stacking) for the
-        dense (`fit`) and sparse-native (`fit_batch`) input paths.
-        ``build_tree(grad, hess)`` returns `_build_tree`'s 5-tuple."""
+        """Shared boosting driver (base prior, tree loop, stochastic
+        row/column sampling, stacking) for the dense (`fit`) and
+        sparse-native (`fit_batch`) input paths.
+        ``build_tree(grad, hess, col_mask)`` returns `_build_tree`'s
+        5-tuple."""
         params = self.init()
         sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
         if self.objective == "logistic":
@@ -283,10 +302,27 @@ class GBDT:
         params["base"] = base.astype(jnp.float32)
 
         margin = jnp.full(label.shape, params["base"])
+        # stochastic GBM sampling: per-tree row mask folds into the weights
+        # (routing still sees every row), per-tree column mask disables
+        # unsampled features' gains.  Masks derive from (seed, tree index)
+        # only, so sharded and multi-host runs sample identically.
+        root_key = jax.random.PRNGKey(self.seed)
+        k_cols = max(1, int(round(self.colsample_bytree * self.num_features)))
+        full_cols = jnp.ones(self.num_features, bool)
         feats, thrs, dirs, leaves = [], [], [], []
-        for _ in range(self.num_trees):
+        for t_idx in range(self.num_trees):
             g, h = self._grad_hess(margin, label)
-            f, t, d, leaf, leaf_rel = build_tree(g * w, h * w)
+            w_t = w
+            if self.subsample < 1.0:
+                kr = jax.random.fold_in(root_key, 2 * t_idx)
+                w_t = w * jax.random.bernoulli(
+                    kr, self.subsample, w.shape).astype(jnp.float32)
+            col_mask = full_cols
+            if self.colsample_bytree < 1.0:
+                kc = jax.random.fold_in(root_key, 2 * t_idx + 1)
+                sel = jax.random.permutation(kc, self.num_features)[:k_cols]
+                col_mask = jnp.zeros(self.num_features, bool).at[sel].set(True)
+            f, t, d, leaf, leaf_rel = build_tree(g * w_t, h * w_t, col_mask)
             margin = margin + leaf[leaf_rel]
             feats.append(f)
             thrs.append(t)
@@ -299,7 +335,8 @@ class GBDT:
         return params
 
     @functools.partial(jax.jit, static_argnums=0)
-    def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array
+    def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                    col_mask: jax.Array
                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
                                jax.Array]:
         """One tree from per-row (grad, hess); levels unrolled under jit.
@@ -362,7 +399,7 @@ class GBDT:
                                 hl - hist_h[:, :, 0:1])], axis=3)
             else:
                 gain = split_gain(gl, hl)[..., None]        # dir axis size 1
-            split_f, split_b, split_d = self._pick_splits(gain)
+            split_f, split_b, split_d = self._pick_splits(gain, col_mask)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -408,7 +445,8 @@ class GBDT:
     @functools.partial(jax.jit, static_argnums=0)
     def _build_tree_sparse(self, row_id: jax.Array, findex: jax.Array,
                            ebin: jax.Array, emask: jax.Array,
-                           grad: jax.Array, hess: jax.Array):
+                           grad: jax.Array, hess: jax.Array,
+                           col_mask: jax.Array):
         """One tree from COO entries — O(nnz) histogram work per level.
 
         The sparse formulation of `_build_tree`: present entries scatter
@@ -464,7 +502,7 @@ class GBDT:
                 [split_gain(gl[..., 0] + miss[:, :, None, 0],
                             gl[..., 1] + miss[:, :, None, 1]),
                  split_gain(gl[..., 0], gl[..., 1])], axis=3)
-            split_f, split_b, split_d = self._pick_splits(gain)
+            split_f, split_b, split_d = self._pick_splits(gain, col_mask)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -530,7 +568,7 @@ class GBDT:
         w = (jnp.ones_like(label) if weight is None
              else weight.astype(jnp.float32))
         return self._boost(label, w,
-                           lambda g, h: self._build_tree(bins, g, h))
+                           lambda g, h, cm: self._build_tree(bins, g, h, cm))
 
     @staticmethod
     def _entry_arrays(batch):
@@ -573,8 +611,8 @@ class GBDT:
         ebin = binner.transform_entries(findex, batch.value)
         return self._boost(
             label, w,
-            lambda g, h: self._build_tree_sparse(row_id, findex, ebin,
-                                                 emask, g, h))
+            lambda g, h, cm: self._build_tree_sparse(row_id, findex, ebin,
+                                                     emask, g, h, cm))
 
     def margins_batch(self, params: dict, batch,
                       binner: QuantileBinner) -> jax.Array:

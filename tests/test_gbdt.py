@@ -423,3 +423,93 @@ def test_explicit_zero_entry_is_missing_on_both_paths():
     np.testing.assert_allclose(np.asarray(p_dense["leaf"]),
                                np.asarray(p_sparse["leaf"]),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_stochastic_sampling_subsample_and_colsample():
+    """subsample / colsample_bytree: still learns, deterministic by seed,
+    and each tree's splits stay within its sampled column set."""
+    rng = np.random.default_rng(14)
+    x = rng.uniform(-1, 1, size=(4000, 8)).astype(np.float32)
+    # additive target: trees that sample only some informative features
+    # still reduce loss (XOR would make column sampling adversarial)
+    y = (x[:, 0] + 0.5 * x[:, 1] - 0.3 * x[:, 2] > 0).astype(np.float32)
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+    label = jnp.asarray(y)
+
+    kwargs = dict(num_features=8, num_trees=20, max_depth=3, num_bins=32,
+                  learning_rate=0.4)
+    stoch = GBDT(**kwargs, subsample=0.7, colsample_bytree=0.5, seed=3)
+    p1 = stoch.fit(bins, label)
+    p2 = GBDT(**kwargs, subsample=0.7, colsample_bytree=0.5, seed=3
+              ).fit(bins, label)
+    for k in ("feature", "threshold", "leaf"):
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]),
+                                      err_msg=f"seeded fit not deterministic: {k}")
+    p3 = GBDT(**kwargs, subsample=0.7, colsample_bytree=0.5, seed=4
+              ).fit(bins, label)
+    assert not np.array_equal(np.asarray(p1["feature"]),
+                              np.asarray(p3["feature"])), \
+        "different seeds should sample differently"
+
+    # colsample: each tree draws 4 of 8 columns; non-null splits must stay
+    # within a 4-feature set per tree
+    feat = np.asarray(p1["feature"])
+    thr = np.asarray(p1["threshold"])
+    for t in range(feat.shape[0]):
+        used = set(feat[t][thr[t] < 32].tolist())
+        assert len(used) <= 4, (t, used)
+
+    acc = float(jnp.mean((stoch.predict(p1, bins) > 0.5) == (label > 0.5)))
+    assert acc > 0.9, f"stochastic forest failed to learn: {acc}"
+
+    # full sampling is bit-identical to the pre-feature behavior
+    full_a = GBDT(**kwargs).fit(bins, label)
+    full_b = GBDT(**kwargs, subsample=1.0, colsample_bytree=1.0, seed=9
+                  ).fit(bins, label)
+    for k in ("feature", "threshold", "leaf"):
+        np.testing.assert_array_equal(np.asarray(full_a[k]),
+                                      np.asarray(full_b[k]))
+
+
+def test_stochastic_sampling_sparse_path_matches_dense():
+    """The sampling masks derive from (seed, tree index) only, so the
+    sparse fit_batch builds the identical stochastic forest to the dense
+    fit on equivalent data — pinning the col_mask plumbing of both paths."""
+    from dmlc_core_tpu.ops.sparse import csr_to_dense_missing
+    rng = np.random.default_rng(15)
+    rows, feats = 768, 6
+    batch, row_id, index, value = _random_padded_batch(rng, rows, feats)
+    dense = np.asarray(csr_to_dense_missing(
+        jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id),
+        rows, feats))
+    y = (np.where(np.isnan(dense[:, 0]), 1.0, dense[:, 0] > 0.0)
+         ).astype(np.float32)
+    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
+                                  ("weight", "row_ptr", "index", "value",
+                                   "num_rows", "field")},
+                               "label": jnp.asarray(y)})
+    binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
+    model = GBDT(num_features=feats, num_trees=6, max_depth=3, num_bins=16,
+                 learning_rate=0.5, missing_aware=True,
+                 subsample=0.8, colsample_bytree=0.67, seed=5)
+    p_dense = model.fit(binner.transform(jnp.asarray(dense)), jnp.asarray(y))
+    p_sparse = model.fit_batch(batch, binner)
+    # default_right is NOT compared bit-for-bit: at a node with zero
+    # missing mass both directions have equal gain, and the sparse path's
+    # miss = node_total - present_sum carries float dust that can flip the
+    # (semantically inert) tie; the prediction parity below is the contract
+    for k in ("feature", "threshold"):
+        np.testing.assert_array_equal(np.asarray(p_dense[k]),
+                                      np.asarray(p_sparse[k]), err_msg=k)
+    np.testing.assert_allclose(np.asarray(p_dense["leaf"]),
+                               np.asarray(p_sparse["leaf"]),
+                               rtol=1e-4, atol=1e-6)
+    pred_d = np.asarray(model.predict(p_dense,
+                                      binner.transform(jnp.asarray(dense))))
+    pred_s = np.asarray(model.predict_batch(p_sparse, batch, binner))
+    np.testing.assert_allclose(pred_d, pred_s, rtol=1e-4, atol=1e-6)
+    # column sampling really bit: 4 of 6 columns per tree
+    feat = np.asarray(p_dense["feature"])
+    thr = np.asarray(p_dense["threshold"])
+    for t in range(feat.shape[0]):
+        assert len(set(feat[t][thr[t] < 16].tolist())) <= 4
