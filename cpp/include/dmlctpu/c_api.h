@@ -167,6 +167,27 @@ int DmlcTpuRecordBatcherBeforeFirst(DmlcTpuRecordBatcherHandle handle);
 int64_t DmlcTpuRecordBatcherBytesRead(DmlcTpuRecordBatcherHandle handle);
 void DmlcTpuRecordBatcherFree(DmlcTpuRecordBatcherHandle handle);
 
+/* ---- generic streams + filesystem metadata (dmlc::Stream::Create /
+ *      FileSystem::ListDirectory parity, reference src/io.cc:132-144) ---- */
+typedef void* DmlcTpuStreamHandle;
+/* mode: "r" / "w" / "a".  Any registered backend URI (file/s3/azure/hdfs/
+ * http/https). */
+int DmlcTpuStreamCreate(const char* uri, const char* mode,
+                        DmlcTpuStreamHandle* out);
+/* returns bytes read (0 = EOF) or -1 on error */
+int64_t DmlcTpuStreamRead(DmlcTpuStreamHandle handle, void* buf, uint64_t n);
+int DmlcTpuStreamWrite(DmlcTpuStreamHandle handle, const void* buf,
+                       uint64_t n);
+/* flush + close; write errors (e.g. remote upload failure) surface here */
+int DmlcTpuStreamClose(DmlcTpuStreamHandle handle);
+void DmlcTpuStreamFree(DmlcTpuStreamHandle handle);
+/* newline-separated "type\tsize\tpath" entries (type: f|d; '\\'/'\n'/'\t'
+ * inside paths are backslash-escaped); pointer valid until the next call
+ * on the same thread.  recursive != 0 descends. */
+int DmlcTpuFsListDirectory(const char* uri, int recursive, const char** out);
+/* single-path stat into the same format (one line) */
+int DmlcTpuFsPathInfo(const char* uri, const char** out);
+
 /* ---- misc ---------------------------------------------------------------- */
 /*! \brief library version string */
 const char* DmlcTpuVersion(void);

@@ -5,11 +5,13 @@
 #include <cstring>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "./data/record_batcher.h"
 #include "./data/staged_batcher.h"
 #include "dmlctpu/data.h"
 #include "dmlctpu/input_split.h"
+#include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/recordio.h"
 #include "dmlctpu/stream.h"
@@ -33,6 +35,9 @@ int Guard(Fn&& fn) {
 
 struct ParserCtx {
   std::unique_ptr<dmlctpu::Parser<uint64_t, float>> parser;
+};
+struct StreamCtx {
+  std::unique_ptr<dmlctpu::Stream> stream;
 };
 struct SplitCtx {
   std::unique_ptr<dmlctpu::InputSplit> split;
@@ -65,6 +70,101 @@ extern "C" {
 
 const char* DmlcTpuGetLastError(void) { return last_error.c_str(); }
 const char* DmlcTpuVersion(void) { return "0.1.0"; }
+
+int DmlcTpuStreamCreate(const char* uri, const char* mode,
+                        DmlcTpuStreamHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<StreamCtx>();
+    ctx->stream = dmlctpu::Stream::Create(uri, mode);
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int64_t DmlcTpuStreamRead(DmlcTpuStreamHandle handle, void* buf, uint64_t n) {
+  int64_t got = -1;
+  int rc = Guard([&] {
+    auto* ctx = static_cast<StreamCtx*>(handle);
+    got = static_cast<int64_t>(ctx->stream->Read(buf, n));
+    return 0;
+  });
+  return rc == 0 ? got : -1;
+}
+
+int DmlcTpuStreamWrite(DmlcTpuStreamHandle handle, const void* buf,
+                       uint64_t n) {
+  return Guard([&] {
+    auto* ctx = static_cast<StreamCtx*>(handle);
+    ctx->stream->Write(buf, n);
+    return 0;
+  });
+}
+
+int DmlcTpuStreamClose(DmlcTpuStreamHandle handle) {
+  return Guard([&] {
+    auto* ctx = static_cast<StreamCtx*>(handle);
+    // the virtual Close() is the throwing flush (destructors deliberately
+    // swallow — see S3WriteStream/StdioFileStream): errors like a failed
+    // multipart completion or ENOSPC surface HERE, then the nothrow
+    // destructor in Free is a no-op
+    ctx->stream->Close();
+    return 0;
+  });
+}
+
+void DmlcTpuStreamFree(DmlcTpuStreamHandle handle) {
+  delete static_cast<StreamCtx*>(handle);
+}
+
+namespace {
+thread_local std::string fs_listing;
+
+void AppendFileInfo(const dmlctpu::io::FileInfo& info, std::string* out) {
+  out->push_back(info.type == dmlctpu::io::FileType::kDirectory ? 'd' : 'f');
+  out->push_back('\t');
+  out->append(std::to_string(info.size));
+  out->push_back('\t');
+  // newline/tab are legal in POSIX filenames and object keys: escape them
+  // so the line format stays parseable
+  for (char c : info.path.str()) {
+    switch (c) {
+      case '\\': out->append("\\\\"); break;
+      case '\n': out->append("\\n"); break;
+      case '\t': out->append("\\t"); break;
+      default: out->push_back(c);
+    }
+  }
+  out->push_back('\n');
+}
+}  // namespace
+
+int DmlcTpuFsListDirectory(const char* uri, int recursive, const char** out) {
+  return Guard([&] {
+    dmlctpu::io::URI parsed(uri);
+    auto* fs = dmlctpu::io::FileSystem::GetInstance(parsed);
+    std::vector<dmlctpu::io::FileInfo> entries;
+    if (recursive != 0) {
+      fs->ListDirectoryRecursive(parsed, &entries);
+    } else {
+      fs->ListDirectory(parsed, &entries);
+    }
+    fs_listing.clear();
+    for (const auto& e : entries) AppendFileInfo(e, &fs_listing);
+    *out = fs_listing.c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuFsPathInfo(const char* uri, const char** out) {
+  return Guard([&] {
+    dmlctpu::io::URI parsed(uri);
+    auto* fs = dmlctpu::io::FileSystem::GetInstance(parsed);
+    fs_listing.clear();
+    AppendFileInfo(fs->GetPathInfo(parsed), &fs_listing);
+    *out = fs_listing.c_str();
+    return 0;
+  });
+}
 
 int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
                         const char* format, DmlcTpuParserHandle* out) {

@@ -615,3 +615,40 @@ TESTCASE(fatal_error_carries_demangled_stack_trace) {
 }
 
 TESTMAIN()
+
+#include "dmlctpu/c_api.h"
+
+TESTCASE(c_api_stream_and_fs) {
+  // the generic Stream/FS C surface the Python bindings and dmlctpu-fs
+  // CLI ride (write -> read roundtrip, listing, stat, error reporting)
+  TemporaryDirectory tmp;
+  std::string path = tmp.path + "/c_api_stream.bin";
+  DmlcTpuStreamHandle h = nullptr;
+  EXPECT_EQV(DmlcTpuStreamCreate(path.c_str(), "w", &h), 0);
+  EXPECT_EQV(DmlcTpuStreamWrite(h, "hello", 5), 0);
+  EXPECT_EQV(DmlcTpuStreamClose(h), 0);
+  DmlcTpuStreamFree(h);
+
+  h = nullptr;
+  EXPECT_EQV(DmlcTpuStreamCreate(path.c_str(), "r", &h), 0);
+  char buf[16] = {0};
+  EXPECT_EQV(DmlcTpuStreamRead(h, buf, sizeof(buf)), 5);
+  EXPECT_EQV(std::string(buf, 5), std::string("hello"));
+  EXPECT_EQV(DmlcTpuStreamRead(h, buf, sizeof(buf)), 0);  // EOF
+  EXPECT_EQV(DmlcTpuStreamClose(h), 0);
+  DmlcTpuStreamFree(h);
+
+  const char* listing = nullptr;
+  EXPECT_EQV(DmlcTpuFsListDirectory(tmp.path.c_str(), 0, &listing), 0);
+  EXPECT_TRUE(std::string(listing).find("c_api_stream.bin") !=
+              std::string::npos);
+  const char* info = nullptr;
+  EXPECT_EQV(DmlcTpuFsPathInfo(path.c_str(), &info), 0);
+  EXPECT_TRUE(std::string(info).rfind("f\t5\t", 0) == 0);
+
+  // missing file: -1 + a populated error string, no crash
+  DmlcTpuStreamHandle bad = nullptr;
+  EXPECT_EQV(DmlcTpuStreamCreate((tmp.path + "/nope").c_str(), "r", &bad), -1);
+  EXPECT_TRUE(std::string(DmlcTpuGetLastError()).find("nope") !=
+              std::string::npos);
+}

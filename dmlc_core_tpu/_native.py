@@ -104,6 +104,20 @@ _LIB.DmlcTpuRecordIOReaderNext.argtypes = [
     ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
 _LIB.DmlcTpuRecordIOReaderFree.argtypes = [ctypes.c_void_p]
 
+_LIB.DmlcTpuStreamCreate.argtypes = [
+    ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuStreamRead.argtypes = [
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+_LIB.DmlcTpuStreamRead.restype = ctypes.c_int64
+_LIB.DmlcTpuStreamWrite.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+_LIB.DmlcTpuStreamClose.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuStreamFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuFsListDirectory.argtypes = [
+    ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuFsPathInfo.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+
 
 class NativeError(RuntimeError):
     """Error raised by the native dmlctpu runtime."""

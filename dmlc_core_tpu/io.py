@@ -8,7 +8,7 @@ for parsed numeric data goes through `dmlc_core_tpu.data` instead.
 from __future__ import annotations
 
 import ctypes
-from typing import Iterator, Optional
+from typing import Iterator, NamedTuple, Optional
 
 from ._native import check, lib
 
@@ -129,3 +129,117 @@ class RecordIOReader:
 
     def __del__(self):
         self.close()
+
+
+class FileInfo(NamedTuple):
+    """One filesystem entry (FileSystem::GetPathInfo / ListDirectory)."""
+    path: str
+    size: int
+    is_dir: bool
+
+
+class Stream:
+    """Generic byte stream over any registered backend URI — the
+    ``dmlc::Stream::Create`` surface (reference src/io.cc:132-144):
+    file://, s3://, azure://, hdfs://, http(s)://, or a bare path.
+
+    mode: "r" (read), "w" (write), "a" (append where the backend allows).
+    File-like: read/write/close, iteration-free by design (wrap in
+    RecordIOReader or text-decode on the caller side as needed).
+    """
+
+    def __init__(self, uri: str, mode: str = "r"):
+        self._handle = ctypes.c_void_p()
+        check(lib().DmlcTpuStreamCreate(uri.encode(), mode.encode(),
+                                        ctypes.byref(self._handle)))
+
+    def read(self, n: int = -1) -> bytes:
+        """Read up to n bytes (all remaining when n < 0)."""
+        if n < 0:
+            chunks = []
+            while True:
+                chunk = self.read(1 << 20)
+                if not chunk:
+                    return b"".join(chunks)
+                chunks.append(chunk)
+        buf = ctypes.create_string_buffer(n)
+        got = lib().DmlcTpuStreamRead(self._handle, buf, n)
+        if got < 0:
+            check(-1)
+        return buf.raw[:got]
+
+    def write(self, data: bytes) -> int:
+        check(lib().DmlcTpuStreamWrite(self._handle, data, len(data)))
+        return len(data)
+
+    def close(self) -> None:
+        """Flush and close; remote upload/flush errors raise HERE."""
+        if self._handle:
+            handle, self._handle = self._handle, ctypes.c_void_p()
+            try:
+                check(lib().DmlcTpuStreamClose(handle))
+            finally:
+                lib().DmlcTpuStreamFree(handle)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001  (interpreter teardown best-effort)
+            pass
+
+
+def open_stream(uri: str, mode: str = "r") -> Stream:
+    """Open a byte stream on any backend (the Stream::Create factory)."""
+    return Stream(uri, mode)
+
+
+def _unescape_path(path: str) -> str:
+    # inverse of the C side's AppendFileInfo escaping (\\, \n, \t)
+    if "\\" not in path:
+        return path
+    out, i = [], 0
+    while i < len(path):
+        c = path[i]
+        if c == "\\" and i + 1 < len(path):
+            nxt = path[i + 1]
+            out.append({"n": "\n", "t": "\t", "\\": "\\"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_infos(raw: bytes) -> list:
+    out = []
+    for line in raw.decode(errors="replace").split("\n"):
+        if not line:
+            continue
+        kind, size, path = line.split("\t", 2)
+        out.append(FileInfo(path=_unescape_path(path), size=int(size),
+                            is_dir=kind == "d"))
+    return out
+
+
+def listdir(uri: str, recursive: bool = False) -> list:
+    """List a directory on any backend (FileSystem::ListDirectory[Recursive])."""
+    out = ctypes.c_char_p()
+    check(lib().DmlcTpuFsListDirectory(uri.encode(), int(recursive),
+                                       ctypes.byref(out)))
+    return _parse_infos(out.value or b"")
+
+
+def path_info(uri: str) -> FileInfo:
+    """Stat one path on any backend (FileSystem::GetPathInfo)."""
+    out = ctypes.c_char_p()
+    check(lib().DmlcTpuFsPathInfo(uri.encode(), ctypes.byref(out)))
+    infos = _parse_infos(out.value or b"")
+    if not infos:
+        raise FileNotFoundError(uri)
+    return infos[0]

@@ -97,3 +97,62 @@ def test_row_ids_and_values(tmp_path):
 
 def test_native_version():
     assert dt.native_version()
+
+
+def test_stream_and_fs_surface(tmp_path):
+    """Generic Stream::Create + FileSystem metadata parity surface
+    (reference src/io.cc:132-144): open/read/write/close, listdir
+    (recursive), path_info — and close() surfaces write errors."""
+    from dmlc_core_tpu.io import open_stream, listdir, path_info
+    p = tmp_path / "x.bin"
+    with open_stream(str(p), "w") as s:
+        s.write(b"abc")
+        s.write(b"defgh")
+    with open_stream(str(p)) as s:
+        assert s.read(2) == b"ab"
+        assert s.read() == b"cdefgh"
+        assert s.read(4) == b""  # EOF
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "y").write_bytes(b"12")
+    names = {f.path.rsplit("/", 1)[-1]: f for f in listdir(str(tmp_path))}
+    assert names["x.bin"].size == 8 and not names["x.bin"].is_dir
+    assert names["sub"].is_dir
+    deep = listdir(str(tmp_path), recursive=True)
+    assert any(f.path.endswith("sub/y") and f.size == 2 for f in deep)
+    info = path_info(str(p))
+    assert (info.size, info.is_dir) == (8, False)
+    import pytest
+    from dmlc_core_tpu._native import NativeError
+    with pytest.raises(NativeError):
+        open_stream(str(tmp_path / "nope"), "r")
+    # newline/tab are legal in POSIX filenames: the listing wire format
+    # escapes them (AppendFileInfo) and the binding unescapes
+    weird = tmp_path / "a\nb\tc"
+    weird.write_bytes(b"xyz")
+    entries = [f for f in listdir(str(tmp_path)) if f.path.endswith("a\nb\tc")]
+    assert len(entries) == 1 and entries[0].size == 3
+
+
+def test_fs_cli_ls_cat_cp_stat(tmp_path):
+    """bin/dmlctpu-fs: the reference's filesys_test driver as a CLI."""
+    import subprocess
+    import sys
+    from pathlib import Path
+    repo = Path(__file__).resolve().parent.parent
+    (tmp_path / "a.txt").write_bytes(b"payload123")
+
+    def run(*args):
+        return subprocess.run([sys.executable, str(repo / "bin" / "dmlctpu-fs"),
+                               *args], capture_output=True, timeout=120)
+
+    ls = run("ls", str(tmp_path))
+    assert ls.returncode == 0 and b"a.txt" in ls.stdout
+    cat = run("cat", str(tmp_path / "a.txt"))
+    assert cat.returncode == 0 and cat.stdout == b"payload123"
+    cp = run("cp", str(tmp_path / "a.txt"), str(tmp_path / "b.txt"))
+    assert cp.returncode == 0
+    assert (tmp_path / "b.txt").read_bytes() == b"payload123"
+    st = run("stat", str(tmp_path / "a.txt"))
+    assert st.returncode == 0 and b"size=10" in st.stdout
+    bad = run("cat", str(tmp_path / "missing"))
+    assert bad.returncode == 1 and b"dmlctpu-fs:" in bad.stderr
