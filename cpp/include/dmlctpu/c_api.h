@@ -181,6 +181,11 @@ int DmlcTpuStreamWrite(DmlcTpuStreamHandle handle, const void* buf,
 /* flush + close; write errors (e.g. remote upload failure) surface here */
 int DmlcTpuStreamClose(DmlcTpuStreamHandle handle);
 void DmlcTpuStreamFree(DmlcTpuStreamHandle handle);
+/* seekable read stream (SeekStream::CreateForRead); supports Read plus: */
+int DmlcTpuSeekStreamCreate(const char* uri, DmlcTpuStreamHandle* out);
+int DmlcTpuStreamSeek(DmlcTpuStreamHandle handle, uint64_t pos);
+/* returns current position or -1 (only valid for seekable streams) */
+int64_t DmlcTpuStreamTell(DmlcTpuStreamHandle handle);
 /* newline-separated "type\tsize\tpath" entries (type: f|d; '\\'/'\n'/'\t'
  * inside paths are backslash-escaped); pointer valid until the next call
  * on the same thread.  recursive != 0 descends. */

@@ -38,6 +38,7 @@ struct ParserCtx {
 };
 struct StreamCtx {
   std::unique_ptr<dmlctpu::Stream> stream;
+  dmlctpu::SeekStream* seekable = nullptr;  // non-owning view when seekable
 };
 struct SplitCtx {
   std::unique_ptr<dmlctpu::InputSplit> split;
@@ -114,6 +115,39 @@ int DmlcTpuStreamClose(DmlcTpuStreamHandle handle) {
 
 void DmlcTpuStreamFree(DmlcTpuStreamHandle handle) {
   delete static_cast<StreamCtx*>(handle);
+}
+
+int DmlcTpuSeekStreamCreate(const char* uri, DmlcTpuStreamHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<StreamCtx>();
+    auto seek = dmlctpu::SeekStream::CreateForRead(uri);
+    ctx->seekable = seek.get();
+    ctx->stream = std::move(seek);
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuStreamSeek(DmlcTpuStreamHandle handle, uint64_t pos) {
+  return Guard([&] {
+    auto* ctx = static_cast<StreamCtx*>(handle);
+    TCHECK(ctx->seekable != nullptr)
+        << "stream is not seekable (open it with SeekStreamCreate)";
+    ctx->seekable->Seek(pos);
+    return 0;
+  });
+}
+
+int64_t DmlcTpuStreamTell(DmlcTpuStreamHandle handle) {
+  int64_t pos = -1;
+  int rc = Guard([&] {
+    auto* ctx = static_cast<StreamCtx*>(handle);
+    TCHECK(ctx->seekable != nullptr)
+        << "stream is not seekable (open it with SeekStreamCreate)";
+    pos = static_cast<int64_t>(ctx->seekable->Tell());
+    return 0;
+  });
+  return rc == 0 ? pos : -1;
 }
 
 namespace {

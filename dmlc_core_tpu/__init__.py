@@ -15,7 +15,8 @@ from . import checkpoint, data, io, models, ops, parallel, timer
 from ._native import NativeError, version as native_version
 from .data import (DeviceStagingIter, PaddedBatch, Parser, RecordBatch,
                    RecordStagingIter, RowBlock)
-from .io import InputSplit, RecordIOReader, RecordIOWriter
+from .io import (FileInfo, InputSplit, RecordIOReader, RecordIOWriter,
+                 listdir, open_seek_stream, open_stream, path_info)
 
 __version__ = "0.1.0"
 __all__ = [
@@ -24,4 +25,5 @@ __all__ = [
     "DeviceStagingIter", "PaddedBatch", "Parser", "RowBlock",
     "RecordBatch", "RecordStagingIter",
     "InputSplit", "RecordIOReader", "RecordIOWriter",
+    "FileInfo", "open_stream", "open_seek_stream", "listdir", "path_info",
 ]

@@ -113,6 +113,11 @@ _LIB.DmlcTpuStreamWrite.argtypes = [
     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
 _LIB.DmlcTpuStreamClose.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuStreamFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuSeekStreamCreate.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuStreamSeek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+_LIB.DmlcTpuStreamTell.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuStreamTell.restype = ctypes.c_int64
 _LIB.DmlcTpuFsListDirectory.argtypes = [
     ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
 _LIB.DmlcTpuFsPathInfo.argtypes = [

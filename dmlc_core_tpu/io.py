@@ -148,10 +148,35 @@ class Stream:
     RecordIOReader or text-decode on the caller side as needed).
     """
 
-    def __init__(self, uri: str, mode: str = "r"):
+    def __init__(self, uri: str, mode: str = "r", _seekable: bool = False):
         self._handle = ctypes.c_void_p()
-        check(lib().DmlcTpuStreamCreate(uri.encode(), mode.encode(),
-                                        ctypes.byref(self._handle)))
+        self._seekable = _seekable
+        enc = uri.encode("utf-8", "surrogateescape")  # os.fsdecode'd names
+        if _seekable:
+            check(lib().DmlcTpuSeekStreamCreate(enc,
+                                                ctypes.byref(self._handle)))
+        else:
+            check(lib().DmlcTpuStreamCreate(enc, mode.encode(),
+                                            ctypes.byref(self._handle)))
+
+    def _require_open(self) -> ctypes.c_void_p:
+        # a NULL handle would segfault in the C shim, not raise
+        if not self._handle:
+            raise ValueError("I/O operation on closed stream")
+        return self._handle
+
+    def seek(self, pos: int) -> None:
+        """Reposition the read cursor (seekable read streams only)."""
+        check(lib().DmlcTpuStreamSeek(self._require_open(), pos))
+
+    def tell(self) -> int:
+        pos = lib().DmlcTpuStreamTell(self._require_open())
+        if pos < 0:
+            check(-1)
+        return pos
+
+    def seekable(self) -> bool:
+        return self._seekable
 
     def read(self, n: int = -1) -> bytes:
         """Read up to n bytes (all remaining when n < 0)."""
@@ -163,13 +188,14 @@ class Stream:
                     return b"".join(chunks)
                 chunks.append(chunk)
         buf = ctypes.create_string_buffer(n)
-        got = lib().DmlcTpuStreamRead(self._handle, buf, n)
+        got = lib().DmlcTpuStreamRead(self._require_open(), buf, n)
         if got < 0:
             check(-1)
         return buf.raw[:got]
 
     def write(self, data: bytes) -> int:
-        check(lib().DmlcTpuStreamWrite(self._handle, data, len(data)))
+        check(lib().DmlcTpuStreamWrite(self._require_open(), data,
+                                       len(data)))
         return len(data)
 
     def close(self) -> None:
@@ -199,6 +225,12 @@ def open_stream(uri: str, mode: str = "r") -> Stream:
     return Stream(uri, mode)
 
 
+def open_seek_stream(uri: str) -> Stream:
+    """Open a seekable read stream (SeekStream::CreateForRead): random
+    access via ``seek``/``tell`` — range-GET on remote backends."""
+    return Stream(uri, "r", _seekable=True)
+
+
 def _unescape_path(path: str) -> str:
     # inverse of the C side's AppendFileInfo escaping (\\, \n, \t)
     if "\\" not in path:
@@ -218,7 +250,9 @@ def _unescape_path(path: str) -> str:
 
 def _parse_infos(raw: bytes) -> list:
     out = []
-    for line in raw.decode(errors="replace").split("\n"):
+    # surrogateescape (os.fsdecode semantics): non-UTF-8 filenames round-trip
+    # back through the surrogateescape encode in Stream/listdir/path_info
+    for line in raw.decode("utf-8", "surrogateescape").split("\n"):
         if not line:
             continue
         kind, size, path = line.split("\t", 2)
@@ -230,15 +264,17 @@ def _parse_infos(raw: bytes) -> list:
 def listdir(uri: str, recursive: bool = False) -> list:
     """List a directory on any backend (FileSystem::ListDirectory[Recursive])."""
     out = ctypes.c_char_p()
-    check(lib().DmlcTpuFsListDirectory(uri.encode(), int(recursive),
-                                       ctypes.byref(out)))
+    check(lib().DmlcTpuFsListDirectory(
+        uri.encode("utf-8", "surrogateescape"), int(recursive),
+        ctypes.byref(out)))
     return _parse_infos(out.value or b"")
 
 
 def path_info(uri: str) -> FileInfo:
     """Stat one path on any backend (FileSystem::GetPathInfo)."""
     out = ctypes.c_char_p()
-    check(lib().DmlcTpuFsPathInfo(uri.encode(), ctypes.byref(out)))
+    check(lib().DmlcTpuFsPathInfo(uri.encode("utf-8", "surrogateescape"),
+                                  ctypes.byref(out)))
     infos = _parse_infos(out.value or b"")
     if not infos:
         raise FileNotFoundError(uri)

@@ -156,3 +156,24 @@ def test_fs_cli_ls_cat_cp_stat(tmp_path):
     assert st.returncode == 0 and b"size=10" in st.stdout
     bad = run("cat", str(tmp_path / "missing"))
     assert bad.returncode == 1 and b"dmlctpu-fs:" in bad.stderr
+
+
+def test_seek_stream_random_access(tmp_path):
+    """SeekStream::CreateForRead parity: seek/tell random access; plain
+    streams reject seek with a clear error."""
+    import pytest
+    from dmlc_core_tpu import open_seek_stream, open_stream
+    from dmlc_core_tpu._native import NativeError
+    p = tmp_path / "s.bin"
+    p.write_bytes(bytes(range(200)))
+    with open_seek_stream(str(p)) as s:
+        assert s.seekable()
+        s.seek(100)
+        assert s.tell() == 100
+        assert s.read(4) == bytes([100, 101, 102, 103])
+        s.seek(0)
+        assert s.read(1) == b"\x00"
+    with open_stream(str(p)) as s:
+        assert not s.seekable()
+        with pytest.raises(NativeError, match="not seekable"):
+            s.seek(1)
