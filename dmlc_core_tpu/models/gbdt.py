@@ -261,6 +261,10 @@ class GBDT:
             "leaf": jnp.zeros((self.num_trees, 2 ** self.max_depth),
                               jnp.float32),
             "base": jnp.zeros((), jnp.float32),
+            # NOTE: forests checkpointed before trees_used existed have one
+            # fewer leaf; load those with a template that pops this key
+            # (margins()/predict() never require it)
+            "trees_used": jnp.zeros((), jnp.int32),
         }
 
     def _pick_splits(self, gain: jax.Array, col_mask: jax.Array):
@@ -284,12 +288,35 @@ class GBDT:
                 jnp.where(null, B, split_b),   # everything routes left
                 jnp.where(null, 0, split_d))
 
-    def _boost(self, label: jax.Array, w: jax.Array, build_tree) -> dict:
+    def _objective_loss(self, margin: jax.Array, label: jax.Array,
+                        weight: Optional[jax.Array]) -> jax.Array:
+        """Weighted mean objective from margins (shared by loss() and the
+        early-stopping eval)."""
+        if self.objective == "logistic":
+            per = logistic_nll(margin, label)
+        else:
+            per = 0.5 * (margin - label) ** 2
+        if weight is None:
+            return jnp.mean(per)
+        w = weight.astype(jnp.float32)
+        return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+    def _boost(self, label: jax.Array, w: jax.Array, build_tree,
+               eval_margin=None, eval_label=None, eval_weight=None,
+               early_stopping_rounds: int = 0) -> dict:
         """Shared boosting driver (base prior, tree loop, stochastic
         row/column sampling, stacking) for the dense (`fit`) and
         sparse-native (`fit_batch`) input paths.
         ``build_tree(grad, hess, col_mask)`` returns `_build_tree`'s
-        5-tuple."""
+        5-tuple.
+
+        Early stopping: ``eval_margin(tree_params) -> per-row margins`` on
+        a held-out set; when its loss fails to improve for
+        ``early_stopping_rounds`` consecutive trees, boosting stops and
+        the forest is truncated at the best round (XGBoost's
+        ``early_stopping_rounds`` semantics).  Unused leading capacity is
+        null-padded so the pytree keeps its static [num_trees, ...]
+        shapes (null trees route everything to leaf 0 with weight 0)."""
         params = self.init()
         sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
         if self.objective == "logistic":
@@ -309,6 +336,10 @@ class GBDT:
         root_key = jax.random.PRNGKey(self.seed)
         k_cols = max(1, int(round(self.colsample_bytree * self.num_features)))
         full_cols = jnp.ones(self.num_features, bool)
+        have_eval = eval_margin is not None
+        ev_m = (jnp.full(eval_label.shape, params["base"]) if have_eval
+                else None)
+        best_loss, best_t, since_best = float("inf"), 0, 0
         feats, thrs, dirs, leaves = [], [], [], []
         for t_idx in range(self.num_trees):
             g, h = self._grad_hess(margin, label)
@@ -328,10 +359,43 @@ class GBDT:
             thrs.append(t)
             dirs.append(d)
             leaves.append(leaf)
+            if have_eval:
+                ev_m = ev_m + eval_margin(f, t, d, leaf)
+                loss = float(self._objective_loss(ev_m, eval_label,
+                                                  eval_weight))
+                if loss < best_loss:
+                    best_loss, best_t, since_best = loss, t_idx + 1, 0
+                elif early_stopping_rounds > 0:
+                    since_best += 1
+                    if since_best >= early_stopping_rounds:
+                        break
+        # truncation at the best round only when stopping was requested:
+        # an eval_set alone is monitoring, not a pruning instruction
+        stop_on = have_eval and early_stopping_rounds > 0
+        trees_used = best_t if stop_on else len(feats)
+        # static [num_trees, ...] shapes: trees past trees_used (stopped
+        # early or worse-than-best) become null trees — every row routes
+        # left to leaf 0 whose weight is 0
+        n_internal = 2 ** self.max_depth - 1
+        null_f = jnp.zeros(n_internal, jnp.int32)
+        null_t = jnp.full(n_internal, self.num_bins, jnp.int32)
+        null_leaf = jnp.zeros(2 ** self.max_depth, jnp.float32)
+        for i in range(self.num_trees):
+            if i < trees_used:
+                continue
+            if i < len(feats):
+                feats[i], thrs[i], dirs[i], leaves[i] = (
+                    null_f, null_t, null_f, null_leaf)
+            else:
+                feats.append(null_f)
+                thrs.append(null_t)
+                dirs.append(null_f)
+                leaves.append(null_leaf)
         params["feature"] = jnp.stack(feats)
         params["threshold"] = jnp.stack(thrs)
         params["default_right"] = jnp.stack(dirs)
         params["leaf"] = jnp.stack(leaves)
+        params["trees_used"] = jnp.asarray(np.int32(trees_used))
         return params
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -535,6 +599,22 @@ class GBDT:
         return jnp.where(row_bin == 0, row_dir == 1, row_bin > row_thr)
 
     @functools.partial(jax.jit, static_argnums=0)
+    def _tree_margins_sparse_one(self, feature, threshold, default_right,
+                                 leaf, row_id, findex, ebin, emask,
+                                 rows_template):
+        """One tree's sparse routing (the eval-set incremental path)."""
+        rows = rows_template.shape[0]
+        rid = row_id.astype(jnp.int32)
+        fi = findex.astype(jnp.int32)
+        node = jnp.zeros(rows, jnp.int32)
+        for _ in range(self.max_depth):
+            go_right = self._route_sparse(
+                fi, ebin, emask, rid, feature[node], threshold[node],
+                default_right[node], rows)
+            node = 2 * node + 1 + go_right.astype(jnp.int32)
+        return leaf[node - (2 ** self.max_depth - 1)]
+
+    @functools.partial(jax.jit, static_argnums=0)
     def _margins_sparse(self, feature, threshold, default_right, leaf,
                         base, row_id, findex, ebin, emask):
         """All-trees sparse margins in ONE jitted fori_loop (the sparse
@@ -557,18 +637,33 @@ class GBDT:
     # ---- public API ---------------------------------------------------------
 
     def fit(self, bins: jax.Array, label: jax.Array,
-            weight: Optional[jax.Array] = None) -> dict:
+            weight: Optional[jax.Array] = None,
+            eval_set: Optional[tuple] = None,
+            early_stopping_rounds: int = 0) -> dict:
         """Train the forest on binned features.
 
         bins: u8 [rows, features] (``QuantileBinner.transform`` output; may
         be sharded over a mesh's data axis — tree state stays replicated
-        and XLA inserts the histogram psum).  Returns the forest pytree.
+        and XLA inserts the histogram psum).  ``eval_set``: optional
+        ``(eval_bins, eval_label[, eval_weight])`` held-out set; with
+        ``early_stopping_rounds > 0``, boosting stops after that many
+        rounds without eval-loss improvement and the forest is truncated
+        at the best round (``trees_used``).  Returns the forest pytree.
         """
         label = label.astype(jnp.float32)
         w = (jnp.ones_like(label) if weight is None
              else weight.astype(jnp.float32))
+        eval_margin = eval_label = eval_weight = None
+        if eval_set is not None:
+            eval_bins, eval_label = eval_set[0], eval_set[1].astype(jnp.float32)
+            eval_weight = eval_set[2] if len(eval_set) > 2 else None
+            eval_margin = (lambda f, t, d, leaf:
+                           self._tree_margins(f, t, d, leaf, eval_bins))
         return self._boost(label, w,
-                           lambda g, h, cm: self._build_tree(bins, g, h, cm))
+                           lambda g, h, cm: self._build_tree(bins, g, h, cm),
+                           eval_margin=eval_margin, eval_label=eval_label,
+                           eval_weight=eval_weight,
+                           early_stopping_rounds=early_stopping_rounds)
 
     @staticmethod
     def _entry_arrays(batch):
@@ -588,7 +683,8 @@ class GBDT:
         return batch.row_ids(), batch.index, emask
 
     def fit_batch(self, batch, binner: QuantileBinner,
-                  weight: Optional[jax.Array] = None) -> dict:
+                  weight: Optional[jax.Array] = None,
+                  eval_set=None, early_stopping_rounds: int = 0) -> dict:
         """Train directly on a staged CSR ``PaddedBatch`` — no densify.
 
         The sparse-native XGBoost-hist path: per-entry bins
@@ -609,10 +705,26 @@ class GBDT:
         w = (batch.weight if weight is None else weight).astype(jnp.float32)
         row_id, findex, emask = self._entry_arrays(batch)
         ebin = binner.transform_entries(findex, batch.value)
+        eval_margin = eval_label = eval_weight = None
+        if eval_set is not None:
+            # eval_set: a held-out PaddedBatch (weight-0 rows excluded
+            # from the eval loss via its own weight vector)
+            ev = eval_set
+            ev_rid, ev_fi, ev_mask = self._entry_arrays(ev)
+            ev_bin = binner.transform_entries(ev_fi, ev.value)
+            eval_label = ev.label.astype(jnp.float32)
+            eval_weight = ev.weight
+            eval_margin = (lambda f, t, d, leaf:
+                           self._tree_margins_sparse_one(
+                               f, t, d, leaf, ev_rid, ev_fi, ev_bin,
+                               ev_mask, ev.label))
         return self._boost(
             label, w,
             lambda g, h, cm: self._build_tree_sparse(row_id, findex, ebin,
-                                                     emask, g, h, cm))
+                                                     emask, g, h, cm),
+            eval_margin=eval_margin, eval_label=eval_label,
+            eval_weight=eval_weight,
+            early_stopping_rounds=early_stopping_rounds)
 
     def margins_batch(self, params: dict, batch,
                       binner: QuantileBinner) -> jax.Array:
@@ -662,12 +774,5 @@ class GBDT:
              weight: Optional[jax.Array] = None) -> jax.Array:
         """Mean objective over rows; ``weight`` masks padding rows (weight
         0) exactly as in ``fit`` and the other model families."""
-        m = self.margins(params, bins)
-        if self.objective == "logistic":
-            per = logistic_nll(m, label)
-        else:
-            per = 0.5 * (m - label) ** 2
-        if weight is None:
-            return jnp.mean(per)
-        w = weight.astype(jnp.float32)
-        return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-12)
+        return self._objective_loss(self.margins(params, bins), label,
+                                    weight)

@@ -513,3 +513,72 @@ def test_stochastic_sampling_sparse_path_matches_dense():
     thr = np.asarray(p_dense["threshold"])
     for t in range(feat.shape[0]):
         assert len(set(feat[t][thr[t] < 16].tolist())) <= 4
+
+
+def test_early_stopping_truncates_at_best_round():
+    """eval_set + early_stopping_rounds: boosting stops when held-out loss
+    degrades, the forest is truncated at the best round (null-padded to
+    static shapes), and generalization beats the no-stopping forest."""
+    rng = np.random.default_rng(16)
+    # tiny noisy train set -> aggressive deep trees overfit fast
+    x_tr = rng.uniform(-1, 1, size=(150, 4)).astype(np.float32)
+    noise = rng.random(150) < 0.25
+    y_tr = (((x_tr[:, 0] > 0) ^ noise)).astype(np.float32)
+    x_ev = rng.uniform(-1, 1, size=(2000, 4)).astype(np.float32)
+    y_ev = (x_ev[:, 0] > 0).astype(np.float32)
+    binner = QuantileBinner(num_bins=32).fit(x_tr)
+    b_tr = binner.transform(jnp.asarray(x_tr))
+    b_ev = binner.transform(jnp.asarray(x_ev))
+
+    model = GBDT(num_features=4, num_trees=40, max_depth=6, num_bins=32,
+                 learning_rate=0.8, lambda_=0.0, min_child_weight=1e-6)
+    stopped = model.fit(b_tr, jnp.asarray(y_tr),
+                        eval_set=(b_ev, jnp.asarray(y_ev)),
+                        early_stopping_rounds=3)
+    used = int(stopped["trees_used"])
+    assert 1 <= used < 40, used
+    # static shapes preserved; null trees beyond trees_used
+    assert stopped["feature"].shape == (40, 63)
+    thr = np.asarray(stopped["threshold"])
+    assert (thr[used:] == 32).all(), "trees past best round must be null"
+    assert (np.asarray(stopped["leaf"])[used:] == 0).all()
+
+    full = model.fit(b_tr, jnp.asarray(y_tr))
+    loss_stopped = float(model.loss(stopped, b_ev, jnp.asarray(y_ev)))
+    loss_full = float(model.loss(full, b_ev, jnp.asarray(y_ev)))
+    assert loss_stopped <= loss_full + 1e-6, (loss_stopped, loss_full)
+
+
+def test_early_stopping_sparse_batch_path():
+    """fit_batch drives the same early-stopping machinery via a held-out
+    PaddedBatch."""
+    rng = np.random.default_rng(17)
+    tr, tr_rid, tr_idx, tr_val = _random_padded_batch(rng, 150, 4)
+    ev, ev_rid, ev_idx, ev_val = _random_padded_batch(rng, 1000, 4)
+
+    def relabel(batch, row_id, index, value, noise_p):
+        present0 = np.zeros(batch.label.shape[0], bool)
+        val0 = np.zeros(batch.label.shape[0], np.float32)
+        for r, i, v in zip(row_id, index, value):
+            if i == 0:
+                present0[r] = True
+                val0[r] = v
+        y = (np.where(present0, val0 > 0, 1).astype(np.float32))
+        flip = rng.random(len(y)) < noise_p
+        y = np.where(flip, 1 - y, y)
+        return batch.__class__(**{**{f: getattr(batch, f) for f in
+                                     ("weight", "row_ptr", "index", "value",
+                                      "num_rows", "field")},
+                                  "label": jnp.asarray(y)})
+
+    tr = relabel(tr, tr_rid, tr_idx, tr_val, 0.25)
+    ev = relabel(ev, ev_rid, ev_idx, ev_val, 0.0)
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    binner.fit_sparse(tr_idx, tr_val, num_features=4)
+    model = GBDT(num_features=4, num_trees=30, max_depth=6, num_bins=16,
+                 learning_rate=0.8, lambda_=0.0, min_child_weight=1e-6,
+                 missing_aware=True)
+    stopped = model.fit_batch(tr, binner, eval_set=ev,
+                              early_stopping_rounds=3)
+    assert 1 <= int(stopped["trees_used"]) < 30
+    assert stopped["feature"].shape[0] == 30
