@@ -258,12 +258,17 @@ class GBDT:
                                   self.num_bins, jnp.int32),
             "default_right": jnp.zeros((self.num_trees, n_internal),
                                        jnp.int32),
+            "split_gain": jnp.zeros((self.num_trees, n_internal),
+                                    jnp.float32),
+            "split_cover": jnp.zeros((self.num_trees, n_internal),
+                                     jnp.float32),
             "leaf": jnp.zeros((self.num_trees, 2 ** self.max_depth),
                               jnp.float32),
             "base": jnp.zeros((), jnp.float32),
-            # NOTE: forests checkpointed before trees_used existed have one
-            # fewer leaf; load those with a template that pops this key
-            # (margins()/predict() never require it)
+            # NOTE: forests checkpointed before trees_used / split_gain /
+            # split_cover existed have fewer leaves; load those with a
+            # template that pops the newer keys (margins()/predict() only
+            # require feature/threshold/leaf/base)
             "trees_used": jnp.zeros((), jnp.int32),
         }
 
@@ -286,7 +291,8 @@ class GBDT:
         null = best_gain <= 0.0
         return (jnp.where(null, 0, split_f),
                 jnp.where(null, B, split_b),   # everything routes left
-                jnp.where(null, 0, split_d))
+                jnp.where(null, 0, split_d),
+                jnp.where(null, 0.0, best_gain))  # importance bookkeeping
 
     def _objective_loss(self, margin: jax.Array, label: jax.Array,
                         weight: Optional[jax.Array]) -> jax.Array:
@@ -308,7 +314,7 @@ class GBDT:
         row/column sampling, stacking) for the dense (`fit`) and
         sparse-native (`fit_batch`) input paths.
         ``build_tree(grad, hess, col_mask)`` returns `_build_tree`'s
-        5-tuple.
+        7-tuple.
 
         Early stopping: ``eval_margin(tree_params) -> per-row margins`` on
         a held-out set; when its loss fails to improve for
@@ -340,7 +346,7 @@ class GBDT:
         ev_m = (jnp.full(eval_label.shape, params["base"]) if have_eval
                 else None)
         best_loss, best_t, since_best = float("inf"), 0, 0
-        feats, thrs, dirs, leaves = [], [], [], []
+        feats, thrs, dirs, sgains, scovers, leaves = [], [], [], [], [], []
         for t_idx in range(self.num_trees):
             g, h = self._grad_hess(margin, label)
             w_t = w
@@ -353,11 +359,14 @@ class GBDT:
                 kc = jax.random.fold_in(root_key, 2 * t_idx + 1)
                 sel = jax.random.permutation(kc, self.num_features)[:k_cols]
                 col_mask = jnp.zeros(self.num_features, bool).at[sel].set(True)
-            f, t, d, leaf, leaf_rel = build_tree(g * w_t, h * w_t, col_mask)
+            f, t, d, sg, sc, leaf, leaf_rel = build_tree(g * w_t, h * w_t,
+                                                         col_mask)
             margin = margin + leaf[leaf_rel]
             feats.append(f)
             thrs.append(t)
             dirs.append(d)
+            sgains.append(sg)
+            scovers.append(sc)
             leaves.append(leaf)
             if have_eval:
                 ev_m = ev_m + eval_margin(f, t, d, leaf)
@@ -379,21 +388,26 @@ class GBDT:
         n_internal = 2 ** self.max_depth - 1
         null_f = jnp.zeros(n_internal, jnp.int32)
         null_t = jnp.full(n_internal, self.num_bins, jnp.int32)
+        null_g = jnp.zeros(n_internal, jnp.float32)
         null_leaf = jnp.zeros(2 ** self.max_depth, jnp.float32)
         for i in range(self.num_trees):
             if i < trees_used:
                 continue
             if i < len(feats):
-                feats[i], thrs[i], dirs[i], leaves[i] = (
-                    null_f, null_t, null_f, null_leaf)
+                feats[i], thrs[i], dirs[i] = null_f, null_t, null_f
+                sgains[i], scovers[i], leaves[i] = null_g, null_g, null_leaf
             else:
                 feats.append(null_f)
                 thrs.append(null_t)
                 dirs.append(null_f)
+                sgains.append(null_g)
+                scovers.append(null_g)
                 leaves.append(null_leaf)
         params["feature"] = jnp.stack(feats)
         params["threshold"] = jnp.stack(thrs)
         params["default_right"] = jnp.stack(dirs)
+        params["split_gain"] = jnp.stack(sgains)
+        params["split_cover"] = jnp.stack(scovers)
         params["leaf"] = jnp.stack(leaves)
         params["trees_used"] = jnp.asarray(np.int32(trees_used))
         return params
@@ -402,13 +416,13 @@ class GBDT:
     def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     col_mask: jax.Array
                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
-                               jax.Array]:
+                               jax.Array, jax.Array, jax.Array]:
         """One tree from per-row (grad, hess); levels unrolled under jit.
 
         bins: u8 [rows, features]; grad/hess: f32 [rows] (weight-scaled,
         padding rows carry 0 mass).  Returns (feature, threshold,
-        default_right, leaf, leaf_rel) where leaf_rel is each row's final
-        leaf index.
+        default_right, split_gain, split_cover, leaf, leaf_rel) where
+        leaf_rel is each row's final leaf index.
         """
         F, B = self.num_features, self.num_bins
         rows = bins.shape[0]
@@ -419,6 +433,8 @@ class GBDT:
         features = []
         thresholds = []
         defaults = []
+        gains = []
+        covers = []
         for depth in range(self.max_depth):
             first = 2 ** depth - 1          # heap id of the level's first node
             n_nodes = 2 ** depth
@@ -463,10 +479,13 @@ class GBDT:
                                 hl - hist_h[:, :, 0:1])], axis=3)
             else:
                 gain = split_gain(gl, hl)[..., None]        # dir axis size 1
-            split_f, split_b, split_d = self._pick_splits(gain, col_mask)
+            split_f, split_b, split_d, split_g = self._pick_splits(gain,
+                                                                   col_mask)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
+            gains.append(split_g)
+            covers.append(h_tot[:, 0, 0])   # node hessian mass (any f)
             # route rows: children of heap node n are 2n+1 (left), 2n+2
             row_bin = bins_i[jnp.arange(rows), split_f[rel]]
             go_right = row_bin > split_b[rel]
@@ -485,7 +504,8 @@ class GBDT:
         # leaf_rel doubles as each row's final leaf assignment, so fit()
         # can update margins without re-routing every row through the tree
         return (jnp.concatenate(features), jnp.concatenate(thresholds),
-                jnp.concatenate(defaults), leaf, leaf_rel)
+                jnp.concatenate(defaults), jnp.concatenate(gains),
+                jnp.concatenate(covers), leaf, leaf_rel)
 
     @functools.partial(jax.jit, static_argnums=0)
     def _tree_margins(self, feature: jax.Array, threshold: jax.Array,
@@ -522,7 +542,7 @@ class GBDT:
         >= 1; bin 0 stays empty).
 
         row_id/findex/ebin/emask: [nnz] (emask 0 for padding lanes);
-        grad/hess: [rows] weight-scaled.  Returns the same 5-tuple as
+        grad/hess: [rows] weight-scaled.  Returns the same 7-tuple as
         `_build_tree`.
         """
         F, B = self.num_features, self.num_bins
@@ -536,7 +556,7 @@ class GBDT:
         gh_row = jnp.stack([grad, hess], axis=-1)          # [rows, 2]
 
         node = jnp.zeros(rows, jnp.int32)
-        features, thresholds, defaults = [], [], []
+        features, thresholds, defaults, gains, covers = [], [], [], [], []
         for depth in range(self.max_depth):
             first = 2 ** depth - 1
             n_nodes = 2 ** depth
@@ -566,10 +586,13 @@ class GBDT:
                 [split_gain(gl[..., 0] + miss[:, :, None, 0],
                             gl[..., 1] + miss[:, :, None, 1]),
                  split_gain(gl[..., 0], gl[..., 1])], axis=3)
-            split_f, split_b, split_d = self._pick_splits(gain, col_mask)
+            split_f, split_b, split_d, split_g = self._pick_splits(gain,
+                                                                   col_mask)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
+            gains.append(split_g)
+            covers.append(gh_node[:, 1])
             go_right = self._route_sparse(fi, ebin, emask, rid,
                                           split_f[rel], split_b[rel],
                                           split_d[rel], rows)
@@ -582,7 +605,8 @@ class GBDT:
         leaf = (-self.learning_rate * gh_leaf[:, 0]
                 / (gh_leaf[:, 1] + self.lambda_))
         return (jnp.concatenate(features), jnp.concatenate(thresholds),
-                jnp.concatenate(defaults), leaf, leaf_rel)
+                jnp.concatenate(defaults), jnp.concatenate(gains),
+                jnp.concatenate(covers), leaf, leaf_rel)
 
     @staticmethod
     def _route_sparse(fi, ebin, emask, rid, row_feat, row_thr, row_dir,
@@ -769,6 +793,38 @@ class GBDT:
     def predict(self, params: dict, bins: jax.Array) -> jax.Array:
         m = self.margins(params, bins)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
+
+    def feature_importance(self, params: dict,
+                           kind: str = "gain") -> jax.Array:
+        """Per-feature importance over real splits (the get_score surface).
+
+        kind follows XGBoost's ``importance_type`` semantics: "weight"
+        (split count), "gain"/"cover" (PER-SPLIT AVERAGE gain / hessian
+        mass, XGBoost's default meaning), "total_gain"/"total_cover"
+        (sums).  Returns f32 [num_features]; null splits are excluded.
+        """
+        feat = np.asarray(params["feature"]).reshape(-1)
+        thr = np.asarray(params["threshold"]).reshape(-1)
+        real = thr < self.num_bins
+        counts = np.zeros(self.num_features, np.float64)
+        np.add.at(counts, feat[real], 1.0)
+        if kind == "weight":
+            return jnp.asarray(counts.astype(np.float32))
+        base = kind[len("total_"):] if kind.startswith("total_") else kind
+        if base not in ("gain", "cover"):
+            raise ValueError(f"unknown importance kind '{kind}'")
+        key = f"split_{base}"
+        if key not in params:
+            raise KeyError(
+                f"forest has no '{key}' (checkpointed before importance "
+                "bookkeeping existed); kind='weight' still works")
+        vals = np.asarray(params[key], np.float64).reshape(-1)
+        out = np.zeros(self.num_features, np.float64)
+        np.add.at(out, feat[real], vals[real])
+        if not kind.startswith("total_"):
+            out = np.divide(out, counts, out=np.zeros_like(out),
+                            where=counts > 0)
+        return jnp.asarray(out.astype(np.float32))
 
     def loss(self, params: dict, bins: jax.Array, label: jax.Array,
              weight: Optional[jax.Array] = None) -> jax.Array:

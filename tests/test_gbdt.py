@@ -582,3 +582,42 @@ def test_early_stopping_sparse_batch_path():
                               early_stopping_rounds=3)
     assert 1 <= int(stopped["trees_used"]) < 30
     assert stopped["feature"].shape[0] == 30
+
+
+def test_feature_importance_identifies_informative_features():
+    """gain/weight/cover importance concentrates on the features the label
+    actually depends on (XGBoost get_score parity surface)."""
+    rng = np.random.default_rng(18)
+    x = rng.uniform(-1, 1, size=(3000, 6)).astype(np.float32)
+    y = ((x[:, 1] > 0) ^ (x[:, 4] > 0.2)).astype(np.float32)  # 1 and 4 only
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+    model = GBDT(num_features=6, num_trees=10, max_depth=3, num_bins=32,
+                 learning_rate=0.5)
+    params = model.fit(bins, jnp.asarray(y))
+    for kind in ("gain", "weight", "cover", "total_gain",
+                 "total_cover"):
+        imp = np.asarray(model.feature_importance(params, kind=kind))
+        assert imp.shape == (6,)
+        assert (imp >= 0).all()
+        # the informative pair must rank on top for every kind; only gain
+        # concentrates sharply (weight/cover also count small noise splits)
+        assert set(np.argsort(imp)[-2:].tolist()) == {1, 4}, (kind, imp)
+    gain_imp = np.asarray(model.feature_importance(params,
+                                                   kind="total_gain"))
+    assert gain_imp[1] + gain_imp[4] > 0.9 * gain_imp.sum(), gain_imp
+    # per-split-average semantics (XGBoost importance_type="gain"):
+    # total_gain / weight == gain, elementwise where splits exist
+    w_imp = np.asarray(model.feature_importance(params, kind="weight"))
+    avg = np.asarray(model.feature_importance(params, kind="gain"))
+    np.testing.assert_allclose(avg[w_imp > 0],
+                               gain_imp[w_imp > 0] / w_imp[w_imp > 0],
+                               rtol=1e-5)
+    import pytest
+    with pytest.raises(ValueError):
+        model.feature_importance(params, kind="nope")
+    # forests checkpointed before the bookkeeping: weight still works
+    old = {k: v for k, v in params.items()
+           if k not in ("split_gain", "split_cover")}
+    assert np.asarray(model.feature_importance(old, kind="weight")).sum() > 0
+    with pytest.raises(KeyError):
+        model.feature_importance(old, kind="gain")
