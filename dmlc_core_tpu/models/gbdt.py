@@ -184,6 +184,14 @@ def _squared_grad_hess(margin: jax.Array, label: jax.Array
     return margin - label, jnp.ones_like(margin)
 
 
+def _softmax_ce(margin: jax.Array, label: jax.Array) -> jax.Array:
+    """Per-row cross-entropy from [rows, K] margins and integer labels."""
+    logz = jax.scipy.special.logsumexp(margin, axis=1)
+    picked = jnp.take_along_axis(
+        margin, label.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    return logz - picked
+
+
 class GBDT:
     """Gradient-boosted complete binary trees over binned features.
 
@@ -193,7 +201,9 @@ class GBDT:
     degenerates to the leftmost leaf and unreachable nodes stay zero),
     ``learning_rate`` (shrinkage), ``lambda_`` (L2
     on leaf weights), ``min_child_weight`` (minimum hessian mass per
-    child), ``objective`` ("logistic" or "squared"), ``subsample`` /
+    child), ``objective`` ("logistic", "squared", or "softmax" with
+    ``num_class`` — K trees per round against the shared softmax
+    distribution, XGBoost's multi:softprob), ``subsample`` /
     ``colsample_bytree`` in (0, 1] (stochastic boosting: a per-tree
     Bernoulli row mask folded into the sample weights, and a per-tree
     feature subset masking the split gains — both derived from ``seed``
@@ -226,9 +236,15 @@ class GBDT:
                  missing_aware: bool = False,
                  subsample: float = 1.0,
                  colsample_bytree: float = 1.0,
-                 seed: int = 0):
-        if objective not in ("logistic", "squared"):
+                 seed: int = 0,
+                 num_class: int = 0):
+        if objective not in ("logistic", "squared", "softmax"):
             raise ValueError(f"unknown objective '{objective}'")
+        if objective == "softmax" and num_class < 2:
+            raise ValueError("objective='softmax' needs num_class >= 2")
+        if objective != "softmax" and num_class:
+            raise ValueError("num_class is only valid with "
+                             "objective='softmax'")
         if not 0.0 < subsample <= 1.0:
             raise ValueError("subsample must be in (0, 1]")
         if not 0.0 < colsample_bytree <= 1.0:
@@ -245,6 +261,7 @@ class GBDT:
         self.subsample = subsample
         self.colsample_bytree = colsample_bytree
         self.seed = seed
+        self.num_class = num_class
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -252,19 +269,19 @@ class GBDT:
 
     def init(self) -> dict:
         n_internal = 2 ** self.max_depth - 1
+        # softmax grows K trees per round (round-major: tree i -> class i%K)
+        total = self.num_trees * max(self.num_class, 1)
         return {
-            "feature": jnp.zeros((self.num_trees, n_internal), jnp.int32),
-            "threshold": jnp.full((self.num_trees, n_internal),
+            "feature": jnp.zeros((total, n_internal), jnp.int32),
+            "threshold": jnp.full((total, n_internal),
                                   self.num_bins, jnp.int32),
-            "default_right": jnp.zeros((self.num_trees, n_internal),
-                                       jnp.int32),
-            "split_gain": jnp.zeros((self.num_trees, n_internal),
-                                    jnp.float32),
-            "split_cover": jnp.zeros((self.num_trees, n_internal),
-                                     jnp.float32),
-            "leaf": jnp.zeros((self.num_trees, 2 ** self.max_depth),
-                              jnp.float32),
-            "base": jnp.zeros((), jnp.float32),
+            "default_right": jnp.zeros((total, n_internal), jnp.int32),
+            "split_gain": jnp.zeros((total, n_internal), jnp.float32),
+            "split_cover": jnp.zeros((total, n_internal), jnp.float32),
+            "leaf": jnp.zeros((total, 2 ** self.max_depth), jnp.float32),
+            "base": (jnp.zeros(self.num_class, jnp.float32)
+                     if self.objective == "softmax"
+                     else jnp.zeros((), jnp.float32)),
             # NOTE: forests checkpointed before trees_used / split_gain /
             # split_cover existed have fewer leaves; load those with a
             # template that pops the newer keys (margins()/predict() only
@@ -276,7 +293,8 @@ class GBDT:
         """Flat argmax over a [nodes, F, B, n_dir] gain array plus
         null-split encoding; shared by the dense and sparse builders.
         ``col_mask`` [F] disables unsampled features (colsample_bytree).
-        Returns (split_f, split_b, split_d)."""
+        Returns (split_f, split_b, split_d, split_gain) with nulls encoded
+        as (0, num_bins, 0, 0.0)."""
         n_nodes = gain.shape[0]
         B = self.num_bins
         n_dir = gain.shape[3]
@@ -297,9 +315,12 @@ class GBDT:
     def _objective_loss(self, margin: jax.Array, label: jax.Array,
                         weight: Optional[jax.Array]) -> jax.Array:
         """Weighted mean objective from margins (shared by loss() and the
-        early-stopping eval)."""
+        early-stopping eval).  softmax: margin is [rows, K], label integer
+        class ids."""
         if self.objective == "logistic":
             per = logistic_nll(margin, label)
+        elif self.objective == "softmax":
+            per = _softmax_ce(margin, label)
         else:
             per = 0.5 * (margin - label) ** 2
         if weight is None:
@@ -335,13 +356,7 @@ class GBDT:
         params["base"] = base.astype(jnp.float32)
 
         margin = jnp.full(label.shape, params["base"])
-        # stochastic GBM sampling: per-tree row mask folds into the weights
-        # (routing still sees every row), per-tree column mask disables
-        # unsampled features' gains.  Masks derive from (seed, tree index)
-        # only, so sharded and multi-host runs sample identically.
         root_key = jax.random.PRNGKey(self.seed)
-        k_cols = max(1, int(round(self.colsample_bytree * self.num_features)))
-        full_cols = jnp.ones(self.num_features, bool)
         have_eval = eval_margin is not None
         ev_m = (jnp.full(eval_label.shape, params["base"]) if have_eval
                 else None)
@@ -349,16 +364,7 @@ class GBDT:
         feats, thrs, dirs, sgains, scovers, leaves = [], [], [], [], [], []
         for t_idx in range(self.num_trees):
             g, h = self._grad_hess(margin, label)
-            w_t = w
-            if self.subsample < 1.0:
-                kr = jax.random.fold_in(root_key, 2 * t_idx)
-                w_t = w * jax.random.bernoulli(
-                    kr, self.subsample, w.shape).astype(jnp.float32)
-            col_mask = full_cols
-            if self.colsample_bytree < 1.0:
-                kc = jax.random.fold_in(root_key, 2 * t_idx + 1)
-                sel = jax.random.permutation(kc, self.num_features)[:k_cols]
-                col_mask = jnp.zeros(self.num_features, bool).at[sel].set(True)
+            w_t, col_mask = self._tree_sampling(root_key, t_idx, w)
             f, t, d, sg, sc, leaf, leaf_rel = build_tree(g * w_t, h * w_t,
                                                          col_mask)
             margin = margin + leaf[leaf_rel]
@@ -382,15 +388,42 @@ class GBDT:
         # an eval_set alone is monitoring, not a pruning instruction
         stop_on = have_eval and early_stopping_rounds > 0
         trees_used = best_t if stop_on else len(feats)
-        # static [num_trees, ...] shapes: trees past trees_used (stopped
-        # early or worse-than-best) become null trees — every row routes
-        # left to leaf 0 whose weight is 0
+        return self._stack_forest(params, feats, thrs, dirs, sgains,
+                                  scovers, leaves, trees_used,
+                                  self.num_trees)
+
+    def _tree_sampling(self, root_key, t_idx: int, w: jax.Array):
+        """Per-tree stochastic-GBM masks, shared by every boosting driver:
+        a Bernoulli row mask folded into the weights (routing still sees
+        all rows) and a feature subset for the gains.  Derived from
+        (seed, tree index) only, so sharded / multi-host runs sample
+        identically."""
+        w_t = w
+        if self.subsample < 1.0:
+            kr = jax.random.fold_in(root_key, 2 * t_idx)
+            w_t = w * jax.random.bernoulli(
+                kr, self.subsample, w.shape).astype(jnp.float32)
+        if self.colsample_bytree < 1.0:
+            kc = jax.random.fold_in(root_key, 2 * t_idx + 1)
+            k_cols = max(1, int(round(self.colsample_bytree
+                                      * self.num_features)))
+            sel = jax.random.permutation(kc, self.num_features)[:k_cols]
+            col_mask = jnp.zeros(self.num_features, bool).at[sel].set(True)
+        else:
+            col_mask = jnp.ones(self.num_features, bool)
+        return w_t, col_mask
+
+    def _stack_forest(self, params, feats, thrs, dirs, sgains, scovers,
+                      leaves, trees_used: int, total: int) -> dict:
+        """Null-pad the per-tree lists to ``total`` static slots (trees past
+        trees_used — stopped early or worse-than-best — route every row
+        left to leaf 0 whose weight is 0) and stack into the pytree."""
         n_internal = 2 ** self.max_depth - 1
         null_f = jnp.zeros(n_internal, jnp.int32)
         null_t = jnp.full(n_internal, self.num_bins, jnp.int32)
         null_g = jnp.zeros(n_internal, jnp.float32)
         null_leaf = jnp.zeros(2 ** self.max_depth, jnp.float32)
-        for i in range(self.num_trees):
+        for i in range(total):
             if i < trees_used:
                 continue
             if i < len(feats):
@@ -411,6 +444,72 @@ class GBDT:
         params["leaf"] = jnp.stack(leaves)
         params["trees_used"] = jnp.asarray(np.int32(trees_used))
         return params
+
+    def _boost_multi(self, label: jax.Array, w: jax.Array, build_tree,
+                     eval_margin=None, eval_label=None, eval_weight=None,
+                     early_stopping_rounds: int = 0) -> dict:
+        """Softmax boosting: K one-vs-rest trees per round against the
+        shared softmax distribution (XGBoost multi:softprob).  Tree i
+        belongs to class ``i % K`` (round-major); early stopping operates
+        on whole rounds against the held-out cross-entropy."""
+        K = self.num_class
+        params = self.init()
+        label = label.astype(jnp.int32)
+        if bool(jnp.any((label < 0) | (label >= K))):
+            # out-of-range classes would silently train a corrupted forest
+            # (zero one-hot rows, clamped CE indices)
+            raise ValueError(
+                f"softmax labels must be integers in [0, {K}); got range "
+                f"[{int(jnp.min(label))}, {int(jnp.max(label))}]")
+        sum_w = jnp.maximum(jnp.sum(w), 1e-12)
+        onehot = jax.nn.one_hot(label, K, dtype=jnp.float32)
+        prior = jnp.clip(jnp.sum(onehot * w[:, None], axis=0) / sum_w,
+                         1e-6, 1.0)
+        params["base"] = jnp.log(prior)
+
+        margin = jnp.broadcast_to(params["base"], (label.shape[0], K))
+        have_eval = eval_margin is not None
+        ev_m = (jnp.broadcast_to(params["base"],
+                                 (eval_label.shape[0], K)) if have_eval
+                else None)
+        best_loss, best_round, since_best = float("inf"), 0, 0
+        root_key = jax.random.PRNGKey(self.seed)
+        feats, thrs, dirs, sgains, scovers, leaves = [], [], [], [], [], []
+        for r in range(self.num_trees):
+            p = jax.nn.softmax(margin, axis=1)
+            if have_eval:
+                ev_round = []
+            for k in range(K):
+                t_idx = r * K + k
+                g = (p[:, k] - onehot[:, k])
+                h = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-16)
+                w_t, col_mask = self._tree_sampling(root_key, t_idx, w)
+                f, t, d, sg, sc, leaf, leaf_rel = build_tree(
+                    g * w_t, h * w_t, col_mask)
+                margin = margin.at[:, k].add(leaf[leaf_rel])
+                feats.append(f)
+                thrs.append(t)
+                dirs.append(d)
+                sgains.append(sg)
+                scovers.append(sc)
+                leaves.append(leaf)
+                if have_eval:
+                    ev_round.append(eval_margin(f, t, d, leaf))
+            if have_eval:
+                ev_m = ev_m + jnp.stack(ev_round, axis=1)
+                loss = float(self._objective_loss(ev_m, eval_label,
+                                                  eval_weight))
+                if loss < best_loss:
+                    best_loss, best_round, since_best = loss, r + 1, 0
+                elif early_stopping_rounds > 0:
+                    since_best += 1
+                    if since_best >= early_stopping_rounds:
+                        break
+        stop_on = have_eval and early_stopping_rounds > 0
+        trees_used = (best_round * K if stop_on else len(feats))
+        return self._stack_forest(params, feats, thrs, dirs, sgains,
+                                  scovers, leaves, trees_used,
+                                  self.num_trees * K)
 
     @functools.partial(jax.jit, static_argnums=0)
     def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -683,11 +782,13 @@ class GBDT:
             eval_weight = eval_set[2] if len(eval_set) > 2 else None
             eval_margin = (lambda f, t, d, leaf:
                            self._tree_margins(f, t, d, leaf, eval_bins))
-        return self._boost(label, w,
-                           lambda g, h, cm: self._build_tree(bins, g, h, cm),
-                           eval_margin=eval_margin, eval_label=eval_label,
-                           eval_weight=eval_weight,
-                           early_stopping_rounds=early_stopping_rounds)
+        driver = (self._boost_multi if self.objective == "softmax"
+                  else self._boost)
+        return driver(label, w,
+                      lambda g, h, cm: self._build_tree(bins, g, h, cm),
+                      eval_margin=eval_margin, eval_label=eval_label,
+                      eval_weight=eval_weight,
+                      early_stopping_rounds=early_stopping_rounds)
 
     @staticmethod
     def _entry_arrays(batch):
@@ -742,7 +843,9 @@ class GBDT:
                            self._tree_margins_sparse_one(
                                f, t, d, leaf, ev_rid, ev_fi, ev_bin,
                                ev_mask, ev.label))
-        return self._boost(
+        driver = (self._boost_multi if self.objective == "softmax"
+                  else self._boost)
+        return driver(
             label, w,
             lambda g, h, cm: self._build_tree_sparse(row_id, findex, ebin,
                                                      emask, g, h, cm),
@@ -769,8 +872,44 @@ class GBDT:
                                     default_right, params["leaf"], base,
                                     row_id, findex, ebin, emask)
 
+    def margins_multi_batch(self, params: dict, batch,
+                            binner: QuantileBinner) -> jax.Array:
+        """[rows, K] softmax margins over a staged CSR batch."""
+        if not (self.missing_aware and binner.missing_aware):
+            raise ValueError("margins_multi_batch requires "
+                             "missing_aware=True on both sides")
+        row_id, findex, emask = self._entry_arrays(batch)
+        ebin = binner.transform_entries(findex, batch.value)
+        default_right = params.get("default_right")
+        if default_right is None:
+            default_right = jnp.zeros_like(params["feature"])
+        return self._margins_multi_sparse_impl(
+            params["feature"], params["threshold"], default_right,
+            params["leaf"], params["base"], row_id, findex, ebin, emask,
+            batch.label)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _margins_multi_sparse_impl(self, feature, threshold, default_right,
+                                   leaf, base, row_id, findex, ebin, emask,
+                                   rows_template) -> jax.Array:
+        K = self.num_class
+        rows = rows_template.shape[0]
+
+        def body(i, m):
+            tm = self._tree_margins_sparse_one(
+                feature[i], threshold[i], default_right[i], leaf[i],
+                row_id, findex, ebin, emask, rows_template)
+            return m + tm[:, None] * jax.nn.one_hot(i % K, K,
+                                                    dtype=jnp.float32)
+
+        init = jnp.broadcast_to(base, (rows, K))
+        return jax.lax.fori_loop(0, feature.shape[0], body, init)
+
     def predict_batch(self, params: dict, batch,
                       binner: QuantileBinner) -> jax.Array:
+        if self.objective == "softmax":
+            return jax.nn.softmax(
+                self.margins_multi_batch(params, batch, binner), axis=1)
         m = self.margins_batch(params, batch, binner)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
 
@@ -790,7 +929,36 @@ class GBDT:
         init = jnp.full(bins.shape[:1], params["base"])
         return jax.lax.fori_loop(0, self.num_trees, body, init)
 
+    @functools.partial(jax.jit, static_argnums=0)
+    def _margins_multi_impl(self, feature, threshold, default_right, leaf,
+                            base, bins) -> jax.Array:
+        """All softmax trees in ONE jitted fori_loop: tree i accumulates
+        into class column i % K via a one-hot outer product (dynamic
+        column updates are not fori-friendly)."""
+        K = self.num_class
+        rows = bins.shape[0]
+
+        def body(i, m):
+            tm = self._tree_margins(feature[i], threshold[i],
+                                    default_right[i], leaf[i], bins)
+            return m + tm[:, None] * jax.nn.one_hot(i % K, K,
+                                                    dtype=jnp.float32)
+
+        init = jnp.broadcast_to(base, (rows, K))
+        return jax.lax.fori_loop(0, feature.shape[0], body, init)
+
+    def margins_multi(self, params: dict, bins: jax.Array) -> jax.Array:
+        """[rows, K] softmax margins (tree i contributes to class i % K)."""
+        default_right = params.get("default_right")
+        if default_right is None:
+            default_right = jnp.zeros_like(params["feature"])
+        return self._margins_multi_impl(params["feature"],
+                                        params["threshold"], default_right,
+                                        params["leaf"], params["base"], bins)
+
     def predict(self, params: dict, bins: jax.Array) -> jax.Array:
+        if self.objective == "softmax":
+            return jax.nn.softmax(self.margins_multi(params, bins), axis=1)
         m = self.margins(params, bins)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
 
@@ -830,5 +998,7 @@ class GBDT:
              weight: Optional[jax.Array] = None) -> jax.Array:
         """Mean objective over rows; ``weight`` masks padding rows (weight
         0) exactly as in ``fit`` and the other model families."""
-        return self._objective_loss(self.margins(params, bins), label,
-                                    weight)
+        m = (self.margins_multi(params, bins)
+             if self.objective == "softmax"
+             else self.margins(params, bins))
+        return self._objective_loss(m, label, weight)

@@ -621,3 +621,86 @@ def test_feature_importance_identifies_informative_features():
     assert np.asarray(model.feature_importance(old, kind="weight")).sum() > 0
     with pytest.raises(KeyError):
         model.feature_importance(old, kind="gain")
+
+
+def test_softmax_multiclass():
+    """objective='softmax': K trees per round against the shared softmax
+    distribution (multi:softprob); learns a 3-class nonlinear rule,
+    probabilities normalize, early stopping works on whole rounds."""
+    rng = np.random.default_rng(19)
+    x = rng.uniform(-1, 1, size=(4000, 4)).astype(np.float32)
+    y = np.where(x[:, 0] + x[:, 1] > 0.4, 2,
+                 np.where(x[:, 0] * x[:, 2] > 0, 1, 0)).astype(np.float32)
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+    model = GBDT(num_features=4, num_trees=12, max_depth=4, num_bins=32,
+                 learning_rate=0.4, objective="softmax", num_class=3)
+    params = model.fit(bins, jnp.asarray(y))
+    assert params["feature"].shape[0] == 12 * 3
+    assert params["base"].shape == (3,)
+    probs = np.asarray(model.predict(params, bins))
+    assert probs.shape == (4000, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+    acc = float(np.mean(probs.argmax(axis=1) == y))
+    assert acc > 0.92, acc
+    # out-of-range labels fail loudly instead of training corrupted forests
+    import pytest
+    with pytest.raises(ValueError, match="softmax labels"):
+        model.fit(bins, jnp.asarray(np.where(y == 2, 3, y)))
+    # loss is mean cross-entropy and improves over the prior-only model
+    base_only = model.init()
+    base_only["base"] = params["base"]
+    full_loss = float(model.loss(params, bins, jnp.asarray(y)))
+    prior_loss = float(model.loss(base_only, bins, jnp.asarray(y)))
+    assert full_loss < 0.5 * prior_loss
+
+    # early stopping truncates at a whole-round boundary
+    x_ev = rng.uniform(-1, 1, size=(1500, 4)).astype(np.float32)
+    y_ev = np.where(x_ev[:, 0] + x_ev[:, 1] > 0.4, 2,
+                    np.where(x_ev[:, 0] * x_ev[:, 2] > 0, 1, 0)
+                    ).astype(np.float32)
+    binner2 = QuantileBinner(num_bins=32).fit(x[:200])
+    b_tr = binner2.transform(jnp.asarray(x[:200]))
+    b_ev = binner2.transform(jnp.asarray(x_ev))
+    noisy = GBDT(num_features=4, num_trees=25, max_depth=6, num_bins=32,
+                 learning_rate=0.9, lambda_=0.0, min_child_weight=1e-6,
+                 objective="softmax", num_class=3)
+    flip = rng.random(200) < 0.3
+    y_tr = np.where(flip, (y[:200] + 1) % 3, y[:200]).astype(np.float32)
+    stopped = noisy.fit(b_tr, jnp.asarray(y_tr),
+                        eval_set=(b_ev, jnp.asarray(y_ev)),
+                        early_stopping_rounds=3)
+    used = int(stopped["trees_used"])
+    assert used % 3 == 0 and 3 <= used < 75, used
+
+
+def test_softmax_sparse_batch_path():
+    """fit_batch + softmax: the sparse builder drives the multiclass loop."""
+    rng = np.random.default_rng(20)
+    batch, row_id, index, value = _random_padded_batch(rng, 1024, 5)
+    from dmlc_core_tpu.ops.sparse import csr_to_dense_missing
+    dense = np.asarray(csr_to_dense_missing(
+        jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id), 1024, 5))
+    f0 = np.nan_to_num(dense[:, 0], nan=-9.0)
+    y = np.where(f0 > 0.5, 2, np.where(f0 > -1.5, 1, 0)).astype(np.float32)
+    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
+                                  ("weight", "row_ptr", "index", "value",
+                                   "num_rows", "field")},
+                               "label": jnp.asarray(y)})
+    binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
+    model = GBDT(num_features=5, num_trees=8, max_depth=3, num_bins=16,
+                 learning_rate=0.5, objective="softmax", num_class=3,
+                 missing_aware=True)
+    params = model.fit_batch(batch, binner)
+    ref = model.fit(binner.transform(jnp.asarray(dense)), jnp.asarray(y))
+    # prediction-level parity (a couple of near-tie cuts may flip on the
+    # float dust between the two histogram formulations; the semantic
+    # contract is agreement of the predicted distributions)
+    probs_sparse = np.asarray(model.predict_batch(params, batch, binner))
+    probs_dense = np.asarray(model.predict(
+        ref, binner.transform(jnp.asarray(dense))))
+    assert probs_sparse.shape == (1024, 3)
+    np.testing.assert_allclose(probs_sparse.sum(axis=1), 1.0, rtol=1e-5)
+    agree = float(np.mean(probs_sparse.argmax(1) == probs_dense.argmax(1)))
+    assert agree > 0.97, agree
+    acc = float(np.mean(probs_sparse.argmax(axis=1) == y))
+    assert acc > 0.9, acc
