@@ -92,6 +92,7 @@ typedef struct {
   const int32_t* index;     /* [nnz_pad] */
   const float* value;       /* [nnz_pad], 0 on padding slots */
   const int32_t* field;     /* [nnz_pad] or NULL */
+  const int32_t* qid;       /* [batch_size] query ids or NULL */
 } DmlcTpuStagedBatchC;
 
 /*! \brief one fixed-shape padded COO batch in a single OWNED allocation.
@@ -118,16 +119,19 @@ typedef struct {
   uint64_t index_off;    /* int32 [nnz_pad] */
   uint64_t value_off;    /* float [nnz_pad] */
   uint64_t field_off;    /* int32 [nnz_pad]; UINT64_MAX when absent */
+  uint64_t qid_off;      /* int32 [batch_size]; UINT64_MAX when absent */
 } DmlcTpuStagedBatchOwnedC;
 
 /*! \brief nnz_max: 0 = unbounded (nnz padded to nnz_bucket multiples); else
  *  a hard per-batch nonzero cap — rows that would exceed it spill into the
  *  next batch and every batch has nnz_pad == nnz_max (fully fixed shapes,
  *  required for multi-host global-array staging) */
+/* with_qid stages the per-row query ids (libsvm qid: tokens) alongside
+ * label/weight - the ranking-objective column */
 int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                const char* format, uint64_t batch_size,
                                uint64_t nnz_bucket, uint64_t nnz_max,
-                               int with_field,
+                               int with_field, int with_qid,
                                DmlcTpuStagedBatcherHandle* out);
 /*! \brief next batch (1/0/-1); buffers stay valid until the following call
  *  to Next/BeforeFirst/Free on this handle */

@@ -359,7 +359,7 @@ void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle) {
 int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                const char* format, uint64_t batch_size,
                                uint64_t nnz_bucket, uint64_t nnz_max,
-                               int with_field,
+                               int with_field, int with_qid,
                                DmlcTpuStagedBatcherHandle* out) {
   return Guard([&] {
     auto ctx = std::make_unique<BatcherCtx>();
@@ -367,7 +367,8 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
     // column packs with a straight memcpy (see staged_batcher.h)
     auto parser = dmlctpu::Parser<uint32_t, float>::Create(uri, part, num_parts, format);
     ctx->batcher = std::make_unique<dmlctpu::data::StagedBatcher>(
-        std::move(parser), batch_size, nnz_bucket, with_field != 0, nnz_max);
+        std::move(parser), batch_size, nnz_bucket, with_field != 0, nnz_max,
+        with_qid != 0);
     ctx->batch_size = batch_size;
     *out = ctx.release();
     return 0;
@@ -390,6 +391,7 @@ void FillOwnedC(const dmlctpu::data::StagedArena* a, void* batch,
   out->index_off = a->index_off;
   out->value_off = a->value_off;
   out->field_off = a->with_field ? a->field_off : ~static_cast<uint64_t>(0);
+  out->qid_off = a->with_qid ? a->qid_off : ~static_cast<uint64_t>(0);
 }
 }  // namespace
 
@@ -411,6 +413,7 @@ int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBat
     out->index = a->index();
     out->value = a->value();
     out->field = a->with_field ? a->field() : nullptr;
+    out->qid = a->with_qid ? a->qid() : nullptr;
     return 1;
   });
 }

@@ -53,8 +53,9 @@ struct StagedArena {
   size_t batch_size = 0;  // rows capacity (fixed for the batcher)
   size_t nnz_cap = 0;     // index/value (/field) capacity
   bool with_field = false;
-  size_t label_off = 0, weight_off = 0, row_ptr_off = 0, index_off = 0,
-         value_off = 0, field_off = 0;
+  bool with_qid = false;
+  size_t label_off = 0, weight_off = 0, qid_off = 0, row_ptr_off = 0,
+         index_off = 0, value_off = 0, field_off = 0;
   // per-batch metadata (rewritten on every reuse)
   uint32_t num_rows = 0;
   size_t nnz_pad = 0;
@@ -68,18 +69,22 @@ struct StagedArena {
   int32_t* index() { return reinterpret_cast<int32_t*>(base + index_off); }
   float* value() { return reinterpret_cast<float*>(base + value_off); }
   int32_t* field() { return reinterpret_cast<int32_t*>(base + field_off); }
+  int32_t* qid() { return reinterpret_cast<int32_t*>(base + qid_off); }
 
   static std::unique_ptr<StagedArena> Make(size_t batch_size, size_t nnz_cap,
-                                           bool with_field) {
+                                           bool with_field, bool with_qid) {
     auto a = std::unique_ptr<StagedArena>(new StagedArena());
     a->batch_size = batch_size;
     a->nnz_cap = nnz_cap;
     a->with_field = with_field;
+    a->with_qid = with_qid;
     auto align64 = [](size_t x) { return (x + 63) & ~static_cast<size_t>(63); };
     // fixed-size components first so their offsets are reuse-stable
     a->label_off = 0;
     a->weight_off = align64(a->label_off + batch_size * 4);
-    a->row_ptr_off = align64(a->weight_off + batch_size * 4);
+    a->qid_off = align64(a->weight_off + batch_size * 4);
+    a->row_ptr_off = align64(
+        a->qid_off + (with_qid ? batch_size * 4 : 0));
     a->index_off = align64(a->row_ptr_off + (batch_size + 1) * 4);
     a->value_off = align64(a->index_off + nnz_cap * 4);
     a->field_off = align64(a->value_off + nnz_cap * 4);
@@ -100,7 +105,7 @@ class StagedArenaPool {
   explicit StagedArenaPool(size_t max_free) : max_free_(max_free) {}
 
   std::unique_ptr<StagedArena> Acquire(size_t batch_size, size_t min_nnz_cap,
-                                       bool with_field) {
+                                       bool with_field, bool with_qid) {
     {
       std::lock_guard<std::mutex> lk(mu_);
       // prefer the largest pooled arena: packing grows capacity adaptively,
@@ -108,6 +113,7 @@ class StagedArenaPool {
       auto best = free_.end();
       for (auto it = free_.begin(); it != free_.end(); ++it) {
         if ((*it)->batch_size == batch_size && (*it)->with_field == with_field &&
+            (*it)->with_qid == with_qid &&
             (best == free_.end() || (*it)->nnz_cap > (*best)->nnz_cap)) {
           best = it;
         }
@@ -118,7 +124,7 @@ class StagedArenaPool {
         return a;
       }
     }
-    return StagedArena::Make(batch_size, min_nnz_cap, with_field);
+    return StagedArena::Make(batch_size, min_nnz_cap, with_field, with_qid);
   }
 
   void Release(std::unique_ptr<StagedArena> a) {
@@ -166,12 +172,13 @@ class StagedBatcherT {
    */
   StagedBatcherT(std::unique_ptr<Parser<IndexType, float>> parser,
                  size_t batch_size, size_t nnz_bucket, bool with_field,
-                 size_t nnz_max = 0)
+                 size_t nnz_max = 0, bool with_qid = false)
       : parser_(std::move(parser)),
         batch_size_(batch_size),
         nnz_bucket_(std::max<size_t>(nnz_bucket, 1)),
         nnz_max_(nnz_max),
         with_field_(with_field),
+        with_qid_(with_qid),
         pool_(std::make_shared<StagedArenaPool>(kIterDepth + 2)),
         iter_(kIterDepth) {
     parser_->BeforeFirst();
@@ -220,7 +227,7 @@ class StagedBatcherT {
     Slot* slot = *cell;
     if (slot->arena == nullptr) {
       slot->arena = pool_->Acquire(batch_size_, BucketRound(last_nnz_ + 1),
-                                   with_field_);
+                                   with_field_, with_qid_);
     }
     StagedArena* a = slot->arena.get();
     const size_t B = batch_size_;
@@ -282,9 +289,10 @@ class StagedBatcherT {
   void Grow(Slot* slot, size_t packed_nnz, size_t need_nnz) {
     StagedArena* old = slot->arena.get();
     size_t new_cap = BucketRound(std::max(need_nnz, old->nnz_cap * 2));
-    auto bigger = pool_->Acquire(batch_size_, new_cap, with_field_);
+    auto bigger = pool_->Acquire(batch_size_, new_cap, with_field_, with_qid_);
     std::memcpy(bigger->label(), old->label(), batch_size_ * 4);
     std::memcpy(bigger->weight(), old->weight(), batch_size_ * 4);
+    if (with_qid_) std::memcpy(bigger->qid(), old->qid(), batch_size_ * 4);
     std::memcpy(bigger->row_ptr(), old->row_ptr(), (batch_size_ + 1) * 4);
     std::memcpy(bigger->index(), old->index(), packed_nnz * 4);
     std::memcpy(bigger->value(), old->value(), packed_nnz * 4);
@@ -304,6 +312,20 @@ class StagedBatcherT {
       std::memcpy(a->weight() + row_base, b.weight + cur_row_, take * sizeof(float));
     } else {
       std::fill(a->weight() + row_base, a->weight() + row_base + take, 1.0f);
+    }
+    if (with_qid_) {
+      int32_t* q = a->qid() + row_base;
+      if (b.qid != nullptr) {
+        for (size_t r = 0; r < take; ++r) {
+          // the staged device column is int32: wrapping would silently
+          // merge distinct ranking groups, so fail loudly like feature ids
+          TCHECK_LE(b.qid[cur_row_ + r], 2147483647u)
+              << "qid >= 2^31 in staged batch; the device layout is int32";
+          q[r] = static_cast<int32_t>(b.qid[cur_row_ + r]);
+        }
+      } else {
+        std::fill(q, q + take, 0);
+      }
     }
     CopyIndex(a->index() + nnz_base, b.index + b.offset[0] + lo, nnz);
     if (b.value != nullptr) {
@@ -345,6 +367,7 @@ class StagedBatcherT {
     StagedArena* a = slot->arena.get();
     std::fill(a->label() + rows, a->label() + B, 0.0f);
     std::fill(a->weight() + rows, a->weight() + B, 0.0f);
+    if (with_qid_) std::fill(a->qid() + rows, a->qid() + B, 0);
     std::fill(a->index() + nnz, a->index() + nnz_pad, 0);
     std::fill(a->value() + nnz, a->value() + nnz_pad, 0.0f);
     int32_t* row_ptr = a->row_ptr();
@@ -377,6 +400,7 @@ class StagedBatcherT {
   size_t nnz_bucket_;
   size_t nnz_max_;
   bool with_field_;
+  bool with_qid_ = false;
   RowBlock<IndexType, float> block_{};
   size_t cur_row_ = 0;
   bool have_block_ = false;

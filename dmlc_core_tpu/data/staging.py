@@ -197,6 +197,7 @@ class PaddedBatch:
     value: jax.Array    # f32 [nnz_pad]
     num_rows: jax.Array  # i32 [] true (unpadded) row count
     field: Optional[jax.Array] = None  # i32 [nnz_pad] (libfm)
+    qid: Optional[jax.Array] = None    # i32 [batch] query ids (ranking)
 
     @property
     def batch_size(self) -> int:
@@ -216,7 +217,7 @@ class PaddedBatch:
 jax.tree_util.register_dataclass(
     PaddedBatch,
     data_fields=["label", "weight", "row_ptr", "index", "value", "num_rows",
-                 "field"],
+                 "field", "qid"],
     meta_fields=[])
 
 
@@ -232,6 +233,7 @@ class _StagedBatchC(ctypes.Structure):
         ("index", ctypes.POINTER(ctypes.c_int32)),
         ("value", ctypes.POINTER(ctypes.c_float)),
         ("field", ctypes.POINTER(ctypes.c_int32)),
+        ("qid", ctypes.POINTER(ctypes.c_int32)),
     ]
 
 
@@ -250,6 +252,7 @@ class _StagedBatchOwnedC(ctypes.Structure):
         ("index_off", ctypes.c_uint64),
         ("value_off", ctypes.c_uint64),
         ("field_off", ctypes.c_uint64),
+        ("qid_off", ctypes.c_uint64),
     ]
 
 
@@ -263,7 +266,7 @@ def _declare_batcher_sig():
     L.DmlcTpuStagedBatcherCreate.argtypes = [
         ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_void_p)]
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
     L.DmlcTpuStagedBatcherNext.argtypes = [ctypes.c_void_p,
                                            ctypes.POINTER(_StagedBatchC)]
     L.DmlcTpuStagedBatcherNextOwned.argtypes = [
@@ -528,18 +531,20 @@ class DeviceStagingIter:
     def __init__(self, uri: str, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
                  part: int = 0, num_parts: int = 1, format: str = "auto",  # noqa: A002
                  sharding=None, with_field: bool = False, prefetch: int = 2,
-                 nnz_max: int = 0, log_every: int = 0):
+                 nnz_max: int = 0, log_every: int = 0,
+                 with_qid: bool = False):
         self._lib = _declare_batcher_sig()
         self._handle = ctypes.c_void_p()
         check(self._lib.DmlcTpuStagedBatcherCreate(
             uri.encode(), part, num_parts, format.encode(),
-            batch_size, nnz_bucket, nnz_max, int(with_field),
+            batch_size, nnz_bucket, nnz_max, int(with_field), int(with_qid),
             ctypes.byref(self._handle)))
         self._batch_size = batch_size
         self._nnz_max = nnz_max
         self._sharding = sharding
         self._prefetch = max(prefetch, 1)
         self._with_field = with_field
+        self._with_qid = with_qid
         self._max_index = -1
         self.batches_staged = 0
         # throughput self-reporting cadence in batches (0 = off); parity with
@@ -590,23 +595,28 @@ class DeviceStagingIter:
     def _stage_inner(self, c: _StagedBatchOwnedC) -> PaddedBatch:
         w = self._wrap_owned(c)
         with_field = w["field"] is not None
+        with_qid = w["qid"] is not None
         num_rows = np.int32(w["num_rows"])
-        leaves = (w["label"], w["weight"], w["row_ptr"], w["index"],
-                  w["value"], num_rows) + ((w["field"],) if with_field else ())
+        leaves = ((w["label"], w["weight"], w["row_ptr"], w["index"],
+                   w["value"], num_rows)
+                  + ((w["field"],) if with_field else ())
+                  + ((w["qid"],) if with_qid else ()))
         if self._sharding is None:
             # one batched dispatch for the whole pytree
             staged = jax.device_put(leaves)
         else:
             repl = self._replicated_sharding()
-            shardings = (self._sharding, self._sharding, repl,
-                         self._sharding, self._sharding, repl) + (
-                             (self._sharding,) if with_field else ())
+            shardings = ((self._sharding, self._sharding, repl,
+                          self._sharding, self._sharding, repl)
+                         + ((self._sharding,) if with_field else ())
+                         + ((self._sharding,) if with_qid else ()))
             staged = jax.device_put(leaves, shardings)
 
         batch = PaddedBatch(
             label=staged[0], weight=staged[1], row_ptr=staged[2],
             index=staged[3], value=staged[4], num_rows=staged[5],
-            field=staged[6] if with_field else None)
+            field=staged[6] if with_field else None,
+            qid=staged[6 + int(with_field)] if with_qid else None)
         self._max_index = max(self._max_index, w["max_index"])
         self._note_staged()
         return batch
@@ -655,6 +665,7 @@ class DeviceStagingIter:
             return np.frombuffer(buf, dtype=dtype, count=count, offset=int(off))
 
         with_field = self._with_field and c.field_off != _NO_FIELD
+        with_qid = self._with_qid and c.qid_off != _NO_FIELD
         return {
             "label": arr(c.label_off, B, np.float32),
             "weight": arr(c.weight_off, B, np.float32),
@@ -662,6 +673,7 @@ class DeviceStagingIter:
             "index": arr(c.index_off, nnz, np.int32),
             "value": arr(c.value_off, nnz, np.float32),
             "field": arr(c.field_off, nnz, np.int32) if with_field else None,
+            "qid": arr(c.qid_off, B, np.int32) if with_qid else None,
             "num_rows": int(c.num_rows),
             "max_index": int(c.max_index),
         }
@@ -727,13 +739,17 @@ class DeviceStagingIter:
             label, weight = local["label"], local["weight"]
             index, value = local["index"], local["value"]
             field = local["field"]
+            qid = local["qid"]
             with_field = field is not None
+            with_qid = qid is not None
         else:
             label = weight = np.zeros(B, np.float32)
             value = np.zeros(nnz, np.float32)
             index = np.zeros(nnz, np.int32)
             with_field = self._with_field
             field = np.zeros(nnz, np.int32) if with_field else None
+            with_qid = self._with_qid
+            qid = np.zeros(B, np.int32) if with_qid else None
 
         repl = self._replicated_sharding()
         put_s = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
@@ -744,7 +760,8 @@ class DeviceStagingIter:
             label=put_s(label), weight=put_s(weight), row_ptr=put_r(global_rp),
             index=put_s(index), value=put_s(value),
             num_rows=put_r(total_rows),
-            field=put_s(field) if with_field else None)
+            field=put_s(field) if with_field else None,
+            qid=put_s(qid) if with_qid else None)
         self._note_staged()
         return batch
 

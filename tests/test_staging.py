@@ -148,3 +148,29 @@ def test_abandoned_iterator_does_not_deadlock(libsvm_file):
     total = sum(int(b.num_rows) for b in it)  # must not hang
     assert total == 1000
     assert time.monotonic() - t0 < 30
+
+
+def test_with_qid_stages_query_ids(tmp_path):
+    """with_qid=True carries the libsvm qid: column per row (the ranking
+    use case qid exists for, reference include/dmlc/data.h Row::qid)."""
+    import numpy as np
+    f = tmp_path / "ranked.libsvm"
+    lines = []
+    expect = []
+    for q in (7, 7, 7, 12, 12, 30):
+        y = len(lines) % 3
+        lines.append(f"{y} qid:{q} 1:0.5 3:1.5")
+        expect.append(q)
+    f.write_text("\n".join(lines) + "\n")
+    from dmlc_core_tpu.data import DeviceStagingIter
+    it = DeviceStagingIter(str(f), batch_size=8, nnz_bucket=8, with_qid=True)
+    batches = list(it)
+    assert len(batches) == 1
+    b = batches[0]
+    assert b.qid is not None and b.qid.shape == (8,)
+    got = np.asarray(b.qid)
+    assert got[:6].tolist() == expect
+    assert (got[6:] == 0).all()  # padding rows carry qid 0
+    # default: no qid column staged
+    it2 = DeviceStagingIter(str(f), batch_size=8, nnz_bucket=8)
+    assert next(iter(it2)).qid is None
