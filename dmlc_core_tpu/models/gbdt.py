@@ -184,6 +184,75 @@ def _squared_grad_hess(margin: jax.Array, label: jax.Array
     return margin - label, jnp.ones_like(margin)
 
 
+@functools.partial(jax.jit, static_argnames=("max_shift",))
+def _pairwise_terms(margin: jax.Array, label: jax.Array, qid: jax.Array,
+                    weight: jax.Array, max_shift: int):
+    """Pairwise logistic (RankNet) terms over qid-contiguous rows.
+
+    Instead of materializing O(n^2) pairs, scan ``s = 1..max_shift`` and
+    pair each row i with row i+s when both sit in the same query group —
+    every within-group pair appears for exactly one shift, so the scan is
+    O(rows * max_group) with only rolls/masks (XLA-friendly, ragged groups
+    included).  Returns (grad, hess, loss_sum, pair_count); grad/hess
+    follow XGBoost's rank:pairwise (winner pushed up, loser down).
+    """
+    rows = margin.shape[0]
+    pos = jnp.arange(rows)
+
+    def body(s, carry):
+        g, h, loss, npairs = carry
+        mj = jnp.roll(margin, -s)
+        yj = jnp.roll(label, -s)
+        qj = jnp.roll(qid, -s)
+        wj = jnp.roll(weight, -s)
+        mask = ((qid == qj) & (pos < rows - s)   # same group, no wraparound
+                & (weight > 0) & (wj > 0))
+        dy = label - yj
+        winner_i = dy > 0
+        pair = mask & (dy != 0)
+        d = jnp.where(winner_i, margin - mj, mj - margin)  # winner - loser
+        p = jax.nn.sigmoid(-d)
+        lam = jnp.where(pair, p, 0.0)
+        hh = jnp.where(pair, jnp.maximum(p * (1.0 - p), 1e-16), 0.0)
+        gi = jnp.where(winner_i, -lam, lam)   # row i's share of the pair
+        g = g + gi + jnp.roll(-gi, s)         # row i+s gets the other sign
+        h = h + hh + jnp.roll(hh, s)
+        # stable log(1 + e^-d)
+        loss = loss + jnp.sum(jnp.where(
+            pair, jnp.maximum(-d, 0) + jnp.log1p(jnp.exp(-jnp.abs(d))), 0.0))
+        npairs = npairs + jnp.sum(pair)
+        return g, h, loss, npairs
+
+    zero = jnp.zeros(rows, jnp.float32)
+    return jax.lax.fori_loop(1, max_shift + 1, body,
+                             (zero, zero, jnp.float32(0.0), jnp.int32(0)))
+
+
+def _validate_rank_qid(qid, weight=None) -> int:
+    """Host-side qid checks for the pairwise scan.
+
+    Real (weight>0) rows of each query must form one contiguous block
+    (the libsvm ranking layout; padding rows are ignored).  Returns the
+    scan depth: the max POSITIONAL span of a group's real rows plus one —
+    spans, not counts, so interior weight-0 gaps (multi-host pad gaps)
+    cannot hide valid pairs from the shifted scan."""
+    q = np.asarray(qid)
+    pos = (np.flatnonzero(np.asarray(weight) > 0) if weight is not None
+           else np.arange(q.size))
+    qf = q[pos]
+    if qf.size == 0:
+        raise ValueError("rank:pairwise needs a non-empty qid array")
+    boundaries = np.flatnonzero(np.diff(qf) != 0)
+    starts = np.concatenate([[0], boundaries + 1])
+    ends = np.concatenate([boundaries + 1, [qf.size]])
+    if len(starts) != len(np.unique(qf)):
+        raise ValueError(
+            "rank:pairwise requires qid groups to be contiguous runs "
+            "(sort rows by qid; libsvm ranking files already are)")
+    spans = pos[ends - 1] - pos[starts]
+    return int(spans.max()) + 1
+
+
 def _softmax_ce(margin: jax.Array, label: jax.Array) -> jax.Array:
     """Per-row cross-entropy from [rows, K] margins and integer labels."""
     logz = jax.scipy.special.logsumexp(margin, axis=1)
@@ -238,7 +307,8 @@ class GBDT:
                  colsample_bytree: float = 1.0,
                  seed: int = 0,
                  num_class: int = 0):
-        if objective not in ("logistic", "squared", "softmax"):
+        if objective not in ("logistic", "squared", "softmax",
+                             "rank:pairwise"):
             raise ValueError(f"unknown objective '{objective}'")
         if objective == "softmax" and num_class < 2:
             raise ValueError("objective='softmax' needs num_class >= 2")
@@ -328,9 +398,63 @@ class GBDT:
         w = weight.astype(jnp.float32)
         return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-12)
 
+    def _rank_fns(self, qid, w, eval_qid=None, eval_w=None,
+                  have_eval: bool = False):
+        """(grad_hess, eval_loss_fn) closures for rank:pairwise.  qid must
+        be contiguous per group; weight-0 padding rows never pair."""
+        if qid is None:
+            raise ValueError("objective='rank:pairwise' needs qid= "
+                             "(per-row query ids; stage with with_qid=True)")
+        if have_eval and eval_qid is None:
+            # falling back to _objective_loss would silently monitor
+            # squared error for a ranking model
+            raise ValueError(
+                "rank:pairwise eval_set needs the eval qids: pass "
+                "(eval_bins, eval_label, eval_weight_or_None, eval_qid), "
+                "or an eval PaddedBatch staged with with_qid=True")
+        qid = jnp.asarray(qid).astype(jnp.int32)
+        max_group = _validate_rank_qid(qid, w)
+
+        def grad_hess(margin, label):
+            g, h, _, _ = _pairwise_terms(margin, label, qid, w,
+                                         max_group - 1)
+            return g, h
+
+        eval_loss_fn = None
+        if eval_qid is not None:
+            eval_qid_arr = jnp.asarray(eval_qid).astype(jnp.int32)
+            ev_group = _validate_rank_qid(eval_qid_arr, eval_w)
+
+            def eval_loss_fn(margin, label, weight):  # noqa: F811
+                ew = (jnp.ones_like(label) if weight is None
+                      else weight.astype(jnp.float32))
+                _, _, loss, npairs = _pairwise_terms(
+                    margin, label, eval_qid_arr, ew, ev_group - 1)
+                return loss / jnp.maximum(npairs, 1)
+
+        return grad_hess, eval_loss_fn
+
+    def rank_scores(self, params: dict, bins: jax.Array) -> jax.Array:
+        """Ranking scores (higher = ranked above) — just the margins."""
+        return self.margins(params, bins)
+
+    def pairwise_loss(self, params: dict, bins: jax.Array,
+                      label: jax.Array, qid: jax.Array,
+                      weight: Optional[jax.Array] = None) -> jax.Array:
+        """Mean pairwise logistic loss over same-query pairs."""
+        w = (jnp.ones_like(label) if weight is None
+             else weight.astype(jnp.float32))
+        qid = jnp.asarray(qid).astype(jnp.int32)
+        max_group = _validate_rank_qid(qid, w)
+        m = self.margins(params, bins)
+        _, _, loss, npairs = _pairwise_terms(
+            m, label.astype(jnp.float32), qid, w, max_group - 1)
+        return loss / jnp.maximum(npairs, 1)
+
     def _boost(self, label: jax.Array, w: jax.Array, build_tree,
                eval_margin=None, eval_label=None, eval_weight=None,
-               early_stopping_rounds: int = 0) -> dict:
+               early_stopping_rounds: int = 0,
+               grad_hess=None, eval_loss_fn=None) -> dict:
         """Shared boosting driver (base prior, tree loop, stochastic
         row/column sampling, stacking) for the dense (`fit`) and
         sparse-native (`fit_batch`) input paths.
@@ -362,8 +486,10 @@ class GBDT:
                 else None)
         best_loss, best_t, since_best = float("inf"), 0, 0
         feats, thrs, dirs, sgains, scovers, leaves = [], [], [], [], [], []
+        grad_hess = grad_hess or self._grad_hess
+        eval_loss_fn = eval_loss_fn or self._objective_loss
         for t_idx in range(self.num_trees):
-            g, h = self._grad_hess(margin, label)
+            g, h = grad_hess(margin, label)
             w_t, col_mask = self._tree_sampling(root_key, t_idx, w)
             f, t, d, sg, sc, leaf, leaf_rel = build_tree(g * w_t, h * w_t,
                                                          col_mask)
@@ -376,8 +502,7 @@ class GBDT:
             leaves.append(leaf)
             if have_eval:
                 ev_m = ev_m + eval_margin(f, t, d, leaf)
-                loss = float(self._objective_loss(ev_m, eval_label,
-                                                  eval_weight))
+                loss = float(eval_loss_fn(ev_m, eval_label, eval_weight))
                 if loss < best_loss:
                     best_loss, best_t, since_best = loss, t_idx + 1, 0
                 elif early_stopping_rounds > 0:
@@ -762,7 +887,8 @@ class GBDT:
     def fit(self, bins: jax.Array, label: jax.Array,
             weight: Optional[jax.Array] = None,
             eval_set: Optional[tuple] = None,
-            early_stopping_rounds: int = 0) -> dict:
+            early_stopping_rounds: int = 0,
+            qid: Optional[jax.Array] = None) -> dict:
         """Train the forest on binned features.
 
         bins: u8 [rows, features] (``QuantileBinner.transform`` output; may
@@ -771,7 +897,13 @@ class GBDT:
         ``(eval_bins, eval_label[, eval_weight])`` held-out set; with
         ``early_stopping_rounds > 0``, boosting stops after that many
         rounds without eval-loss improvement and the forest is truncated
-        at the best round (``trees_used``).  Returns the forest pytree.
+        at the best round (``trees_used``).
+
+        ``qid``: per-row query ids, required for
+        ``objective='rank:pairwise'`` (contiguous groups; stage with
+        ``with_qid=True``); its eval_set form is the 4-tuple
+        ``(eval_bins, eval_label, eval_weight_or_None, eval_qid)``.
+        Returns the forest pytree.
         """
         label = label.astype(jnp.float32)
         w = (jnp.ones_like(label) if weight is None
@@ -782,6 +914,21 @@ class GBDT:
             eval_weight = eval_set[2] if len(eval_set) > 2 else None
             eval_margin = (lambda f, t, d, leaf:
                            self._tree_margins(f, t, d, leaf, eval_bins))
+        if self.objective == "rank:pairwise":
+            grad_hess, eval_loss_fn = self._rank_fns(
+                qid, w,
+                eval_qid=(eval_set[3] if eval_set is not None and
+                          len(eval_set) > 3 else None),
+                eval_w=eval_weight, have_eval=eval_set is not None)
+            return self._boost(label, w,
+                               lambda g, h, cm: self._build_tree(bins, g, h,
+                                                                 cm),
+                               eval_margin=eval_margin,
+                               eval_label=eval_label,
+                               eval_weight=eval_weight,
+                               early_stopping_rounds=early_stopping_rounds,
+                               grad_hess=grad_hess,
+                               eval_loss_fn=eval_loss_fn)
         driver = (self._boost_multi if self.objective == "softmax"
                   else self._boost)
         return driver(label, w,
@@ -843,6 +990,20 @@ class GBDT:
                            self._tree_margins_sparse_one(
                                f, t, d, leaf, ev_rid, ev_fi, ev_bin,
                                ev_mask, ev.label))
+        if self.objective == "rank:pairwise":
+            grad_hess, eval_loss_fn = self._rank_fns(
+                batch.qid, w,
+                eval_qid=(eval_set.qid if eval_set is not None else None),
+                eval_w=(eval_set.weight if eval_set is not None else None),
+                have_eval=eval_set is not None)
+            return self._boost(
+                label, w,
+                lambda g, h, cm: self._build_tree_sparse(
+                    row_id, findex, ebin, emask, g, h, cm),
+                eval_margin=eval_margin, eval_label=eval_label,
+                eval_weight=eval_weight,
+                early_stopping_rounds=early_stopping_rounds,
+                grad_hess=grad_hess, eval_loss_fn=eval_loss_fn)
         driver = (self._boost_multi if self.objective == "softmax"
                   else self._boost)
         return driver(
@@ -998,6 +1159,9 @@ class GBDT:
              weight: Optional[jax.Array] = None) -> jax.Array:
         """Mean objective over rows; ``weight`` masks padding rows (weight
         0) exactly as in ``fit`` and the other model families."""
+        if self.objective == "rank:pairwise":
+            raise ValueError("ranking loss needs qids: use "
+                             "pairwise_loss(params, bins, label, qid)")
         m = (self.margins_multi(params, bins)
              if self.objective == "softmax"
              else self.margins(params, bins))

@@ -704,3 +704,94 @@ def test_softmax_sparse_batch_path():
     assert agree > 0.97, agree
     acc = float(np.mean(probs_sparse.argmax(axis=1) == y))
     assert acc > 0.9, acc
+
+
+def test_rank_pairwise_learns_ordering():
+    """objective='rank:pairwise': within-query pairwise accuracy rises from
+    chance to near-perfect; shuffled qid groups are rejected."""
+    rng = np.random.default_rng(21)
+    rows_per_q, n_q = 12, 60
+    n = rows_per_q * n_q
+    x = rng.uniform(-1, 1, size=(n, 4)).astype(np.float32)
+    qid = np.repeat(np.arange(n_q), rows_per_q).astype(np.int32)
+    # relevance = nonlinear score + per-query offset (offset is irrelevant
+    # to within-query order, so pointwise regression is mislead by it)
+    offs = np.repeat(rng.uniform(-5, 5, n_q), rows_per_q)
+    rel = (x[:, 0] + 0.8 * np.sign(x[:, 1]) * x[:, 1] ** 2).astype(np.float32)
+    label = (rel + offs).astype(np.float32)
+
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+    model = GBDT(num_features=4, num_trees=25, max_depth=3, num_bins=32,
+                 learning_rate=0.3, objective="rank:pairwise")
+    params = model.fit(bins, jnp.asarray(label), qid=jnp.asarray(qid))
+    scores = np.asarray(model.rank_scores(params, bins))
+
+    def pairwise_acc(s):
+        good = total = 0
+        for q in range(n_q):
+            sl = slice(q * rows_per_q, (q + 1) * rows_per_q)
+            sq, lq = s[sl], label[sl]
+            for i in range(rows_per_q):
+                for j in range(i + 1, rows_per_q):
+                    if lq[i] == lq[j]:
+                        continue
+                    total += 1
+                    good += (sq[i] > sq[j]) == (lq[i] > lq[j])
+        return good / max(total, 1)
+
+    acc = pairwise_acc(scores)
+    assert acc > 0.95, acc
+    # the loss surface agrees
+    final = float(model.pairwise_loss(params, bins, jnp.asarray(label),
+                                      jnp.asarray(qid)))
+    base = float(model.pairwise_loss(model.init(), bins, jnp.asarray(label),
+                                     jnp.asarray(qid)))
+    assert final < 0.4 * base, (final, base)
+
+    import pytest
+    with pytest.raises(ValueError, match="contiguous"):
+        model.fit(bins, jnp.asarray(label),
+                  qid=jnp.asarray(rng.permutation(qid)))
+    with pytest.raises(ValueError, match="qid"):
+        model.fit(bins, jnp.asarray(label))
+
+
+def test_rank_pairwise_from_staged_qid(tmp_path):
+    """End to end: libsvm qid: file -> with_qid staging -> fit_batch rank."""
+    rng = np.random.default_rng(22)
+    lines = []
+    for q in range(40):
+        for _ in range(8):
+            v = {i: float(rng.uniform(0.1, 2.0)) for i in range(3)}
+            rel = round(2 * v[0] + v[1] ** 2, 3)
+            lines.append(f"{rel} qid:{q} " +
+                         " ".join(f"{i}:{val:.4f}" for i, val in v.items()))
+    f = tmp_path / "rank.libsvm"
+    f.write_text("\n".join(lines) + "\n")
+    from dmlc_core_tpu.data import DeviceStagingIter
+    it = DeviceStagingIter(str(f), batch_size=512, nnz_bucket=1 << 10,
+                           with_qid=True)
+    batch = next(iter(it))
+    it.close()
+    assert batch.qid is not None
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    mask = np.asarray(batch.value) != 0
+    binner.fit_sparse(np.asarray(batch.index)[mask],
+                      np.asarray(batch.value)[mask], num_features=3)
+    model = GBDT(num_features=3, num_trees=15, max_depth=3, num_bins=16,
+                 learning_rate=0.3, objective="rank:pairwise",
+                 missing_aware=True)
+    params = model.fit_batch(batch, binner)
+    scores = np.asarray(model.margins_batch(params, batch, binner))
+    w = np.asarray(batch.weight)
+    y = np.asarray(batch.label)
+    q = np.asarray(batch.qid)
+    good = total = 0
+    for i in range(len(y)):
+        for j in range(i + 1, len(y)):
+            if w[i] == 0 or w[j] == 0 or q[i] != q[j] or y[i] == y[j]:
+                continue
+            total += 1
+            good += (scores[i] > scores[j]) == (y[i] > y[j])
+    assert total > 0
+    assert good / total > 0.9, good / total
