@@ -64,6 +64,11 @@ def main() -> int:
                     help="train straight on the staged CSR batch "
                          "(fit_batch: O(nnz) histograms, no densify; "
                          "implies --missing semantics)")
+    ap.add_argument("--rank", action="store_true",
+                    help="learning-to-rank demo: a qid-grouped libsvm "
+                         "dataset staged with with_qid=True into "
+                         "objective='rank:pairwise' (reports within-query "
+                         "pairwise accuracy)")
     args = ap.parse_args()
 
     import jax
@@ -73,6 +78,89 @@ def main() -> int:
     from dmlc_core_tpu.data import DeviceStagingIter
     from dmlc_core_tpu.models import GBDT, QuantileBinner
     from dmlc_core_tpu.ops.sparse import csr_to_dense, csr_to_dense_missing
+
+    def concat_staged(uri, with_qid=False):
+        """Drain ALL staged batches of a dataset into one host PaddedBatch
+        (hist-GBDT needs the full dataset per level); None if no rows."""
+        from dmlc_core_tpu.data.staging import PaddedBatch
+        it = DeviceStagingIter(uri, batch_size=args.batch_size,
+                               with_qid=with_qid)
+        parts = [(np.asarray(b.label), np.asarray(b.weight),
+                  np.asarray(b.row_ptr), np.asarray(b.index),
+                  np.asarray(b.value),
+                  np.asarray(b.qid) if with_qid else None) for b in it]
+        if not parts:
+            return None
+        nnz_off = np.cumsum([0] + [p[4].shape[0] for p in parts])
+        return PaddedBatch(
+            label=jnp.asarray(np.concatenate([p[0] for p in parts])),
+            weight=jnp.asarray(np.concatenate([p[1] for p in parts])),
+            row_ptr=jnp.asarray(np.concatenate(
+                [parts[0][2]] + [p[2][1:] + off for p, off
+                                 in zip(parts[1:], nnz_off[1:-1])])),
+            index=jnp.asarray(np.concatenate([p[3] for p in parts])),
+            value=jnp.asarray(np.concatenate([p[4] for p in parts])),
+            num_rows=jnp.asarray(np.int32(
+                sum(int((p[1] > 0).sum()) for p in parts))),
+            field=None,
+            qid=(jnp.asarray(np.concatenate([p[5] for p in parts]))
+                 if with_qid else None))
+
+    if args.rank:
+        data_rank = args.data or "/tmp/gbdt_rank_example.libsvm"
+        if args.data is None and not os.path.exists(data_rank):
+            print("generating synthetic ranking dataset...", flush=True)
+            rng = np.random.default_rng(0)
+            with open(data_rank, "w") as f:
+                for q in range(1500):
+                    for _ in range(int(rng.integers(6, 14))):
+                        v = {int(i): float(rng.uniform(0.1, 2.0))
+                             for i in np.sort(rng.choice(
+                                 args.dim, size=max(3, args.dim // 4),
+                                 replace=False))}
+                        rel = round(2 * v.get(0, 0.0)
+                                    + v.get(1, 0.0) ** 2
+                                    + float(rng.normal(0, 0.05)), 4)
+                        f.write(f"{rel} qid:{q} " + " ".join(
+                            f"{i}:{val:.4f}" for i, val in v.items()) + "\n")
+        batch = concat_staged(data_rank, with_qid=True)
+        if batch is None:
+            print(f"error: no rows staged from {data_rank}", file=sys.stderr)
+            return 1
+        mask = np.asarray(batch.value) != 0
+        binner = QuantileBinner(num_bins=args.bins, missing_aware=True)
+        binner.fit_sparse(np.asarray(batch.index)[mask],
+                          np.asarray(batch.value)[mask],
+                          num_features=args.dim)
+        model = GBDT(num_features=args.dim, num_trees=args.trees,
+                     max_depth=args.depth, num_bins=args.bins,
+                     learning_rate=0.3, objective="rank:pairwise",
+                     missing_aware=True)
+        t0 = time.monotonic()
+        params = model.fit_batch(batch, binner)
+        jax.block_until_ready(params["leaf"])
+        t_fit = time.monotonic() - t0
+        scores = np.asarray(model.margins_batch(params, batch, binner))
+        w = np.asarray(batch.weight)
+        y = np.asarray(batch.label)
+        q = np.asarray(batch.qid)
+        # within-query pairwise accuracy over a row sample
+        rng2 = np.random.default_rng(1)
+        real = np.flatnonzero(w > 0)
+        good = total = 0
+        for i in rng2.choice(real, size=min(4000, len(real)), replace=False):
+            same = real[(q[real] == q[i]) & (real != i)]
+            for j in same:
+                if y[i] == y[j]:
+                    continue
+                total += 1
+                good += (scores[i] > scores[j]) == (y[i] > y[j])
+        acc = good / max(total, 1)
+        print(f"fit {args.trees} rank trees in {t_fit:.2f}s; "
+              f"pairwise accuracy={acc:.4f} over {total} sampled pairs",
+              flush=True)
+        print(f"final: pairwise_accuracy={acc:.4f}", flush=True)
+        return 0 if acc > 0.8 else 1
 
     data = args.data
     if data is None:
@@ -84,27 +172,11 @@ def main() -> int:
     if args.native_sparse:
         # no densify: staged CSR batches concatenated into one host batch
         # for fit_batch (hist-GBDT needs the full dataset per level)
-        from dmlc_core_tpu.data.staging import PaddedBatch
         t0 = time.monotonic()
-        it = DeviceStagingIter(data, batch_size=args.batch_size)
-        parts = [(np.asarray(b.label), np.asarray(b.weight),
-                  np.asarray(b.row_ptr), np.asarray(b.index),
-                  np.asarray(b.value)) for b in it]
-        if not parts:
+        batch = concat_staged(data)
+        if batch is None:
             print(f"error: no rows staged from {data}", file=sys.stderr)
             return 1
-        nnz_off = np.cumsum([0] + [p[4].shape[0] for p in parts])
-        batch = PaddedBatch(
-            label=jnp.asarray(np.concatenate([p[0] for p in parts])),
-            weight=jnp.asarray(np.concatenate([p[1] for p in parts])),
-            row_ptr=jnp.asarray(np.concatenate(
-                [parts[0][2]] + [p[2][1:] + off for p, off
-                                 in zip(parts[1:], nnz_off[1:-1])])),
-            index=jnp.asarray(np.concatenate([p[3] for p in parts])),
-            value=jnp.asarray(np.concatenate([p[4] for p in parts])),
-            num_rows=jnp.asarray(np.int32(
-                sum(int((p[1] > 0).sum()) for p in parts))),
-            field=None)
         t_stage = time.monotonic() - t0
         mask = np.asarray(batch.value) != 0
         n_real = int(np.asarray(batch.weight).sum())

@@ -57,3 +57,27 @@ def test_gbdt_example_runs(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert "final:" in proc.stdout and "accuracy" in proc.stdout
+
+
+def test_gbdt_rank_example_runs(tmp_path):
+    """The learning-to-rank demo: qid libsvm -> with_qid staging -> rank."""
+    import numpy as np
+    rng = np.random.default_rng(5)
+    lines = []
+    for q in range(120):
+        for _ in range(8):
+            v = {int(i): float(rng.uniform(0.1, 2.0))
+                 for i in np.sort(rng.choice(8, size=4, replace=False))}
+            rel = round(2 * v.get(0, 0.0) + v.get(1, 0.0) ** 2, 4)
+            lines.append(f"{rel} qid:{q} " +
+                         " ".join(f"{i}:{val:.4f}" for i, val in v.items()))
+    data = tmp_path / "rank.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "examples/gbdt_train.py", "--rank", "--data",
+         str(data), "--dim", "8", "--trees", "12", "--depth", "3",
+         "--bins", "16"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "pairwise_accuracy=" in proc.stdout
