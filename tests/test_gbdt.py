@@ -795,3 +795,47 @@ def test_rank_pairwise_from_staged_qid(tmp_path):
             good += (scores[i] > scores[j]) == (y[i] > y[j])
     assert total > 0
     assert good / total > 0.9, good / total
+
+
+def test_sharded_softmax_and_rank_match_single_device():
+    """The 8-device mesh histogram-psum parity extends to the multiclass
+    and ranking objectives (their gradients are computed from sharded
+    margins/labels; tree state stays replicated)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    rng = np.random.default_rng(23)
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    rows_sh = NamedSharding(mesh, P("data"))
+    dev = jax.devices()[0]
+
+    # softmax
+    x = rng.uniform(-1, 1, size=(1024, 4)).astype(np.float32)
+    y3 = np.where(x[:, 0] > 0.3, 2,
+                  np.where(x[:, 1] > 0, 1, 0)).astype(np.float32)
+    bins = np.asarray(QuantileBinner(num_bins=32).fit_transform(x))
+    sm = GBDT(num_features=4, num_trees=3, max_depth=3, num_bins=32,
+              learning_rate=0.4, objective="softmax", num_class=3)
+    p1 = sm.fit(jax.device_put(bins, dev), jax.device_put(jnp.asarray(y3), dev))
+    ps = sm.fit(jax.device_put(bins, rows_sh),
+                jax.device_put(jnp.asarray(y3), rows_sh))
+    for k in ("feature", "threshold"):
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(ps[k]),
+                                      err_msg=f"softmax {k}")
+    np.testing.assert_allclose(np.asarray(p1["leaf"]), np.asarray(ps["leaf"]),
+                               rtol=1e-4, atol=1e-6)
+
+    # rank:pairwise (qid groups aligned to the row sharding)
+    qid = np.repeat(np.arange(128), 8).astype(np.int32)
+    rel = (x[:, 0] + x[:, 1] ** 2).astype(np.float32)
+    rk = GBDT(num_features=4, num_trees=3, max_depth=3, num_bins=32,
+              learning_rate=0.3, objective="rank:pairwise")
+    r1 = rk.fit(jax.device_put(bins, dev),
+                jax.device_put(jnp.asarray(rel), dev),
+                qid=jax.device_put(jnp.asarray(qid), dev))
+    rs = rk.fit(jax.device_put(bins, rows_sh),
+                jax.device_put(jnp.asarray(rel), rows_sh),
+                qid=jax.device_put(jnp.asarray(qid), rows_sh))
+    for k in ("feature", "threshold"):
+        np.testing.assert_array_equal(np.asarray(r1[k]), np.asarray(rs[k]),
+                                      err_msg=f"rank {k}")
+    np.testing.assert_allclose(np.asarray(r1["leaf"]), np.asarray(rs["leaf"]),
+                               rtol=1e-4, atol=1e-6)
