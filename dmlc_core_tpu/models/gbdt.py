@@ -272,7 +272,11 @@ class GBDT:
     on leaf weights), ``min_child_weight`` (minimum hessian mass per
     child), ``objective`` ("logistic", "squared", or "softmax" with
     ``num_class`` — K trees per round against the shared softmax
-    distribution, XGBoost's multi:softprob), ``subsample`` /
+    distribution, XGBoost's multi:softprob), ``monotone_constraints``
+    (per-feature -1/0/+1: violating splits are gain-masked, per-node
+    output bounds propagate down the tree, and leaves clamp into them —
+    the forest is guaranteed monotone in constrained features'
+    present values), ``subsample`` /
     ``colsample_bytree`` in (0, 1] (stochastic boosting: a per-tree
     Bernoulli row mask folded into the sample weights, and a per-tree
     feature subset masking the split gains — both derived from ``seed``
@@ -306,7 +310,8 @@ class GBDT:
                  subsample: float = 1.0,
                  colsample_bytree: float = 1.0,
                  seed: int = 0,
-                 num_class: int = 0):
+                 num_class: int = 0,
+                 monotone_constraints=None):
         if objective not in ("logistic", "squared", "softmax",
                              "rank:pairwise"):
             raise ValueError(f"unknown objective '{objective}'")
@@ -332,6 +337,20 @@ class GBDT:
         self.colsample_bytree = colsample_bytree
         self.seed = seed
         self.num_class = num_class
+        if monotone_constraints is not None:
+            raw = np.asarray(monotone_constraints)
+            # validate before casting: int32 truncation would silently
+            # accept (and neuter) values like 0.5
+            if (raw.shape != (num_features,)
+                    or not np.isin(raw, (-1, 0, 1)).all()):
+                raise ValueError("monotone_constraints must be a length-"
+                                 "num_features sequence of -1/0/+1")
+            mc = raw.astype(np.int32)
+            if not mc.any():
+                monotone_constraints = None  # all-zero = unconstrained
+            else:
+                monotone_constraints = jnp.asarray(mc)
+        self.monotone_constraints = monotone_constraints
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -517,6 +536,65 @@ class GBDT:
                                   scovers, leaves, trees_used,
                                   self.num_trees)
 
+    def _dir_child_weights(self, dirs, g_tot, h_tot):
+        """Child weights -GL/(HL+λ), -GR/(HR+λ) per direction, stacked to
+        the gain array's [nodes, F, B, n_dir] layout (one formula shared
+        by the dense and sparse builders)."""
+        lam = self.lambda_
+        ws = [(-a / (b + lam), -(g_tot - a) / (h_tot - b + lam))
+              for a, b in dirs]
+        wl = jnp.stack([wp[0] for wp in ws], axis=3)
+        wr = jnp.stack([wp[1] for wp in ws], axis=3)
+        return wl, wr
+
+    def _apply_monotone(self, gain, wl, wr, lo, hi):
+        """Mask monotonicity-violating splits (XGBoost monotone_constraints).
+
+        gain/wl/wr: [nodes, F, B, n_dir]; lo/hi: [nodes] output bounds.
+        For constraint +1 on feature f the left child's weight must not
+        exceed the right child's (and both must admit a value inside the
+        node's bounds after clipping); -1 mirrors.  Unconstrained features
+        pass through."""
+        c = self.monotone_constraints  # [F] in {-1, 0, +1}
+        wl_c = jnp.clip(wl, lo[:, None, None, None], hi[:, None, None, None])
+        wr_c = jnp.clip(wr, lo[:, None, None, None], hi[:, None, None, None])
+        ok_pos = wl_c <= wr_c
+        ok_neg = wl_c >= wr_c
+        cb = c[None, :, None, None]
+        ok = jnp.where(cb > 0, ok_pos, jnp.where(cb < 0, ok_neg, True))
+        return jnp.where(ok, gain, -jnp.inf)
+
+    def _child_bounds(self, split_f, split_b, split_d, wl, wr, lo, hi):
+        """Bounds for the next level's nodes after splitting.
+
+        Gathers the chosen split's (clipped) child weights, takes their
+        midpoint, and narrows the children of constrained features:
+        +1: left.hi = min(hi, mid), right.lo = max(lo, mid); -1 mirrored.
+        Null splits (threshold == num_bins) pass bounds through.  Returns
+        (lo2, hi2) of length 2 * nodes in heap child order."""
+        n_nodes = wl.shape[0]
+        B = self.num_bins
+        # null splits encode threshold == B: clamp the gather (mid is
+        # unused for them — the where below passes bounds through)
+        flat_idx = jnp.minimum((split_f * B + split_b) * wl.shape[3]
+                               + split_d,
+                               wl.shape[1] * B * wl.shape[3] - 1)
+        pick = lambda a: jnp.take_along_axis(  # noqa: E731
+            a.reshape(n_nodes, -1), flat_idx[:, None], 1)[:, 0]
+        wl_c = jnp.clip(pick(wl), lo, hi)
+        wr_c = jnp.clip(pick(wr), lo, hi)
+        mid = 0.5 * (wl_c + wr_c)
+        c = self.monotone_constraints[split_f]
+        null = split_b >= B
+        hi_l = jnp.where(~null & (c > 0), jnp.minimum(hi, mid), hi)
+        lo_l = jnp.where(~null & (c < 0), jnp.maximum(lo, mid), lo)
+        lo_r = jnp.where(~null & (c > 0), jnp.maximum(lo, mid), lo)
+        hi_r = jnp.where(~null & (c < 0), jnp.minimum(hi, mid), hi)
+        # heap order: children of node n are 2n+1, 2n+2 -> interleave
+        lo2 = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
+        hi2 = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
+        return lo2, hi2
+
     def _tree_sampling(self, root_key, t_idx: int, w: jax.Array):
         """Per-tree stochastic-GBM masks, shared by every boosting driver:
         a Bernoulli row mask folded into the weights (routing still sees
@@ -654,6 +732,9 @@ class GBDT:
         feat_cols = jnp.arange(F, dtype=jnp.int32)
 
         node = jnp.zeros(rows, jnp.int32)  # heap id of each row's node
+        mono = self.monotone_constraints is not None
+        lo = jnp.full(1, -jnp.inf)
+        hi = jnp.full(1, jnp.inf)
         features = []
         thresholds = []
         defaults = []
@@ -697,14 +778,19 @@ class GBDT:
                 # missing (bin 0) mass on the left (its natural cumsum
                 # side) vs on the right.  dir axis: 0 = left, 1 = right
                 # (argmax ties resolve to left, the XGBoost default).
-                gain = jnp.stack(
-                    [split_gain(gl, hl),
-                     split_gain(gl - hist_g[:, :, 0:1],
-                                hl - hist_h[:, :, 0:1])], axis=3)
+                dirs = [(gl, hl),
+                        (gl - hist_g[:, :, 0:1], hl - hist_h[:, :, 0:1])]
             else:
-                gain = split_gain(gl, hl)[..., None]        # dir axis size 1
+                dirs = [(gl, hl)]
+            gain = jnp.stack([split_gain(a, b) for a, b in dirs], axis=3)
+            if mono:
+                wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
+                gain = self._apply_monotone(gain, wl, wr, lo, hi)
             split_f, split_b, split_d, split_g = self._pick_splits(gain,
                                                                    col_mask)
+            if mono:
+                lo, hi = self._child_bounds(split_f, split_b, split_d,
+                                            wl, wr, lo, hi)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -718,13 +804,16 @@ class GBDT:
                                      split_d[rel] == 1, go_right)
             node = 2 * node + 1 + go_right.astype(jnp.int32)
 
-        # leaf weights: -G/(H + lambda) per leaf, shrunken
+        # leaf weights: -G/(H + lambda) per leaf, shrunken (clamped into the
+        # node's propagated bounds first under monotone constraints)
         n_leaves = 2 ** self.max_depth
         leaf_rel = node - (n_leaves - 1)
         gh_leaf = jax.ops.segment_sum(jnp.stack([grad, hess], axis=-1),
                                       leaf_rel, num_segments=n_leaves)
-        leaf = (-self.learning_rate * gh_leaf[:, 0]
-                / (gh_leaf[:, 1] + self.lambda_))
+        leaf_w = -gh_leaf[:, 0] / (gh_leaf[:, 1] + self.lambda_)
+        if mono:
+            leaf_w = jnp.clip(leaf_w, lo, hi)
+        leaf = self.learning_rate * leaf_w
         # leaf_rel doubles as each row's final leaf assignment, so fit()
         # can update margins without re-routing every row through the tree
         return (jnp.concatenate(features), jnp.concatenate(thresholds),
@@ -780,6 +869,9 @@ class GBDT:
         gh_row = jnp.stack([grad, hess], axis=-1)          # [rows, 2]
 
         node = jnp.zeros(rows, jnp.int32)
+        mono = self.monotone_constraints is not None
+        lo = jnp.full(1, -jnp.inf)
+        hi = jnp.full(1, jnp.inf)
         features, thresholds, defaults, gains, covers = [], [], [], [], []
         for depth in range(self.max_depth):
             first = 2 ** depth - 1
@@ -806,12 +898,18 @@ class GBDT:
                 return jnp.where(ok, g, -jnp.inf)
 
             # dir 0: missing left (GL gains the missing mass); dir 1: right
-            gain = jnp.stack(
-                [split_gain(gl[..., 0] + miss[:, :, None, 0],
-                            gl[..., 1] + miss[:, :, None, 1]),
-                 split_gain(gl[..., 0], gl[..., 1])], axis=3)
+            dirs = [(gl[..., 0] + miss[:, :, None, 0],
+                     gl[..., 1] + miss[:, :, None, 1]),
+                    (gl[..., 0], gl[..., 1])]
+            gain = jnp.stack([split_gain(a, b) for a, b in dirs], axis=3)
+            if mono:
+                wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
+                gain = self._apply_monotone(gain, wl, wr, lo, hi)
             split_f, split_b, split_d, split_g = self._pick_splits(gain,
                                                                    col_mask)
+            if mono:
+                lo, hi = self._child_bounds(split_f, split_b, split_d,
+                                            wl, wr, lo, hi)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -826,8 +924,10 @@ class GBDT:
         leaf_rel = node - (n_leaves - 1)
         gh_leaf = jax.ops.segment_sum(gh_row, leaf_rel,
                                       num_segments=n_leaves)
-        leaf = (-self.learning_rate * gh_leaf[:, 0]
-                / (gh_leaf[:, 1] + self.lambda_))
+        leaf_w = -gh_leaf[:, 0] / (gh_leaf[:, 1] + self.lambda_)
+        if mono:
+            leaf_w = jnp.clip(leaf_w, lo, hi)
+        leaf = self.learning_rate * leaf_w
         return (jnp.concatenate(features), jnp.concatenate(thresholds),
                 jnp.concatenate(defaults), jnp.concatenate(gains),
                 jnp.concatenate(covers), leaf, leaf_rel)

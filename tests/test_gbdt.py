@@ -839,3 +839,82 @@ def test_sharded_softmax_and_rank_match_single_device():
                                       err_msg=f"rank {k}")
     np.testing.assert_allclose(np.asarray(r1["leaf"]), np.asarray(rs["leaf"]),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_monotone_constraints_enforced():
+    """monotone_constraints: predictions are globally non-decreasing (+1)
+    / non-increasing (-1) in the constrained feature, while accuracy on a
+    monotone-compatible signal stays high; unconstrained fit unchanged."""
+    rng = np.random.default_rng(24)
+    n = 4000
+    x = rng.uniform(-1, 1, size=(n, 3)).astype(np.float32)
+    # monotone signal in f0 + noise + nuisance features
+    margin_true = 2.0 * x[:, 0] + 0.5 * np.sin(4 * x[:, 1])
+    y = (margin_true + rng.normal(0, 0.6, n) > 0).astype(np.float32)
+    binner = QuantileBinner(num_bins=32).fit(x)
+    bins = binner.transform(jnp.asarray(x))
+
+    model = GBDT(num_features=3, num_trees=15, max_depth=4, num_bins=32,
+                 learning_rate=0.3, monotone_constraints=[1, 0, 0])
+    params = model.fit(bins, jnp.asarray(y))
+
+    # sweep feature-0 bins over random contexts: margins must not decrease
+    base = np.asarray(bins)[rng.choice(n, 64, replace=False)]
+    sweeps = np.repeat(base[:, None, :], 32, axis=1)
+    sweeps[:, :, 0] = np.arange(32)[None, :]
+    m = np.asarray(model.margins(params, jnp.asarray(
+        sweeps.reshape(-1, 3).astype(np.uint8)))).reshape(64, 32)
+    viol = np.diff(m, axis=1) < -1e-5
+    assert not viol.any(), f"{viol.sum()} monotonicity violations"
+    acc = float(jnp.mean((model.predict(params, bins) > 0.5) == (y > 0.5)))
+    assert acc > 0.8, acc
+
+    # -1 constraint mirrors
+    model_neg = GBDT(num_features=3, num_trees=10, max_depth=3, num_bins=32,
+                     learning_rate=0.3, monotone_constraints=[-1, 0, 0])
+    p_neg = model_neg.fit(bins, jnp.asarray(1.0 - y))
+    m_neg = np.asarray(model_neg.margins(p_neg, jnp.asarray(
+        sweeps.reshape(-1, 3).astype(np.uint8)))).reshape(64, 32)
+    assert not (np.diff(m_neg, axis=1) > 1e-5).any()
+
+    # all-zero constraints normalize to the unconstrained (identical) path
+    plain = GBDT(num_features=3, num_trees=5, max_depth=3, num_bins=32,
+                 learning_rate=0.3)
+    zeros = GBDT(num_features=3, num_trees=5, max_depth=3, num_bins=32,
+                 learning_rate=0.3, monotone_constraints=[0, 0, 0])
+    np.testing.assert_array_equal(
+        np.asarray(plain.fit(bins, jnp.asarray(y))["leaf"]),
+        np.asarray(zeros.fit(bins, jnp.asarray(y))["leaf"]))
+
+    import pytest
+    with pytest.raises(ValueError, match="monotone"):
+        GBDT(num_features=3, monotone_constraints=[1, 0])
+
+
+def test_monotone_constraints_sparse_path():
+    """fit_batch honors monotone constraints too."""
+    rng = np.random.default_rng(25)
+    batch, row_id, index, value = _random_padded_batch(rng, 1024, 3,
+                                                       density=0.9)
+    from dmlc_core_tpu.ops.sparse import csr_to_dense_missing
+    dense = np.asarray(csr_to_dense_missing(
+        jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id), 1024, 3))
+    f0 = np.nan_to_num(dense[:, 0], nan=0.0)
+    y = (2 * f0 + rng.normal(0, 0.4, 1024) > 0).astype(np.float32)
+    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
+                                  ("weight", "row_ptr", "index", "value",
+                                   "num_rows", "field", "qid")},
+                               "label": jnp.asarray(y)})
+    binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
+    model = GBDT(num_features=3, num_trees=10, max_depth=3, num_bins=16,
+                 learning_rate=0.3, missing_aware=True,
+                 monotone_constraints=[1, 0, 0])
+    params = model.fit_batch(batch, binner)
+    # sweep bins of feature 0 (present codes 1..15) over contexts
+    base = np.asarray(binner.transform(jnp.asarray(dense)))[
+        rng.choice(1024, 32, replace=False)]
+    sweeps = np.repeat(base[:, None, :], 15, axis=1)
+    sweeps[:, :, 0] = np.arange(1, 16)[None, :]
+    m = np.asarray(model.margins(params, jnp.asarray(
+        sweeps.reshape(-1, 3).astype(np.uint8)))).reshape(32, 15)
+    assert not (np.diff(m, axis=1) < -1e-5).any()
