@@ -270,7 +270,8 @@ class GBDT:
     degenerates to the leftmost leaf and unreachable nodes stay zero),
     ``learning_rate`` (shrinkage), ``lambda_`` (L2
     on leaf weights), ``min_child_weight`` (minimum hessian mass per
-    child), ``objective`` ("logistic", "squared", or "softmax" with
+    child), ``gamma`` (min split loss: splits below it become null —
+    XGBoost's complexity pruning), ``objective`` ("logistic", "squared", or "softmax" with
     ``num_class`` — K trees per round against the shared softmax
     distribution, XGBoost's multi:softprob), ``monotone_constraints``
     (per-feature -1/0/+1: violating splits are gain-masked, per-node
@@ -305,6 +306,7 @@ class GBDT:
                  max_depth: int = 6, num_bins: int = 256,
                  learning_rate: float = 0.3, lambda_: float = 1.0,
                  min_child_weight: float = 1e-3,
+                 gamma: float = 0.0,
                  objective: str = "logistic",
                  missing_aware: bool = False,
                  subsample: float = 1.0,
@@ -331,6 +333,9 @@ class GBDT:
         self.learning_rate = learning_rate
         self.lambda_ = lambda_
         self.min_child_weight = min_child_weight
+        if gamma < 0:
+            raise ValueError("gamma must be >= 0")
+        self.gamma = gamma
         self.objective = objective
         self.missing_aware = missing_aware
         self.subsample = subsample
@@ -395,7 +400,11 @@ class GBDT:
         best = best_flat // n_dir
         split_f = (best // B).astype(jnp.int32)
         split_b = (best % B).astype(jnp.int32)
-        null = best_gain <= 0.0
+        # gamma = min_split_loss on XGBoost's scale: its objective carries
+        # a 0.5 factor this formulation omits, so its "0.5*gain <= gamma"
+        # pruning rule is raw gain <= 2*gamma here (default 0 keeps the
+        # positive-gain requirement; configs port over unchanged)
+        null = best_gain <= 2.0 * self.gamma
         return (jnp.where(null, 0, split_f),
                 jnp.where(null, B, split_b),   # everything routes left
                 jnp.where(null, 0, split_d),

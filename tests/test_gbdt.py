@@ -308,10 +308,8 @@ def test_sparse_fit_batch_matches_dense_missing_aware_fit():
         rows, feats))
     y = (np.where(np.isnan(dense[:, 0]), 1.0, dense[:, 0] > 0.3)
          ).astype(np.float32)
-    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
-                                  ("weight", "row_ptr", "index", "value",
-                                   "num_rows", "field")},
-                               "label": jnp.asarray(y)})
+    import dataclasses
+    batch = dataclasses.replace(batch, label=jnp.asarray(y))
 
     binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
     model = GBDT(num_features=feats, num_trees=3, max_depth=3, num_bins=16,
@@ -484,10 +482,8 @@ def test_stochastic_sampling_sparse_path_matches_dense():
         rows, feats))
     y = (np.where(np.isnan(dense[:, 0]), 1.0, dense[:, 0] > 0.0)
          ).astype(np.float32)
-    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
-                                  ("weight", "row_ptr", "index", "value",
-                                   "num_rows", "field")},
-                               "label": jnp.asarray(y)})
+    import dataclasses
+    batch = dataclasses.replace(batch, label=jnp.asarray(y))
     binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
     model = GBDT(num_features=feats, num_trees=6, max_depth=3, num_bins=16,
                  learning_rate=0.5, missing_aware=True,
@@ -682,10 +678,8 @@ def test_softmax_sparse_batch_path():
         jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id), 1024, 5))
     f0 = np.nan_to_num(dense[:, 0], nan=-9.0)
     y = np.where(f0 > 0.5, 2, np.where(f0 > -1.5, 1, 0)).astype(np.float32)
-    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
-                                  ("weight", "row_ptr", "index", "value",
-                                   "num_rows", "field")},
-                               "label": jnp.asarray(y)})
+    import dataclasses
+    batch = dataclasses.replace(batch, label=jnp.asarray(y))
     binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
     model = GBDT(num_features=5, num_trees=8, max_depth=3, num_bins=16,
                  learning_rate=0.5, objective="softmax", num_class=3,
@@ -901,10 +895,8 @@ def test_monotone_constraints_sparse_path():
         jnp.asarray(index), jnp.asarray(value), jnp.asarray(row_id), 1024, 3))
     f0 = np.nan_to_num(dense[:, 0], nan=0.0)
     y = (2 * f0 + rng.normal(0, 0.4, 1024) > 0).astype(np.float32)
-    batch = batch.__class__(**{**{f: getattr(batch, f) for f in
-                                  ("weight", "row_ptr", "index", "value",
-                                   "num_rows", "field", "qid")},
-                               "label": jnp.asarray(y)})
+    import dataclasses
+    batch = dataclasses.replace(batch, label=jnp.asarray(y))
     binner = QuantileBinner(num_bins=16, missing_aware=True).fit(dense)
     model = GBDT(num_features=3, num_trees=10, max_depth=3, num_bins=16,
                  learning_rate=0.3, missing_aware=True,
@@ -918,3 +910,30 @@ def test_monotone_constraints_sparse_path():
     m = np.asarray(model.margins(params, jnp.asarray(
         sweeps.reshape(-1, 3).astype(np.uint8)))).reshape(32, 15)
     assert not (np.diff(m, axis=1) < -1e-5).any()
+
+
+def test_gamma_prunes_low_gain_splits():
+    """gamma (min_split_loss): higher thresholds null more splits, and a
+    huge gamma yields a stump-free (all-null) forest."""
+    rng = np.random.default_rng(26)
+    x = rng.uniform(-1, 1, size=(2000, 3)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0.3)).astype(np.float32)
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+
+    def real_splits(gamma):
+        m = GBDT(num_features=3, num_trees=3, max_depth=4, num_bins=32,
+                 learning_rate=0.5, gamma=gamma)
+        p = m.fit(bins, jnp.asarray(y))
+        return int((np.asarray(p["threshold"]) < 32).sum()), m, p
+
+    n0, _, _ = real_splits(0.0)
+    n5, _, _ = real_splits(5.0)
+    n_inf, m_inf, p_inf = real_splits(1e9)
+    assert n0 > n5 > 0, (n0, n5)
+    assert n_inf == 0
+    # all-null forest still predicts the base rate
+    pred = np.asarray(m_inf.predict(p_inf, bins))
+    np.testing.assert_allclose(pred, pred[0], rtol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="gamma"):
+        GBDT(num_features=3, gamma=-1.0)
