@@ -61,11 +61,13 @@ struct URISpec {
   std::string uri;
   std::map<std::string, std::string> args;
   std::string cache_file;
+  std::string raw_fragment;  // the '#' fragment verbatim (no part suffix)
 
   URISpec(const std::string& raw, unsigned part_index, unsigned num_parts) {
     std::vector<std::string> hash_parts = Split(raw, '#');
     TCHECK_LE(hash_parts.size(), 2u) << "at most one '#' (cache file) allowed in URI: " << raw;
     if (hash_parts.size() == 2) {
+      raw_fragment = hash_parts[1];
       cache_file = hash_parts[1];
       if (num_parts != 1) {
         cache_file += ".split" + std::to_string(num_parts) + ".part" + std::to_string(part_index);

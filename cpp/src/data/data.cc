@@ -5,6 +5,7 @@
 // registrations:230-256, explicit instantiations:114-221).
 #include "dmlctpu/data.h"
 
+#include <cstring>
 #include <memory>
 #include <string>
 
@@ -73,7 +74,8 @@ Parser<IndexType, DType>* CreateLibFMParser(const std::string& path,
 /*! \brief resolve type ("auto" → ?format= arg → libsvm) through the registry */
 template <typename IndexType, typename DType>
 Parser<IndexType, DType>* CreateParserImpl(const char* uri_, unsigned part,
-                                           unsigned num_parts, const char* type) {
+                                           unsigned num_parts, const char* type,
+                                           bool forward_cache = true) {
   std::string ptype = type;
   io::URISpec spec(uri_, part, num_parts);
   if (ptype == "auto") {
@@ -82,15 +84,32 @@ Parser<IndexType, DType>* CreateParserImpl(const char* uri_, unsigned part,
   }
   const auto* entry = Registry<ParserFactoryReg<IndexType, DType>>::Get()->Find(ptype);
   TCHECK(entry != nullptr) << "unknown data format '" << ptype << "'";
-  return entry->body(spec.uri, spec.args, part, num_parts);
+  std::string path = spec.uri;
+  if (forward_cache && !spec.raw_fragment.empty()) {
+    // forward the #cachefile sugar to the CHUNK level (CachedInputSplit):
+    // epoch 2+ of a parser-fed pipeline (e.g. device staging over a remote
+    // filesystem) replays raw chunks from the local cache instead of
+    // re-reading the source.  The RAW fragment is forwarded (InputSplit's
+    // own URISpec parse applies the per-part suffix exactly once) with a
+    // distinct ".chunks" suffix: DiskRowIter (CreateIterImpl) owns the
+    // un-suffixed name for its parsed-page cache, and the two must never
+    // collide.
+    path += "#" + spec.raw_fragment + ".chunks";
+  }
+  return entry->body(path, spec.args, part, num_parts);
 }
 
 template <typename IndexType, typename DType>
 RowBlockIter<IndexType, DType>* CreateIterImpl(const char* uri_, unsigned part,
                                                unsigned num_parts, const char* type) {
   io::URISpec spec(uri_, part, num_parts);
+  // the iterator's parser skips the chunk-level cache: DiskRowIter caches
+  // parsed pages itself, and a second cache underneath would double the
+  // epoch-1 writes (and tee a partial file when the page cache already
+  // satisfies the epoch)
   std::unique_ptr<Parser<IndexType, DType>> parser(
-      CreateParserImpl<IndexType, DType>(uri_, part, num_parts, type));
+      CreateParserImpl<IndexType, DType>(uri_, part, num_parts, type,
+                                         /*forward_cache=*/false));
   if (!spec.cache_file.empty()) {
     return new DiskRowIter<IndexType, DType>(std::move(parser), spec.cache_file.c_str(),
                                              /*reuse_cache=*/true);

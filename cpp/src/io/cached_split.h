@@ -7,6 +7,7 @@
 #define DMLCTPU_SRC_IO_CACHED_SPLIT_H_
 
 #include <algorithm>
+#include <cstdio>
 #include <memory>
 #include <string>
 
@@ -29,24 +30,32 @@ class CachedInputSplit : public InputSplit {
     if (preproc_ != nullptr) preproc_->Destroy();
     preproc_.reset();
     fo_.reset();
+    // an interrupted first pass leaves only the .tmp name behind — never a
+    // file later runs could mistake for a complete cache
+    if (!tmp_written_path_.empty()) std::remove(tmp_written_path_.c_str());
     cached_.Destroy();
     delete tmp_chunk_;
   }
 
   void BeforeFirst() override {
     if (preproc_ != nullptr) {
+      if (!touched_) return;  // nothing consumed yet: epoch 1 streams + tees
       // drain the first pass so the cache file is complete, then swap over
       if (tmp_chunk_ != nullptr) preproc_->Recycle(&tmp_chunk_);
       SplitterBase::Chunk* c = nullptr;
       while (preproc_->Next(&c)) preproc_->Recycle(&c);
-      preproc_.reset();
-      if (fo_ != nullptr) fo_->Close();  // cache must be durable before reuse
-      fo_.reset();
-      TCHECK(InitCachedIter()) << "failed to reopen cache file " << cache_file_;
-    } else {
-      if (tmp_chunk_ != nullptr) cached_.Recycle(&tmp_chunk_);
-      cached_.BeforeFirst();
+      FinalizeCacheFile();
+      pending_swap_ = true;
     }
+    if (pending_swap_) {
+      // first-pass end was observed (here or in EndOfPass); reads restart
+      // from the finalized cache only on an explicit reset
+      TCHECK(InitCachedIter()) << "failed to reopen cache file " << cache_file_;
+      pending_swap_ = false;
+      return;
+    }
+    if (tmp_chunk_ != nullptr) cached_.Recycle(&tmp_chunk_);
+    cached_.BeforeFirst();
   }
   void ResetPartition(unsigned, unsigned) override {
     TLOG(Fatal) << "CachedInputSplit cannot be re-partitioned (cache is per-part)";
@@ -57,20 +66,24 @@ class CachedInputSplit : public InputSplit {
   size_t GetTotalSize() override { return base_->GetTotalSize(); }
 
   bool NextRecord(Blob* out) override {
+    if (pending_swap_) return false;  // exhaustion is sticky until reset
     auto* iter = ActiveIter();
-    if (tmp_chunk_ == nullptr && !iter->Next(&tmp_chunk_)) return false;
+    touched_ = true;
+    if (tmp_chunk_ == nullptr && !iter->Next(&tmp_chunk_)) return EndOfPass();
     while (!base_->ExtractNextRecord(out, tmp_chunk_)) {
       iter->Recycle(&tmp_chunk_);
-      if (!iter->Next(&tmp_chunk_)) return false;
+      if (!iter->Next(&tmp_chunk_)) return EndOfPass();
     }
     return true;
   }
   bool NextChunk(Blob* out) override {
+    if (pending_swap_) return false;  // exhaustion is sticky until reset
     auto* iter = ActiveIter();
-    if (tmp_chunk_ == nullptr && !iter->Next(&tmp_chunk_)) return false;
+    touched_ = true;
+    if (tmp_chunk_ == nullptr && !iter->Next(&tmp_chunk_)) return EndOfPass();
     while (!base_->ExtractNextChunk(out, tmp_chunk_)) {
       iter->Recycle(&tmp_chunk_);
-      if (!iter->Next(&tmp_chunk_)) return false;
+      if (!iter->Next(&tmp_chunk_)) return EndOfPass();
     }
     return true;
   }
@@ -80,8 +93,44 @@ class CachedInputSplit : public InputSplit {
     return preproc_ != nullptr ? preproc_.get() : &cached_;
   }
 
+  /*! \brief first pass exhausted mid-stream: finalize the cache file but
+   *  stay exhausted (sticky false) until the caller's BeforeFirst —
+   *  matching the reference's contract that records only come back after
+   *  an explicit reset */
+  bool EndOfPass() {
+    if (preproc_ != nullptr) {
+      FinalizeCacheFile();
+      pending_swap_ = true;
+    }
+    return false;
+  }
+
+  /*! \brief close the tee and (for local paths) rename the .tmp over the
+   *  cache name — an interrupted run must never leave a truncated file
+   *  under the cache name, which later runs would silently replay as the
+   *  full dataset */
+  void FinalizeCacheFile() {
+    preproc_.reset();
+    if (fo_ != nullptr) fo_->Close();  // cache must be durable before reuse
+    fo_.reset();
+    if (!tmp_written_path_.empty()) {
+      if (std::rename(tmp_written_path_.c_str(), cache_file_.c_str()) != 0) {
+        TLOG(Warning) << "could not rename " << tmp_written_path_ << " -> "
+                      << cache_file_ << "; cache will not be reused";
+        cache_file_ = tmp_written_path_;
+      }
+      tmp_written_path_.clear();
+    }
+  }
+
   void InitPreprocIter() {
-    fo_ = Stream::Create(cache_file_.c_str(), "w");
+    // write-then-rename only works on local paths; remote cache URIs
+    // (std::rename cannot span backends) write the final name directly —
+    // the pre-atomicity behavior, durable but not interruption-safe
+    local_atomic_ = URI(cache_file_).protocol.empty();
+    tmp_written_path_ = local_atomic_ ? cache_file_ + ".tmp" : "";
+    fo_ = Stream::Create(local_atomic_ ? tmp_written_path_.c_str()
+                                       : cache_file_.c_str(), "w");
     preproc_ = std::make_unique<ThreadedIter<SplitterBase::Chunk>>(16);
     preproc_->Init([this](SplitterBase::Chunk** cell) {
       if (*cell == nullptr) *cell = new SplitterBase::Chunk(buffer_units_);
@@ -119,6 +168,10 @@ class CachedInputSplit : public InputSplit {
   std::unique_ptr<SplitterBase> base_;
   size_t buffer_units_;
   std::string cache_file_;
+  std::string tmp_written_path_;  // non-empty while a local first pass writes
+  bool touched_ = false;          // any record/chunk consumed yet
+  bool pending_swap_ = false;     // first pass done; awaiting BeforeFirst
+  bool local_atomic_ = false;     // rename-based finalize available
   std::unique_ptr<Stream> fo_;
   std::unique_ptr<SeekStream> fi_;
   std::unique_ptr<ThreadedIter<SplitterBase::Chunk>> preproc_;

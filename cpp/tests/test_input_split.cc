@@ -376,3 +376,68 @@ TESTCASE(fuzz_exactly_once_random_configs) {
 }
 
 TESTMAIN()
+
+TESTCASE(cached_split_interrupted_pass_leaves_no_cache) {
+  // a first pass abandoned mid-stream must not leave a file under the
+  // cache name (write-then-rename finalize); a fresh split re-reads the
+  // source and can then finalize normally
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(400, "i");
+  WriteFile(tmp.path + "/d.txt", Join(lines, "\n", true));
+  std::string cache = tmp.path + "/icache";
+  std::string uri = tmp.path + "/d.txt#" + cache;
+  {
+    auto split = InputSplit::Create(uri.c_str(), 0, 1, "text");
+    InputSplit::Blob b;
+    EXPECT_TRUE(split->NextRecord(&b));  // consume ONE record, abandon
+  }
+  EXPECT_TRUE(!std::filesystem::exists(cache));
+  EXPECT_TRUE(!std::filesystem::exists(cache + ".tmp"));  // tmp removed too
+  // fresh run: full epoch from the source, then the cache finalizes
+  auto split = InputSplit::Create(uri.c_str(), 0, 1, "text");
+  InputSplit::Blob b;
+  size_t n = 0;
+  while (split->NextRecord(&b)) ++n;
+  EXPECT_EQV(n, lines.size());
+  EXPECT_TRUE(std::filesystem::exists(cache));
+}
+
+TESTCASE(cached_split_construction_does_not_drain_source) {
+  // BeforeFirst ahead of any consumption must be a no-op in preproc mode:
+  // time-to-first-record stays one chunk, not a full source drain (the
+  // staging/parser ctors all call BeforeFirst up front)
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(100, "l");
+  WriteFile(tmp.path + "/d.txt", Join(lines, "\n", true));
+  std::string cache = tmp.path + "/lcache";
+  std::string uri = tmp.path + "/d.txt#" + cache;
+  auto split = InputSplit::Create(uri.c_str(), 0, 1, "text");
+  split->BeforeFirst();  // pre-consumption: must not finalize the cache
+  EXPECT_TRUE(!std::filesystem::exists(cache));
+  InputSplit::Blob b;
+  size_t n = 0;
+  while (split->NextRecord(&b)) ++n;
+  EXPECT_EQV(n, lines.size());
+  EXPECT_TRUE(std::filesystem::exists(cache));  // finalized on exhaustion
+}
+
+TESTCASE(cached_split_exhaustion_is_sticky_until_reset) {
+  // after the first pass ends, NextRecord keeps returning false until an
+  // explicit BeforeFirst (the reference contract; a generic while-loop
+  // re-entered without reset must not silently replay the dataset)
+  TemporaryDirectory tmp;
+  auto lines = MakeLines(50, "x");
+  WriteFile(tmp.path + "/d.txt", Join(lines, "\n", true));
+  std::string uri = tmp.path + "/d.txt#" + tmp.path + "/xcache";
+  auto split = InputSplit::Create(uri.c_str(), 0, 1, "text");
+  InputSplit::Blob b;
+  size_t n = 0;
+  while (split->NextRecord(&b)) ++n;
+  EXPECT_EQV(n, lines.size());
+  EXPECT_TRUE(!split->NextRecord(&b));  // still false, no replay
+  EXPECT_TRUE(!split->NextChunk(&b));
+  split->BeforeFirst();                 // reset: cache now serves
+  n = 0;
+  while (split->NextRecord(&b)) ++n;
+  EXPECT_EQV(n, lines.size());
+}

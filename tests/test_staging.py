@@ -174,3 +174,41 @@ def test_with_qid_stages_query_ids(tmp_path):
     # default: no qid column staged
     it2 = DeviceStagingIter(str(f), batch_size=8, nnz_bucket=8)
     assert next(iter(it2)).qid is None
+
+
+def test_cachefile_uri_sugar_through_staging(tmp_path):
+    """`uri#cachefile` flows through the staged pipeline: epoch 1 tees
+    chunks into the cache, epoch 2 replays from it — pinned by deleting
+    the source file between epochs (reference cached_input_split.h)."""
+    import numpy as np
+    src = tmp_path / "train.libsvm"
+    rng = np.random.default_rng(0)
+    lines = [f"{i % 2} {int(rng.integers(0, 9))}:1 9:{i}.5"
+             for i in range(200)]
+    src.write_text("\n".join(lines) + "\n")
+    cache = tmp_path / "train.cache"
+    from dmlc_core_tpu.data import DeviceStagingIter
+    it = DeviceStagingIter(f"{src}#{cache}", batch_size=64, nnz_bucket=64)
+
+    def epoch_sums():
+        rows = 0
+        vsum = 0.0
+        for b in it:
+            rows += int(np.asarray(b.weight).sum())
+            vsum += float(np.asarray(b.value).sum())
+        return rows, vsum
+
+    first = epoch_sums()
+    assert first[0] == 200
+    # parser-fed pipelines cache at the CHUNK level with a distinct suffix
+    # (DiskRowIter owns the un-suffixed name for its parsed-page cache);
+    # the finalized cache exists only under its real name (write-then-
+    # rename: an interrupted first pass leaves only a .tmp file behind)
+    chunk_cache = cache.with_name(cache.name + ".chunks")
+    assert chunk_cache.exists() and chunk_cache.stat().st_size > 0
+    assert not chunk_cache.with_name(chunk_cache.name + ".tmp").exists()
+    src.unlink()  # epoch 2 must come from the cache
+    second = epoch_sums()
+    assert second[0] == 200
+    np.testing.assert_allclose(second[1], first[1], rtol=1e-6)
+
