@@ -1183,6 +1183,32 @@ class GBDT:
         m = self.margins_batch(params, batch, binner)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
 
+    def predict_staged(self, params: dict, uri: str,
+                       binner: QuantileBinner, batch_size: int = 65536,
+                       **staging_kwargs) -> np.ndarray:
+        """Streaming inference over a whole dataset URI: stage sparse
+        batches (`DeviceStagingIter`), score each with the sparse-native
+        routing, and return the real rows' predictions in file order
+        (padding rows dropped).  Any staging kwarg (part/num_parts,
+        format, nnz_bucket, ...) passes through."""
+        from ..data import DeviceStagingIter
+
+        it = DeviceStagingIter(uri, batch_size=batch_size, **staging_kwargs)
+        outs = []
+        try:
+            for batch in it:
+                pred = np.asarray(self.predict_batch(params, batch, binner))
+                # padding is tail-only on single-host batches: slice by the
+                # real-row count (a weight>0 filter would silently drop
+                # legitimately zero-weighted file rows and misalign output)
+                outs.append(pred[:int(batch.num_rows)])
+        finally:
+            it.close()
+        if not outs:
+            shape = (0, self.num_class) if self.objective == "softmax" else (0,)
+            return np.zeros(shape, np.float32)
+        return np.concatenate(outs)
+
     @functools.partial(jax.jit, static_argnums=0)
     def margins(self, params: dict, bins: jax.Array) -> jax.Array:
         # forests checkpointed before default_right existed predict as

@@ -937,3 +937,55 @@ def test_gamma_prunes_low_gain_splits():
     import pytest
     with pytest.raises(ValueError, match="gamma"):
         GBDT(num_features=3, gamma=-1.0)
+
+
+def test_predict_staged_streams_file_order(tmp_path):
+    """predict_staged: whole-file streaming inference through the staged
+    pipeline, predictions in file order with padding rows dropped."""
+    rng = np.random.default_rng(27)
+    lines = []
+    for i in range(700):
+        v0, v1 = rng.uniform(0.1, 2.0, 2)
+        y = int(v0 > v1)
+        lines.append(f"{y} 0:{v0:.4f} 1:{v1:.4f}")
+    f = tmp_path / "d.libsvm"
+    f.write_text("\n".join(lines) + "\n")
+
+    from dmlc_core_tpu.data import DeviceStagingIter
+    it = DeviceStagingIter(str(f), batch_size=1024)
+    big = next(iter(it))
+    it.close()
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    mask = np.asarray(big.value) != 0
+    binner.fit_sparse(np.asarray(big.index)[mask],
+                      np.asarray(big.value)[mask], num_features=2)
+    model = GBDT(num_features=2, num_trees=8, max_depth=3, num_bins=16,
+                 learning_rate=0.5, missing_aware=True)
+    params = model.fit_batch(big, binner)
+
+    # small batches force multiple staged rounds; order must match
+    streamed = model.predict_staged(params, str(f), binner, batch_size=128)
+    assert streamed.shape == (700,)
+    whole = np.asarray(model.predict_batch(params, big, binner))[
+        np.asarray(big.weight) > 0]
+    np.testing.assert_allclose(streamed, whole, rtol=1e-5, atol=1e-6)
+    acc = float(np.mean((streamed > 0.5) ==
+                        (np.array([int(l.split()[0]) for l in lines]) > 0.5)))
+    assert acc > 0.9
+    # a zero-byte file errors at creation (no files match / empty split)...
+    empty = tmp_path / "none.libsvm"
+    empty.write_text("")
+    import pytest
+    from dmlc_core_tpu._native import NativeError
+    with pytest.raises(NativeError):
+        model.predict_staged(params, str(empty), binner)
+    # ...while whitespace-only input stages zero batches -> empty output
+    blank = tmp_path / "blank.libsvm"
+    blank.write_text("\n\n\n")
+    out = model.predict_staged(params, str(blank), binner)
+    assert out.shape == (0,)
+    # zero-weighted REAL rows stay in the output (alignment contract)
+    wfile = tmp_path / "w.libsvm"
+    wfile.write_text("1:0.0 0:1.5 1:0.2\n0 0:0.1 1:1.9\n")
+    out = model.predict_staged(params, str(wfile), binner)
+    assert out.shape == (2,)
