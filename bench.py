@@ -330,10 +330,20 @@ import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
 from jax.sharding import Mesh
-from dmlc_core_tpu.parallel.collective import allreduce_bench
+from dmlc_core_tpu.parallel import collective_bench
 mesh = Mesh(np.asarray(jax.devices()), ("data",))
-out = allreduce_bench(mesh, mib_per_device=16.0, iters=5)
+out = collective_bench(mesh, "allreduce", mib_per_device=16.0, iters=5)
+# primary metric goes out FIRST: a failure in the extra ops must never
+# cost the allreduce number (VERDICT r1 item 8)
 print("ALLREDUCE " + json.dumps(out), flush=True)
+others = {}
+for op in ("allgather", "reducescatter", "ppermute"):
+    try:
+        others[op] = round(collective_bench(mesh, op, mib_per_device=8.0,
+                                            iters=3)["bus_gbps"], 3)
+    except Exception as e:  # noqa: BLE001
+        others[op] = f"error: {str(e)[-120:]}"
+print("EXTRAS " + json.dumps(others), flush=True)
 """
 
 
@@ -360,6 +370,8 @@ def run_allreduce() -> dict:
         for line in proc.stdout.splitlines():
             if line.startswith("ALLREDUCE "):
                 result = json.loads(line[len("ALLREDUCE "):])
+            elif line.startswith("EXTRAS "):
+                result["others"] = json.loads(line[len("EXTRAS "):])
         if not result:
             result = {"error": proc.stderr[-300:]}
     except subprocess.TimeoutExpired:
@@ -834,6 +846,7 @@ def main() -> None:
         "allreduce_platform": allreduce.get("platform"),
         "allreduce_devices": allreduce.get("devices"),
         "allreduce_note": allreduce.get("note") or allreduce.get("error"),
+        "collectives_bus_gbps": allreduce.get("others"),
         "gbdt_row_trees_per_sec": phases.get("gbdt", {}).get("row_trees_s"),
         "gbdt_sparse_row_trees_per_sec": phases.get("gbdt", {}).get(
             "sparse_row_trees_s"),

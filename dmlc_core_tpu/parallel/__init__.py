@@ -1,10 +1,10 @@
 """Distributed execution: device meshes, collectives, multi-host bootstrap."""
 from .mesh import make_mesh, data_sharding, replicated_sharding
-from .collective import allreduce, allreduce_bench
+from .collective import allreduce, allreduce_bench, collective_bench
 from .bootstrap import init_from_env, dmlc_env_info
 
 __all__ = [
     "make_mesh", "data_sharding", "replicated_sharding",
-    "allreduce", "allreduce_bench",
+    "allreduce", "allreduce_bench", "collective_bench",
     "init_from_env", "dmlc_env_info",
 ]

@@ -36,41 +36,82 @@ def allreduce(x: jax.Array, op: str = "sum", axis_name: str = "data") -> jax.Arr
     return fn(x, axis_name)
 
 
-def _bench_step(mesh: Mesh, nfloats_per_dev: int):
-    """Build a jitted shard_map that psums one f32 buffer per device."""
-    try:
-        from jax import shard_map  # jax >= 0.8 stable location
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map
-
-    def reduce_fn(x):
-        return jax.lax.psum(x, "data")
-
-    sharded = shard_map(reduce_fn, mesh=mesh, in_specs=P("data"), out_specs=P())
-    return jax.jit(sharded)
-
-
 def allreduce_bench(mesh: Mesh, mib_per_device: float = 64.0, iters: int = 10) -> dict:
     """Measure all-reduce bus bandwidth over the mesh's ``data`` axis.
 
     Returns {bytes, seconds_per_iter, algo_gbps, bus_gbps}.  Bus bandwidth
     uses the standard 2(n-1)/n ring factor.
     """
+    return collective_bench(mesh, "allreduce", mib_per_device, iters)
+
+
+# per-op (kernel builder, out spec, algbw size base as a function of the
+# per-device bytes, bus-bandwidth ring factor).  Conventions follow
+# NCCL-tests so numbers compare across stacks: the algbw base is the
+# TOTAL data size of the op (allgather: n * sendcount; the others equal
+# the per-device buffer), bus factors allreduce 2(n-1)/n,
+# allgather/reducescatter (n-1)/n, ppermute 1 (pure point-to-point).
+def _kernels():
+    def allreduce_fn(x):
+        return jax.lax.psum(x, "data")
+
+    def allgather_fn(x):
+        return jax.lax.all_gather(x, "data")
+
+    def reducescatter_fn(x):
+        return jax.lax.psum_scatter(x, "data", tiled=True)
+
+    def ppermute_fn(x):
+        n = jax.lax.axis_size("data")
+        return jax.lax.ppermute(x, "data",
+                                [(i, (i + 1) % n) for i in range(n)])
+
+    one = lambda n: 1.0  # noqa: E731
+    return {
+        "allreduce": (allreduce_fn, P(), one, lambda n: 2.0 * (n - 1) / n),
+        "allgather": (allgather_fn, P(None, "data"), lambda n: float(n),
+                      lambda n: (n - 1) / n),
+        "reducescatter": (reducescatter_fn, P("data"), one,
+                          lambda n: (n - 1) / n),
+        "ppermute": (ppermute_fn, P("data"), one, lambda n: 1.0),
+    }
+
+
+def collective_bench(mesh: Mesh, op: str = "allreduce",
+                     mib_per_device: float = 64.0, iters: int = 10) -> dict:
+    """Bandwidth of one XLA collective over the mesh's ``data`` axis — the
+    ICI/DCN data plane the reference's TCP tree+ring bootstrap hands off
+    to (SURVEY §5 'distributed communication backend').
+
+    op: "allreduce" | "allgather" | "reducescatter" | "ppermute".
+    Returns {devices, bytes, seconds_per_iter, algo_gbps, bus_gbps, op}.
+    """
+    kernels = _kernels()
+    try:
+        fn, out_spec, size_base, bus_factor = kernels[op]
+    except KeyError:
+        raise ValueError(
+            f"unknown collective '{op}' (have {sorted(kernels)})") from None
+    try:
+        from jax import shard_map  # jax >= 0.8 stable location
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+
     n = mesh.devices.size
     nfloats = int(mib_per_device * (1 << 20) // 4)
-    step = _bench_step(mesh, nfloats)
+    step = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"),
+                             out_specs=out_spec))
     x = jax.device_put(
-        np.random.default_rng(0).standard_normal((n * nfloats,), dtype=np.float32),
+        np.random.default_rng(0).standard_normal((n * nfloats,),
+                                                 dtype=np.float32),
         NamedSharding(mesh, P("data")))
-    # warmup + compile
-    step(x).block_until_ready()
+    step(x).block_until_ready()  # warmup + compile
     watch = Stopwatch()
     for _ in range(iters):
         out = step(x)
     out.block_until_ready()
     secs = watch.elapsed() / iters
-    nbytes = nfloats * 4
+    nbytes = int(nfloats * 4 * size_base(n))  # NCCL-tests size convention
     algo = nbytes / secs / 1e9
-    bus = algo * (2.0 * (n - 1) / n)
     return {"devices": n, "bytes": nbytes, "seconds_per_iter": secs,
-            "algo_gbps": algo, "bus_gbps": bus}
+            "algo_gbps": algo, "bus_gbps": algo * bus_factor(n), "op": op}

@@ -120,3 +120,19 @@ def test_csr_to_dense_matches_scatter():
     for r, i, v in zip(row_id, index, value):
         want[r, i] += v
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_collective_bench_all_ops():
+    """Every XLA-collective primitive of the data plane benches on the
+    virtual mesh (allreduce/allgather/reducescatter/ppermute)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from dmlc_core_tpu.parallel import collective_bench
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    for op in ("allreduce", "allgather", "reducescatter", "ppermute"):
+        out = collective_bench(mesh, op, mib_per_device=0.5, iters=2)
+        assert out["op"] == op and out["devices"] == 8
+        assert out["bus_gbps"] > 0
+    import pytest
+    with pytest.raises(ValueError, match="unknown collective"):
+        collective_bench(mesh, "nope")
