@@ -277,7 +277,11 @@ class GBDT:
     (per-feature -1/0/+1: violating splits are gain-masked, per-node
     output bounds propagate down the tree, and leaves clamp into them —
     the forest is guaranteed monotone in constrained features'
-    present values), ``subsample`` /
+    present values), ``interaction_constraints`` (feature groups; every
+    root-to-leaf path's splits stay within one group, via per-node
+    allowed-feature masks propagated down the levels),
+    ``colsample_bylevel`` (a fresh feature draw per depth, composing with
+    colsample_bytree), ``subsample`` /
     ``colsample_bytree`` in (0, 1] (stochastic boosting: a per-tree
     Bernoulli row mask folded into the sample weights, and a per-tree
     feature subset masking the split gains — both derived from ``seed``
@@ -313,7 +317,9 @@ class GBDT:
                  colsample_bytree: float = 1.0,
                  seed: int = 0,
                  num_class: int = 0,
-                 monotone_constraints=None):
+                 monotone_constraints=None,
+                 colsample_bylevel: float = 1.0,
+                 interaction_constraints=None):
         if objective not in ("logistic", "squared", "softmax",
                              "rank:pairwise"):
             raise ValueError(f"unknown objective '{objective}'")
@@ -356,6 +362,35 @@ class GBDT:
             else:
                 monotone_constraints = jnp.asarray(mc)
         self.monotone_constraints = monotone_constraints
+        if not 0.0 < colsample_bylevel <= 1.0:
+            raise ValueError("colsample_bylevel must be in (0, 1]")
+        self.colsample_bylevel = colsample_bylevel
+        self._interaction_groups = None
+        if interaction_constraints is not None:
+            # membership[g, f]: feature f belongs to group g.  XGBoost
+            # semantics need group IDENTITY (a pairwise co-occurrence
+            # union over-permits with overlapping groups): each node
+            # tracks which groups remain active, a split on f keeps only
+            # the active groups containing f, and the node's allowed
+            # features are the union of its active groups.  Features in
+            # no group become singletons.
+            rows = []
+            grouped = np.zeros(num_features, dtype=bool)
+            for group in interaction_constraints:
+                g = np.asarray(group, np.int64)
+                if g.size and ((g < 0) | (g >= num_features)).any():
+                    raise ValueError(
+                        "interaction_constraints feature ids must be in "
+                        f"[0, {num_features})")
+                row = np.zeros(num_features, dtype=bool)
+                row[g] = True
+                rows.append(row)
+                grouped[g] = True
+            for f in np.flatnonzero(~grouped):
+                row = np.zeros(num_features, dtype=bool)
+                row[f] = True
+                rows.append(row)
+            self._interaction_groups = jnp.asarray(np.stack(rows))  # [G, F]
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -386,13 +421,16 @@ class GBDT:
     def _pick_splits(self, gain: jax.Array, col_mask: jax.Array):
         """Flat argmax over a [nodes, F, B, n_dir] gain array plus
         null-split encoding; shared by the dense and sparse builders.
-        ``col_mask`` [F] disables unsampled features (colsample_bytree).
+        ``col_mask`` disables features: [F] (colsample_bytree / bylevel)
+        or [nodes, F] (per-node interaction constraints).
         Returns (split_f, split_b, split_d, split_gain) with nulls encoded
         as (0, num_bins, 0, 0.0)."""
         n_nodes = gain.shape[0]
         B = self.num_bins
         n_dir = gain.shape[3]
-        gain = jnp.where(col_mask[None, :, None, None], gain, -jnp.inf)
+        mask = (col_mask[None, :, None, None] if col_mask.ndim == 1
+                else col_mask[:, :, None, None])
+        gain = jnp.where(mask, gain, -jnp.inf)
         flat = gain.reshape(n_nodes, -1)
         best_flat = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best_flat[:, None], 1)[:, 0]
@@ -486,7 +524,7 @@ class GBDT:
         """Shared boosting driver (base prior, tree loop, stochastic
         row/column sampling, stacking) for the dense (`fit`) and
         sparse-native (`fit_batch`) input paths.
-        ``build_tree(grad, hess, col_mask)`` returns `_build_tree`'s
+        ``build_tree(grad, hess, col_mask, col_key)`` returns `_build_tree`'s
         7-tuple.
 
         Early stopping: ``eval_margin(tree_params) -> per-row margins`` on
@@ -519,8 +557,9 @@ class GBDT:
         for t_idx in range(self.num_trees):
             g, h = grad_hess(margin, label)
             w_t, col_mask = self._tree_sampling(root_key, t_idx, w)
+            ck = jax.random.fold_in(root_key, 1_000_000 + t_idx)
             f, t, d, sg, sc, leaf, leaf_rel = build_tree(g * w_t, h * w_t,
-                                                         col_mask)
+                                                         col_mask, ck)
             margin = margin + leaf[leaf_rel]
             feats.append(f)
             thrs.append(t)
@@ -603,6 +642,41 @@ class GBDT:
         lo2 = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
         hi2 = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
         return lo2, hi2
+
+    def _level_feature_mask(self, col_mask, col_key, depth: int, active):
+        """Effective feature mask for one level: the per-tree mask, an
+        optional fresh colsample_bylevel draw (sampled WITHIN the tree
+        subset, so the intersection can never go empty), and the per-node
+        interaction allowed sets.  Returns [F] or [nodes, F]."""
+        eff = col_mask
+        if self.colsample_bylevel < 1.0:
+            k_tree = (max(1, int(round(self.colsample_bytree
+                                       * self.num_features)))
+                      if self.colsample_bytree < 1.0 else self.num_features)
+            k_level = max(1, int(round(self.colsample_bylevel * k_tree)))
+            kd = jax.random.fold_in(col_key, depth)
+            scores = jnp.where(col_mask,
+                               jax.random.uniform(kd, (self.num_features,)),
+                               jnp.inf)
+            thresh = jnp.sort(scores)[k_level - 1]
+            eff = scores <= thresh
+        if active is not None:
+            # allowed features per node = union of its active groups
+            allowed = jnp.einsum("ng,gf->nf", active,
+                                 self._interaction_groups) > 0
+            return allowed & eff[None, :]
+        return eff
+
+    def _next_active(self, active, split_f, split_b):
+        """Propagate interaction-constraint group sets to the children: a
+        real split on f keeps only the active groups CONTAINING f (group
+        identity, not pairwise co-occurrence — overlapping groups stay
+        correct); null splits pass through.  [n, G] -> [2n, G] in heap
+        child order."""
+        null = (split_b >= self.num_bins)[:, None]
+        in_group = self._interaction_groups[:, split_f].T  # [n, G]
+        nxt = jnp.where(null, active, active & in_group)
+        return jnp.repeat(nxt, 2, axis=0)
 
     def _tree_sampling(self, root_key, t_idx: int, w: jax.Array):
         """Per-tree stochastic-GBM masks, shared by every boosting driver:
@@ -696,8 +770,9 @@ class GBDT:
                 g = (p[:, k] - onehot[:, k])
                 h = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-16)
                 w_t, col_mask = self._tree_sampling(root_key, t_idx, w)
+                ck = jax.random.fold_in(root_key, 1_000_000 + t_idx)
                 f, t, d, sg, sc, leaf, leaf_rel = build_tree(
-                    g * w_t, h * w_t, col_mask)
+                    g * w_t, h * w_t, col_mask, ck)
                 margin = margin.at[:, k].add(leaf[leaf_rel])
                 feats.append(f)
                 thrs.append(t)
@@ -725,7 +800,7 @@ class GBDT:
 
     @functools.partial(jax.jit, static_argnums=0)
     def _build_tree(self, bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                    col_mask: jax.Array
+                    col_mask: jax.Array, col_key: jax.Array
                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
                                jax.Array, jax.Array, jax.Array]:
         """One tree from per-row (grad, hess); levels unrolled under jit.
@@ -744,6 +819,8 @@ class GBDT:
         mono = self.monotone_constraints is not None
         lo = jnp.full(1, -jnp.inf)
         hi = jnp.full(1, jnp.inf)
+        active = (jnp.ones((1, self._interaction_groups.shape[0]), bool)
+                  if self._interaction_groups is not None else None)
         features = []
         thresholds = []
         defaults = []
@@ -795,11 +872,15 @@ class GBDT:
             if mono:
                 wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
                 gain = self._apply_monotone(gain, wl, wr, lo, hi)
+            node_mask = self._level_feature_mask(col_mask, col_key, depth,
+                                                 active)
             split_f, split_b, split_d, split_g = self._pick_splits(gain,
-                                                                   col_mask)
+                                                                   node_mask)
             if mono:
                 lo, hi = self._child_bounds(split_f, split_b, split_d,
                                             wl, wr, lo, hi)
+            if active is not None:
+                active = self._next_active(active, split_f, split_b)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -852,7 +933,7 @@ class GBDT:
     def _build_tree_sparse(self, row_id: jax.Array, findex: jax.Array,
                            ebin: jax.Array, emask: jax.Array,
                            grad: jax.Array, hess: jax.Array,
-                           col_mask: jax.Array):
+                           col_mask: jax.Array, col_key: jax.Array):
         """One tree from COO entries — O(nnz) histogram work per level.
 
         The sparse formulation of `_build_tree`: present entries scatter
@@ -881,6 +962,8 @@ class GBDT:
         mono = self.monotone_constraints is not None
         lo = jnp.full(1, -jnp.inf)
         hi = jnp.full(1, jnp.inf)
+        active = (jnp.ones((1, self._interaction_groups.shape[0]), bool)
+                  if self._interaction_groups is not None else None)
         features, thresholds, defaults, gains, covers = [], [], [], [], []
         for depth in range(self.max_depth):
             first = 2 ** depth - 1
@@ -914,11 +997,15 @@ class GBDT:
             if mono:
                 wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
                 gain = self._apply_monotone(gain, wl, wr, lo, hi)
+            node_mask = self._level_feature_mask(col_mask, col_key, depth,
+                                                 active)
             split_f, split_b, split_d, split_g = self._pick_splits(gain,
-                                                                   col_mask)
+                                                                   node_mask)
             if mono:
                 lo, hi = self._child_bounds(split_f, split_b, split_d,
                                             wl, wr, lo, hi)
+            if active is not None:
+                active = self._next_active(active, split_f, split_b)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -1030,8 +1117,8 @@ class GBDT:
                           len(eval_set) > 3 else None),
                 eval_w=eval_weight, have_eval=eval_set is not None)
             return self._boost(label, w,
-                               lambda g, h, cm: self._build_tree(bins, g, h,
-                                                                 cm),
+                               lambda g, h, cm, ck: self._build_tree(
+                                   bins, g, h, cm, ck),
                                eval_margin=eval_margin,
                                eval_label=eval_label,
                                eval_weight=eval_weight,
@@ -1041,7 +1128,8 @@ class GBDT:
         driver = (self._boost_multi if self.objective == "softmax"
                   else self._boost)
         return driver(label, w,
-                      lambda g, h, cm: self._build_tree(bins, g, h, cm),
+                      lambda g, h, cm, ck: self._build_tree(bins, g, h,
+                                                            cm, ck),
                       eval_margin=eval_margin, eval_label=eval_label,
                       eval_weight=eval_weight,
                       early_stopping_rounds=early_stopping_rounds)
@@ -1107,8 +1195,8 @@ class GBDT:
                 have_eval=eval_set is not None)
             return self._boost(
                 label, w,
-                lambda g, h, cm: self._build_tree_sparse(
-                    row_id, findex, ebin, emask, g, h, cm),
+                lambda g, h, cm, ck: self._build_tree_sparse(
+                    row_id, findex, ebin, emask, g, h, cm, ck),
                 eval_margin=eval_margin, eval_label=eval_label,
                 eval_weight=eval_weight,
                 early_stopping_rounds=early_stopping_rounds,
@@ -1117,8 +1205,8 @@ class GBDT:
                   else self._boost)
         return driver(
             label, w,
-            lambda g, h, cm: self._build_tree_sparse(row_id, findex, ebin,
-                                                     emask, g, h, cm),
+            lambda g, h, cm, ck: self._build_tree_sparse(
+                row_id, findex, ebin, emask, g, h, cm, ck),
             eval_margin=eval_margin, eval_label=eval_label,
             eval_weight=eval_weight,
             early_stopping_rounds=early_stopping_rounds)

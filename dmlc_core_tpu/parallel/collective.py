@@ -99,6 +99,10 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
 
     n = mesh.devices.size
     nfloats = int(mib_per_device * (1 << 20) // 4)
+    # reducescatter (tiled psum_scatter) needs the per-device count
+    # divisible by the axis size; rounding down keeps every op valid on
+    # non-power-of-two meshes
+    nfloats = max(n, nfloats - nfloats % n)
     step = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"),
                              out_specs=out_spec))
     x = jax.device_put(

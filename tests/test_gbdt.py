@@ -989,3 +989,87 @@ def test_predict_staged_streams_file_order(tmp_path):
     wfile.write_text("1:0.0 0:1.5 1:0.2\n0 0:0.1 1:1.9\n")
     out = model.predict_staged(params, str(wfile), binner)
     assert out.shape == (2,)
+
+
+def test_interaction_constraints_respected_on_every_path():
+    """interaction_constraints: features on any root-to-leaf path stay
+    within one allowed group (checked structurally over every tree), and
+    the model still learns within-group interactions."""
+    rng = np.random.default_rng(28)
+    x = rng.uniform(-1, 1, size=(4000, 4)).astype(np.float32)
+    # label needs (0 xor 1) and (2 > t): groups {0,1} and {2,3} suffice
+    y = (((x[:, 0] > 0) ^ (x[:, 1] > 0)) & (x[:, 2] > -0.5)
+         ).astype(np.float32)
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+    model = GBDT(num_features=4, num_trees=12, max_depth=4, num_bins=32,
+                 learning_rate=0.4,
+                 interaction_constraints=[[0, 1], [2, 3]])
+    params = model.fit(bins, jnp.asarray(y))
+
+    feat = np.asarray(params["feature"])
+    thr = np.asarray(params["threshold"])
+    groups = [{0, 1}, {2, 3}]
+    n_internal = feat.shape[1]
+    for t in range(feat.shape[0]):
+        # walk every root-to-leaf path of the complete heap
+        def walk(node, used):
+            if node >= n_internal:
+                if used:
+                    assert any(used <= g for g in groups), (t, used)
+                return
+            u = used | ({int(feat[t, node])} if thr[t, node] < 32 else set())
+            walk(2 * node + 1, u)
+            walk(2 * node + 2, u)
+        walk(0, set())
+    acc = float(jnp.mean((model.predict(params, bins) > 0.5) == (y > 0.5)))
+    assert acc > 0.85, acc
+
+    # OVERLAPPING groups need group identity, not pairwise co-occurrence:
+    # with [[0,1,2],[0,3],[1,3]] a path splitting 0 then 1 must stay
+    # within {0,1,2} (no group contains {0,1,3})
+    ov_groups = [{0, 1, 2}, {0, 3}, {1, 3}]
+    model_ov = GBDT(num_features=4, num_trees=10, max_depth=4, num_bins=32,
+                    learning_rate=0.4,
+                    interaction_constraints=[[0, 1, 2], [0, 3], [1, 3]])
+    p_ov = model_ov.fit(bins, jnp.asarray(y))
+    feat_o = np.asarray(p_ov["feature"])
+    thr_o = np.asarray(p_ov["threshold"])
+    for t in range(feat_o.shape[0]):
+        def walk_o(node, used):
+            if node >= n_internal:
+                if used:
+                    assert any(used <= g for g in ov_groups), (t, used)
+                return
+            u = used | ({int(feat_o[t, node])} if thr_o[t, node] < 32
+                        else set())
+            walk_o(2 * node + 1, u)
+            walk_o(2 * node + 2, u)
+        walk_o(0, set())
+
+    import pytest
+    with pytest.raises(ValueError, match="interaction_constraints"):
+        GBDT(num_features=4, interaction_constraints=[[0, 9]])
+
+
+def test_colsample_bylevel_deterministic_and_learns():
+    rng = np.random.default_rng(29)
+    x = rng.uniform(-1, 1, size=(3000, 8)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 3] - 0.4 * x[:, 6] > 0).astype(np.float32)
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+    kwargs = dict(num_features=8, num_trees=15, max_depth=4, num_bins=32,
+                  learning_rate=0.4, colsample_bylevel=0.5, seed=6)
+    p1 = GBDT(**kwargs).fit(bins, jnp.asarray(y))
+    p2 = GBDT(**kwargs).fit(bins, jnp.asarray(y))
+    np.testing.assert_array_equal(np.asarray(p1["feature"]),
+                                  np.asarray(p2["feature"]))
+    # differs from the unsampled forest
+    p_full = GBDT(**{**kwargs, "colsample_bylevel": 1.0}).fit(
+        bins, jnp.asarray(y))
+    assert not np.array_equal(np.asarray(p1["feature"]),
+                              np.asarray(p_full["feature"]))
+    m = GBDT(**kwargs)
+    acc = float(jnp.mean((m.predict(p1, bins) > 0.5) == (y > 0.5)))
+    assert acc > 0.9, acc
+    import pytest
+    with pytest.raises(ValueError, match="colsample_bylevel"):
+        GBDT(num_features=8, colsample_bylevel=0.0)
