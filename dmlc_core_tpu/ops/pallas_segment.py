@@ -42,21 +42,29 @@ def _seg_kernel(row_id_ref, contrib_ref, out_ref):
     # rows covered by this out tile, absolute ids
     rows = rt * _ROW_TILE + jax.lax.broadcasted_iota(jnp.int32, (1, _ROW_TILE), 1)
     rid = row_id_ref[...]          # [1, NNZ_TILE] int32
-    contrib = contrib_ref[...]     # [1, NNZ_TILE] f32
+    contrib = contrib_ref[...]     # [L, NNZ_TILE] f32 (L lanes)
     onehot = (rid[0, :, None] == rows[0, None, :]).astype(jnp.float32)
-    # [1, NNZ] @ [NNZ, ROWS] -> [1, ROWS]; accumulate across nnz steps
+    # [L, NNZ] @ [NNZ, ROWS] -> [L, ROWS]; accumulate across nnz steps
     out_ref[...] += jnp.dot(contrib, onehot, preferred_element_type=jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
 def _segment_sum_pallas(contrib: jax.Array, row_id: jax.Array,
                         num_segments: int, interpret: bool) -> jax.Array:
-    nnz = contrib.shape[0]
+    """contrib: [nnz] or [nnz, L] (multi-lane — e.g. (grad, hess) carried
+    through one kernel, the shape the GBDT histogram build uses)."""
+    lanes = 1 if contrib.ndim == 1 else contrib.shape[1]
+    if contrib.shape[0] == 0:  # empty shard: zero histogram, like XLA
+        shape = ((num_segments,) if contrib.ndim == 1
+                 else (num_segments, lanes))
+        return jnp.zeros(shape, jnp.float32)
+    contrib2 = contrib.reshape(contrib.shape[0], lanes).T  # [L, nnz]
+    nnz = contrib2.shape[1]
     nnz_pad = pl.cdiv(nnz, _NNZ_TILE) * _NNZ_TILE
     rows_pad = pl.cdiv(num_segments, _ROW_TILE) * _ROW_TILE
     # pad entries land in an out-of-range row with contribution 0
-    contrib_p = jnp.zeros((1, nnz_pad), jnp.float32).at[0, :nnz].set(
-        contrib.astype(jnp.float32))
+    contrib_p = jnp.zeros((lanes, nnz_pad), jnp.float32).at[:, :nnz].set(
+        contrib2.astype(jnp.float32))
     row_id_p = jnp.full((1, nnz_pad), rows_pad, jnp.int32).at[0, :nnz].set(
         row_id.astype(jnp.int32))
     out = pl.pallas_call(
@@ -64,19 +72,22 @@ def _segment_sum_pallas(contrib: jax.Array, row_id: jax.Array,
         grid=(rows_pad // _ROW_TILE, nnz_pad // _NNZ_TILE),
         in_specs=[
             pl.BlockSpec((1, _NNZ_TILE), lambda rt, nt: (0, nt)),
-            pl.BlockSpec((1, _NNZ_TILE), lambda rt, nt: (0, nt)),
+            pl.BlockSpec((lanes, _NNZ_TILE), lambda rt, nt: (0, nt)),
         ],
-        out_specs=pl.BlockSpec((1, _ROW_TILE), lambda rt, nt: (0, rt)),
-        out_shape=jax.ShapeDtypeStruct((1, rows_pad), jnp.float32),
+        out_specs=pl.BlockSpec((lanes, _ROW_TILE), lambda rt, nt: (0, rt)),
+        out_shape=jax.ShapeDtypeStruct((lanes, rows_pad), jnp.float32),
         interpret=interpret,
     )(row_id_p, contrib_p)
-    return out[0, :num_segments]
+    res = out[:, :num_segments]
+    return res[0] if contrib.ndim == 1 else res.T
 
 
 def segment_sum(contrib: jax.Array, row_id: jax.Array, num_segments: int,
                 force: str | None = None) -> jax.Array:
     """Segment-sum with selectable backend.
 
+    contrib: [nnz] or [nnz, L] (multi-lane statistics share one pass —
+    the key/one-hot work is amortized over the lanes).
     force: None/"xla" -> jax.ops.segment_sum (scatter-add);
            "pallas"   -> the tiled one-hot contraction kernel above
                          (interpret mode off-TPU).

@@ -41,3 +41,26 @@ def test_padding_entries_inert():
     row_id = jnp.asarray([0, 1, 3, 3], jnp.int32)
     got = segment_sum(contrib, row_id, 4, force="pallas")
     np.testing.assert_allclose(np.asarray(got), [1.0, 2.0, 0.0, 0.0])
+
+
+def test_multilane_matches_xla():
+    """[nnz, L] lanes (the fused (grad, hess) histogram shape) share one
+    kernel pass and match per-lane XLA segment sums."""
+    rng = np.random.default_rng(4)
+    nnz, rows, L = 2048, 300, 2
+    row_id = jnp.asarray(rng.integers(0, rows, nnz).astype(np.int32))
+    contrib = jnp.asarray(rng.standard_normal((nnz, L)).astype(np.float32))
+    got = segment_sum(contrib, row_id, rows, force="pallas")
+    want = segment_sum(contrib, row_id, rows)  # xla handles ND natively
+    assert got.shape == (rows, L)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_empty_input_returns_zeros():
+    got = segment_sum(jnp.zeros((0,), jnp.float32),
+                      jnp.zeros((0,), jnp.int32), 8, force="pallas")
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(8, np.float32))
+    got2 = segment_sum(jnp.zeros((0, 2), jnp.float32),
+                       jnp.zeros((0,), jnp.int32), 8, force="pallas")
+    assert got2.shape == (8, 2) and not np.asarray(got2).any()
