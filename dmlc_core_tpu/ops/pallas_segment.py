@@ -53,6 +53,9 @@ def _segment_sum_pallas(contrib: jax.Array, row_id: jax.Array,
                         num_segments: int, interpret: bool) -> jax.Array:
     """contrib: [nnz] or [nnz, L] (multi-lane — e.g. (grad, hess) carried
     through one kernel, the shape the GBDT histogram build uses)."""
+    if contrib.ndim > 2:
+        raise ValueError("pallas segment_sum supports [nnz] or [nnz, L] "
+                         f"contrib, got shape {contrib.shape}")
     lanes = 1 if contrib.ndim == 1 else contrib.shape[1]
     if contrib.shape[0] == 0:  # empty shard: zero histogram, like XLA
         shape = ((num_segments,) if contrib.ndim == 1
