@@ -281,7 +281,11 @@ class GBDT:
     root-to-leaf path's splits stay within one group, via per-node
     allowed-feature masks propagated down the levels),
     ``colsample_bylevel`` (a fresh feature draw per depth, composing with
-    colsample_bytree), ``subsample`` /
+    colsample_bytree), ``base_score`` (initial prediction — a probability
+    for the logistic objective per XGBoost semantics, a raw margin for
+    squared/softmax; None derives the weighted prior from the data),
+    ``scale_pos_weight`` (positive-class weight multiplier, logistic
+    only — weight rows directly for other objectives), ``subsample`` /
     ``colsample_bytree`` in (0, 1] (stochastic boosting: a per-tree
     Bernoulli row mask folded into the sample weights, and a per-tree
     feature subset masking the split gains — both derived from ``seed``
@@ -319,7 +323,9 @@ class GBDT:
                  num_class: int = 0,
                  monotone_constraints=None,
                  colsample_bylevel: float = 1.0,
-                 interaction_constraints=None):
+                 interaction_constraints=None,
+                 base_score=None,
+                 scale_pos_weight: float = 1.0):
         if objective not in ("logistic", "squared", "softmax",
                              "rank:pairwise"):
             raise ValueError(f"unknown objective '{objective}'")
@@ -391,6 +397,13 @@ class GBDT:
                 row[f] = True
                 rows.append(row)
             self._interaction_groups = jnp.asarray(np.stack(rows))  # [G, F]
+        self.base_score = base_score  # None = weighted prior from data
+        if scale_pos_weight <= 0:
+            raise ValueError("scale_pos_weight must be > 0")
+        if scale_pos_weight != 1.0 and objective != "logistic":
+            raise ValueError("scale_pos_weight applies to the logistic "
+                             "objective (weight rows directly otherwise)")
+        self.scale_pos_weight = scale_pos_weight
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -535,14 +548,27 @@ class GBDT:
         null-padded so the pytree keeps its static [num_trees, ...]
         shapes (null trees route everything to leaf 0 with weight 0)."""
         params = self.init()
-        sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
-        if self.objective == "logistic":
-            # base margin from the weighted prior, clamped away from 0/1
-            p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0)) / sum_w,
-                         1e-6, 1 - 1e-6)
-            base = jnp.log(p / (1 - p))
+        if self.scale_pos_weight != 1.0:
+            # XGBoost's positive-class reweighting, as weight sugar
+            w = w * jnp.where(label > 0.5, self.scale_pos_weight, 1.0)
+        if self.base_score is not None:
+            bs = jnp.asarray(self.base_score, jnp.float32)
+            if self.objective == "logistic":
+                # XGBoost semantics: base_score is a PROBABILITY for the
+                # logistic objective (its default 0.5 means margin 0)
+                bs = jnp.clip(bs, 1e-6, 1 - 1e-6)
+                base = jnp.log(bs / (1 - bs))
+            else:
+                base = bs
         else:
-            base = jnp.sum(label * w) / sum_w
+            sum_w = jnp.maximum(jnp.sum(w), 1e-12)  # div-by-zero guard only
+            if self.objective == "logistic":
+                # base margin from the weighted prior, clamped away from 0/1
+                p = jnp.clip(jnp.sum(jnp.where(label > 0.5, w, 0.0)) / sum_w,
+                             1e-6, 1 - 1e-6)
+                base = jnp.log(p / (1 - p))
+            else:
+                base = jnp.sum(label * w) / sum_w
         params["base"] = base.astype(jnp.float32)
 
         margin = jnp.full(label.shape, params["base"])
@@ -749,9 +775,14 @@ class GBDT:
                 f"[{int(jnp.min(label))}, {int(jnp.max(label))}]")
         sum_w = jnp.maximum(jnp.sum(w), 1e-12)
         onehot = jax.nn.one_hot(label, K, dtype=jnp.float32)
-        prior = jnp.clip(jnp.sum(onehot * w[:, None], axis=0) / sum_w,
-                         1e-6, 1.0)
-        params["base"] = jnp.log(prior)
+        if self.base_score is not None:
+            base = jnp.broadcast_to(
+                jnp.asarray(self.base_score, jnp.float32), (K,))
+            params["base"] = base
+        else:
+            prior = jnp.clip(jnp.sum(onehot * w[:, None], axis=0) / sum_w,
+                             1e-6, 1.0)
+            params["base"] = jnp.log(prior)
 
         margin = jnp.broadcast_to(params["base"], (label.shape[0], K))
         have_eval = eval_margin is not None

@@ -1073,3 +1073,49 @@ def test_colsample_bylevel_deterministic_and_learns():
     import pytest
     with pytest.raises(ValueError, match="colsample_bylevel"):
         GBDT(num_features=8, colsample_bylevel=0.0)
+
+
+def test_base_score_and_scale_pos_weight():
+    """base_score overrides the data prior; scale_pos_weight reweights the
+    positive class (recall goes up on imbalanced data)."""
+    rng = np.random.default_rng(30)
+    x = rng.uniform(-1, 1, size=(4000, 3)).astype(np.float32)
+    # 8% positives, imperfectly separable
+    y = ((x[:, 0] + 0.3 * rng.standard_normal(4000) > 1.15)
+         ).astype(np.float32)
+    assert 0.02 < y.mean() < 0.15
+    bins = QuantileBinner(num_bins=32).fit_transform(x)
+
+    m0 = GBDT(num_features=3, num_trees=8, max_depth=3, num_bins=32,
+              learning_rate=0.3)
+    p0 = m0.fit(bins, jnp.asarray(y))
+    mw = GBDT(num_features=3, num_trees=8, max_depth=3, num_bins=32,
+              learning_rate=0.3, scale_pos_weight=8.0)
+    pw = mw.fit(bins, jnp.asarray(y))
+
+    def recall(model, params):
+        pred = np.asarray(model.predict(params, bins)) > 0.5
+        return float(pred[y > 0.5].mean())
+
+    assert recall(mw, pw) > recall(m0, p0), \
+        (recall(mw, pw), recall(m0, p0))
+
+    # logistic base_score is a PROBABILITY (XGBoost): 0.5 -> margin 0
+    mb = GBDT(num_features=3, num_trees=1, max_depth=1, num_bins=32,
+              base_score=0.5)
+    pb = mb.fit(bins, jnp.asarray(y))
+    np.testing.assert_allclose(float(pb["base"]), 0.0, atol=1e-6)
+    mreg = GBDT(num_features=3, num_trees=1, max_depth=1, num_bins=32,
+                objective="squared", base_score=2.5)
+    preg = mreg.fit(bins, jnp.asarray(y))
+    assert float(preg["base"]) == 2.5  # raw margin for regression
+    # multiclass base broadcast
+    ms = GBDT(num_features=3, num_trees=1, max_depth=1, num_bins=32,
+              objective="softmax", num_class=3, base_score=0.5)
+    ps = ms.fit(bins, jnp.asarray((y * 2).astype(np.float32)))
+    np.testing.assert_allclose(np.asarray(ps["base"]), [0.5, 0.5, 0.5])
+    import pytest
+    with pytest.raises(ValueError, match="scale_pos_weight"):
+        GBDT(num_features=3, scale_pos_weight=0.0)
+    with pytest.raises(ValueError, match="scale_pos_weight"):
+        GBDT(num_features=3, objective="squared", scale_pos_weight=2.0)
