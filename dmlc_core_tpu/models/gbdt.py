@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.pallas_segment import histogram_gh
+
 
 class QuantileBinner:
     """Per-feature quantile binning to uint8 codes (XGBoost-hist's sketch).
@@ -325,7 +327,8 @@ class GBDT:
                  colsample_bylevel: float = 1.0,
                  interaction_constraints=None,
                  base_score=None,
-                 scale_pos_weight: float = 1.0):
+                 scale_pos_weight: float = 1.0,
+                 histogram: str = "auto"):
         if objective not in ("logistic", "squared", "softmax",
                              "rank:pairwise"):
             raise ValueError(f"unknown objective '{objective}'")
@@ -404,8 +407,41 @@ class GBDT:
             raise ValueError("scale_pos_weight applies to the logistic "
                              "objective (weight rows directly otherwise)")
         self.scale_pos_weight = scale_pos_weight
+        if histogram not in ("auto", "xla", "pallas"):
+            raise ValueError("histogram must be 'auto', 'xla' or 'pallas'")
+        self.histogram = histogram
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
+
+    # "auto" caps the Pallas histogram at this many (node, bin) segments
+    # per feature: kernel compare work is O(rows * n_nodes * num_bins) per
+    # feature and doubles each level, while XLA scatter-add stays O(rows*F)
+    # — so deep levels flip to scatter.  At num_bins=256 this keeps the
+    # kernel through n_nodes=32 (depths 0-5, the whole XGBoost-default
+    # depth-6 forest).
+    _PALLAS_SEG_LIMIT = 8192
+
+    def _hist_impl(self, n_nodes: int) -> str:
+        """Histogram backend for a level with ``n_nodes`` nodes.  Resolved
+        lazily (never in __init__: touching jax.default_backend() there
+        would initialize the backend as a constructor side effect, breaking
+        construct-before-jax.distributed.initialize programs).  Explicit
+        "xla"/"pallas" always wins; "auto" = the Pallas kernel on a
+        SINGLE-device TPU while the level is shallow enough for the
+        one-hot contraction to beat scatter, XLA elsewhere.  Multi-device
+        meshes stay on XLA even on TPU: the sharded fit path relies on
+        ``segment_sum`` being GSPMD-partitionable so the compiler inserts
+        the histogram psum (the rabit-allreduce analogue); ``pallas_call``
+        has no partitioning rule, so routing a row-sharded fit into it
+        would break (or silently replicate) that path.  Off-TPU pallas
+        interpret mode is a correctness tool, not an execution path."""
+        if self.histogram != "auto":
+            return self.histogram
+        if (jax.default_backend() == "tpu"
+                and jax.device_count() == 1
+                and n_nodes * self.num_bins <= self._PALLAS_SEG_LIMIT):
+            return "pallas"
+        return "xla"
 
     # ---- forest construction ------------------------------------------------
 
@@ -844,7 +880,6 @@ class GBDT:
         F, B = self.num_features, self.num_bins
         rows = bins.shape[0]
         bins_i = bins.astype(jnp.int32)
-        feat_cols = jnp.arange(F, dtype=jnp.int32)
 
         node = jnp.zeros(rows, jnp.int32)  # heap id of each row's node
         mono = self.monotone_constraints is not None
@@ -861,17 +896,16 @@ class GBDT:
             first = 2 ** depth - 1          # heap id of the level's first node
             n_nodes = 2 ** depth
             rel = node - first              # [rows] in [0, n_nodes)
-            # fused histogram build: ONE segment-sum over rows x features
+            # fused histogram build: ONE reduction over rows x features
             # carrying (grad, hess) lanes together — the key array (the
             # bandwidth bottleneck) is read once, not once per statistic.
-            # keys: ((node * F) + f) * B + bin  ->  [n_nodes, F, B, 2]
-            keys = ((rel[:, None] * F + feat_cols[None, :]) * B + bins_i
-                    ).reshape(-1)
-            seg = n_nodes * F * B
+            # Backend per level via _hist_impl: the Pallas one-hot-
+            # contraction kernel on TPU while the level is shallow
+            # (scatter-free; see ops.histogram_gh for the layout and the
+            # HBM-footprint contrast), XLA scatter-add otherwise.
             gh = jnp.stack([grad, hess], axis=-1)  # [rows, 2]
-            hist = jax.ops.segment_sum(
-                jnp.broadcast_to(gh[:, None, :], (rows, F, 2)).reshape(-1, 2),
-                keys, num_segments=seg).reshape(n_nodes, F, B, 2)
+            hist = histogram_gh(bins_i, rel, gh, n_nodes, B,
+                                force=self._hist_impl(n_nodes))
             hist_g = hist[..., 0]
             hist_h = hist[..., 1]
             # left cumulative mass for "go right if bin > b" at each cut b
@@ -1309,9 +1343,25 @@ class GBDT:
         batches (`DeviceStagingIter`), score each with the sparse-native
         routing, and return the real rows' predictions in file order
         (padding rows dropped).  Any staging kwarg (part/num_parts,
-        format, nnz_bucket, ...) passes through."""
+        format, nnz_bucket, ...) passes through — except ``sharding``:
+        this surface slices ``pred[:num_rows]`` on the assumption that
+        padding is tail-only, which sharded (and multi-host) batches break
+        (padding interleaves per shard), so those are rejected rather than
+        silently misaligned."""
         from ..data import DeviceStagingIter
 
+        if staging_kwargs.get("sharding") is not None:
+            raise ValueError(
+                "predict_staged is a single-host, unsharded surface "
+                "(tail-only padding assumption); for sharded or "
+                "multi-host data, stage with DeviceStagingIter(sharding="
+                "...) and score with predict_batch, keeping rows where "
+                "batch.weight > 0")
+        if jax.process_count() > 1:
+            raise ValueError(
+                "predict_staged under multi-host jax.distributed would "
+                "interleave padding across processes; use "
+                "DeviceStagingIter + predict_batch per batch instead")
         it = DeviceStagingIter(uri, batch_size=batch_size, **staging_kwargs)
         outs = []
         try:

@@ -1,6 +1,6 @@
 """TPU compute ops over padded CSR batches."""
-from .pallas_segment import segment_sum
+from .pallas_segment import histogram_gh, segment_sum
 from .sparse import csr_matvec, csr_matmul, csr_row_sumsq_matmul, padded_row_mean
 
 __all__ = ["csr_matvec", "csr_matmul", "csr_row_sumsq_matmul",
-           "padded_row_mean", "segment_sum"]
+           "padded_row_mean", "histogram_gh", "segment_sum"]

@@ -26,6 +26,7 @@ def test_binner_roundtrip_monotone():
     assert counts.min() > 0.5 * 4096 / 64
 
 
+@pytest.mark.slow
 def test_single_tree_recovers_exact_threshold_split():
     """A depth-1 regression tree on y = 1{x > 0} must find the 0 cut and
     emit the two class means (up to shrinkage/lambda)."""
@@ -49,6 +50,7 @@ def test_single_tree_recovers_exact_threshold_split():
     assert abs(cut) < 0.1, f"split cut {cut} should be near 0"
 
 
+@pytest.mark.slow
 def test_boosting_reduces_logloss_and_fits_xor():
     """XOR-in-quadrants is linearly inseparable; trees must fit it."""
     rng = np.random.default_rng(2)
@@ -70,6 +72,7 @@ def test_boosting_reduces_logloss_and_fits_xor():
     assert acc > 0.97, f"XOR accuracy {acc}"
 
 
+@pytest.mark.slow
 def test_weights_zero_rows_are_ignored():
     """Padding rows (weight 0) must not influence the forest."""
     rng = np.random.default_rng(3)
@@ -95,6 +98,7 @@ def test_weights_zero_rows_are_ignored():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sharded_fit_matches_single_device():
     """Rows sharded over the 8-device mesh: the per-level histograms gain a
     compiler-inserted psum, and the forest must match the single-device one
@@ -134,6 +138,7 @@ def test_sharded_fit_matches_single_device():
     np.testing.assert_allclose(pred_s, pred_1, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_forest_checkpoint_roundtrip(tmp_path):
     """The forest pytree checkpoints through the RecordIO substrate."""
     from dmlc_core_tpu import checkpoint
@@ -153,6 +158,7 @@ def test_forest_checkpoint_roundtrip(tmp_path):
 
 
 @pytest.mark.parametrize("objective", ["logistic", "squared"])
+@pytest.mark.slow
 def test_loss_finite_and_improves_on_noise(objective):
     rng = np.random.default_rng(6)
     x = rng.normal(size=(1024, 5)).astype(np.float32)
@@ -183,6 +189,7 @@ def test_missing_aware_binner_reserves_bin_zero():
     assert present.min() >= 1
 
 
+@pytest.mark.slow
 def test_missing_aware_split_learns_default_direction():
     """Missingness itself predicts the label; a zero-filled model cannot
     isolate it (0 collides with real values), a missing-aware one can."""
@@ -225,6 +232,7 @@ def test_missing_aware_split_learns_default_direction():
     assert root_dir == 1 or root_thr == 0, (root_dir, root_thr)
 
 
+@pytest.mark.slow
 def test_missing_aware_false_is_backward_compatible():
     """With missing_aware off, forests are identical to the pre-feature
     algorithm (the dir axis is size 1 and argmax order is unchanged)."""
@@ -295,6 +303,7 @@ def test_transform_entries_matches_dense_transform():
     assert (ebin >= 1).all()
 
 
+@pytest.mark.slow
 def test_sparse_fit_batch_matches_dense_missing_aware_fit():
     """fit_batch (O(nnz) COO histograms) must build the same forest as the
     dense missing-aware path on the equivalent NaN-densified matrix."""
@@ -357,6 +366,7 @@ def test_sparse_binner_fit_sparse_quantiles():
         assert len(np.unique(codes)) >= 5
 
 
+@pytest.mark.slow
 def test_fit_sparse_trailing_empty_features_and_nan():
     """Features past the sketch's max index must not crash fit_sparse, and
     NaN handling matches the dense surface (excluded from cuts; entries
@@ -379,6 +389,7 @@ def test_fit_sparse_trailing_empty_features_and_nan():
     assert ebin[0] == 0 and ebin[1] >= 1
 
 
+@pytest.mark.slow
 def test_explicit_zero_entry_is_missing_on_both_paths():
     """A stored value-0 entry is indistinguishable from padding, so both
     the dense (csr_to_dense_missing) and sparse (fit_batch) routes treat
@@ -423,6 +434,7 @@ def test_explicit_zero_entry_is_missing_on_both_paths():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_stochastic_sampling_subsample_and_colsample():
     """subsample / colsample_bytree: still learns, deterministic by seed,
     and each tree's splits stay within its sampled column set."""
@@ -469,6 +481,7 @@ def test_stochastic_sampling_subsample_and_colsample():
                                       np.asarray(full_b[k]))
 
 
+@pytest.mark.slow
 def test_stochastic_sampling_sparse_path_matches_dense():
     """The sampling masks derive from (seed, tree index) only, so the
     sparse fit_batch builds the identical stochastic forest to the dense
@@ -511,6 +524,7 @@ def test_stochastic_sampling_sparse_path_matches_dense():
         assert len(set(feat[t][thr[t] < 16].tolist())) <= 4
 
 
+@pytest.mark.slow
 def test_early_stopping_truncates_at_best_round():
     """eval_set + early_stopping_rounds: boosting stops when held-out loss
     degrades, the forest is truncated at the best round (null-padded to
@@ -545,6 +559,7 @@ def test_early_stopping_truncates_at_best_round():
     assert loss_stopped <= loss_full + 1e-6, (loss_stopped, loss_full)
 
 
+@pytest.mark.slow
 def test_early_stopping_sparse_batch_path():
     """fit_batch drives the same early-stopping machinery via a held-out
     PaddedBatch."""
@@ -580,6 +595,7 @@ def test_early_stopping_sparse_batch_path():
     assert stopped["feature"].shape[0] == 30
 
 
+@pytest.mark.slow
 def test_feature_importance_identifies_informative_features():
     """gain/weight/cover importance concentrates on the features the label
     actually depends on (XGBoost get_score parity surface)."""
@@ -619,6 +635,7 @@ def test_feature_importance_identifies_informative_features():
         model.feature_importance(old, kind="gain")
 
 
+@pytest.mark.slow
 def test_softmax_multiclass():
     """objective='softmax': K trees per round against the shared softmax
     distribution (multi:softprob); learns a 3-class nonlinear rule,
@@ -669,6 +686,7 @@ def test_softmax_multiclass():
     assert used % 3 == 0 and 3 <= used < 75, used
 
 
+@pytest.mark.slow
 def test_softmax_sparse_batch_path():
     """fit_batch + softmax: the sparse builder drives the multiclass loop."""
     rng = np.random.default_rng(20)
@@ -700,6 +718,7 @@ def test_softmax_sparse_batch_path():
     assert acc > 0.9, acc
 
 
+@pytest.mark.slow
 def test_rank_pairwise_learns_ordering():
     """objective='rank:pairwise': within-query pairwise accuracy rises from
     chance to near-perfect; shuffled qid groups are rejected."""
@@ -750,6 +769,7 @@ def test_rank_pairwise_learns_ordering():
         model.fit(bins, jnp.asarray(label))
 
 
+@pytest.mark.slow
 def test_rank_pairwise_from_staged_qid(tmp_path):
     """End to end: libsvm qid: file -> with_qid staging -> fit_batch rank."""
     rng = np.random.default_rng(22)
@@ -791,6 +811,7 @@ def test_rank_pairwise_from_staged_qid(tmp_path):
     assert good / total > 0.9, good / total
 
 
+@pytest.mark.slow
 def test_sharded_softmax_and_rank_match_single_device():
     """The 8-device mesh histogram-psum parity extends to the multiclass
     and ranking objectives (their gradients are computed from sharded
@@ -835,6 +856,7 @@ def test_sharded_softmax_and_rank_match_single_device():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_monotone_constraints_enforced():
     """monotone_constraints: predictions are globally non-decreasing (+1)
     / non-increasing (-1) in the constrained feature, while accuracy on a
@@ -885,6 +907,7 @@ def test_monotone_constraints_enforced():
         GBDT(num_features=3, monotone_constraints=[1, 0])
 
 
+@pytest.mark.slow
 def test_monotone_constraints_sparse_path():
     """fit_batch honors monotone constraints too."""
     rng = np.random.default_rng(25)
@@ -912,6 +935,7 @@ def test_monotone_constraints_sparse_path():
     assert not (np.diff(m, axis=1) < -1e-5).any()
 
 
+@pytest.mark.slow
 def test_gamma_prunes_low_gain_splits():
     """gamma (min_split_loss): higher thresholds null more splits, and a
     huge gamma yields a stump-free (all-null) forest."""
@@ -939,6 +963,7 @@ def test_gamma_prunes_low_gain_splits():
         GBDT(num_features=3, gamma=-1.0)
 
 
+@pytest.mark.slow
 def test_predict_staged_streams_file_order(tmp_path):
     """predict_staged: whole-file streaming inference through the staged
     pipeline, predictions in file order with padding rows dropped."""
@@ -991,6 +1016,7 @@ def test_predict_staged_streams_file_order(tmp_path):
     assert out.shape == (2,)
 
 
+@pytest.mark.slow
 def test_interaction_constraints_respected_on_every_path():
     """interaction_constraints: features on any root-to-leaf path stay
     within one allowed group (checked structurally over every tree), and
@@ -1051,6 +1077,7 @@ def test_interaction_constraints_respected_on_every_path():
         GBDT(num_features=4, interaction_constraints=[[0, 9]])
 
 
+@pytest.mark.slow
 def test_colsample_bylevel_deterministic_and_learns():
     rng = np.random.default_rng(29)
     x = rng.uniform(-1, 1, size=(3000, 8)).astype(np.float32)
@@ -1075,6 +1102,7 @@ def test_colsample_bylevel_deterministic_and_learns():
         GBDT(num_features=8, colsample_bylevel=0.0)
 
 
+@pytest.mark.slow
 def test_base_score_and_scale_pos_weight():
     """base_score overrides the data prior; scale_pos_weight reweights the
     positive class (recall goes up on imbalanced data)."""

@@ -3,8 +3,9 @@ on the CPU mesh; the same code path compiles natively on TPU)."""
 import numpy as np
 
 import jax.numpy as jnp
+import pytest
 
-from dmlc_core_tpu.ops.pallas_segment import segment_sum
+from dmlc_core_tpu.ops.pallas_segment import histogram_gh, segment_sum
 
 
 def _case(nnz, rows, seed):
@@ -64,3 +65,45 @@ def test_empty_input_returns_zeros():
     got2 = segment_sum(jnp.zeros((0, 2), jnp.float32),
                        jnp.zeros((0,), jnp.int32), 8, force="pallas")
     assert got2.shape == (8, 2) and not np.asarray(got2).any()
+
+
+def test_histogram_gh_matches_xla():
+    """The dedicated [nodes, features, bins] histogram kernel (the GBDT
+    per-level hot op) against the flattened-key XLA scatter formulation,
+    across node counts and non-tile-multiple row counts."""
+    rng = np.random.default_rng(7)
+    # (8, 128) drives n_nodes*B = 1024 = two 512-wide segment tiles, so the
+    # st > 0 grid path, the segs offset, and cross-tile slicing execute
+    for rows, F, B, n_nodes in [(200, 3, 8, 1), (777, 5, 16, 4),
+                                (64, 2, 4, 8), (130, 2, 128, 8)]:
+        bins = jnp.asarray(rng.integers(0, B, (rows, F)).astype(np.int32))
+        rel = jnp.asarray(rng.integers(0, n_nodes, rows).astype(np.int32))
+        gh = jnp.asarray(rng.standard_normal((rows, 2)).astype(np.float32))
+        want = histogram_gh(bins, rel, gh, n_nodes, B)                # xla
+        got = histogram_gh(bins, rel, gh, n_nodes, B, force="pallas")
+        assert got.shape == (n_nodes, F, B, 2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow  # two full fits through interpret-mode pallas (~30 s)
+def test_histogram_gh_gbdt_forests_identical():
+    """VERDICT r4 #1 'done' criterion: the SAME forest comes out of a fit
+    whether the per-level histogram runs on XLA scatter-add or on the
+    Pallas kernel (interpret mode here; native on TPU)."""
+    from dmlc_core_tpu.models.gbdt import GBDT, QuantileBinner
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((160, 4)).astype(np.float32)
+    # well-separated signal so split argmaxes aren't epsilon ties
+    y = (x[:, 0] + 0.5 * x[:, 2] > 0).astype(np.float32)
+    bins = QuantileBinner(num_bins=8).fit_transform(x)
+    kw = dict(num_features=4, num_trees=3, max_depth=3, num_bins=8,
+              learning_rate=0.5, seed=0)
+    fx = GBDT(histogram="xla", **kw).fit(bins, jnp.asarray(y))
+    fp = GBDT(histogram="pallas", **kw).fit(bins, jnp.asarray(y))
+    np.testing.assert_array_equal(np.asarray(fx["feature"]),
+                                  np.asarray(fp["feature"]))
+    np.testing.assert_array_equal(np.asarray(fx["threshold"]),
+                                  np.asarray(fp["threshold"]))
+    np.testing.assert_allclose(np.asarray(fx["leaf"]),
+                               np.asarray(fp["leaf"]), rtol=1e-5, atol=1e-6)
