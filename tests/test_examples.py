@@ -3,9 +3,14 @@ programs are its only executable documentation; same contract here)."""
 import os
 import subprocess
 import sys
+
+import pytest
 from pathlib import Path  # noqa: F401
 
 REPO = Path(__file__).resolve().parent.parent
+
+# every case launches example scripts as subprocesses (~20 s): full tier
+pytestmark = pytest.mark.slow
 
 
 def test_train_linear_example_runs(tmp_path):

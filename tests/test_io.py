@@ -133,6 +133,7 @@ def test_stream_and_fs_surface(tmp_path):
     assert len(entries) == 1 and entries[0].size == 3
 
 
+@pytest.mark.slow  # CLI subprocesses pay a jax import each (~15 s)
 def test_fs_cli_ls_cat_cp_stat(tmp_path):
     """bin/dmlctpu-fs: the reference's filesys_test driver as a CLI."""
     import subprocess
@@ -156,6 +157,17 @@ def test_fs_cli_ls_cat_cp_stat(tmp_path):
     assert st.returncode == 0 and b"size=10" in st.stdout
     bad = run("cat", str(tmp_path / "missing"))
     assert bad.returncode == 1 and b"dmlctpu-fs:" in bad.stderr
+    # same-target guard: local realpath aliases and remote spellings that
+    # provably alias (scheme/host case, HDFS duplicate slashes) must refuse
+    # before the destination is truncated; spellings that select DIFFERENT
+    # resources (?versionId) must not be conflated
+    same = run("cp", str(tmp_path / "a.txt"), str(tmp_path / "a.txt"))
+    assert same.returncode == 1 and b"same file" in same.stderr
+    assert (tmp_path / "a.txt").read_bytes() == b"payload123"
+    rem = run("cp", "hdfs://nn:50070/a//b.txt", "HDFS://NN:50070/a/b.txt")
+    assert rem.returncode == 1 and b"same file" in rem.stderr
+    ver = run("cp", "s3://bucket/k.txt?versionId=7", "s3://bucket/k.txt")
+    assert b"same file" not in ver.stderr  # distinct resources: not refused
 
 
 def test_seek_stream_random_access(tmp_path):

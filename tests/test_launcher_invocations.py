@@ -74,18 +74,23 @@ def test_ssh_command_shape(monkeypatch, tmp_path):
                   "--", "python", "train.py"])
     ssh.run(args)
     assert len(calls) == 2
-    c0 = calls[0]["cmd"]
-    assert c0[:5] == ["ssh", "-o", "StrictHostKeyChecking=no", "-p", "2222"]
-    assert c0[5] == "nodeA"
-    remote = c0[6]
+    # rank threads launch concurrently, so capture order is scheduler-
+    # dependent (the tpu test below hit the same race under load): key the
+    # assertions on the target host, never on list position.
+    by_host = {c["cmd"][5]: c["cmd"] for c in calls}
+    assert sorted(by_host) == ["nodeA", "nodeB"]
+    cA = by_host["nodeA"]
+    assert cA[:5] == ["ssh", "-o", "StrictHostKeyChecking=no", "-p", "2222"]
+    remote = cA[6]
     assert "export DMLC_ROLE=worker" in remote
     assert "export DMLC_TASK_ID=0" in remote
     assert "export DMLC_TRACKER_URI=10.0.0.9" in remote
     assert "export DMLC_JOB_CLUSTER=ssh" in remote
     assert remote.endswith("python train.py")
     # second rank wraps to nodeB on the default port
-    assert calls[1]["cmd"][3:6] == ["-p", "22", "nodeB"]
-    assert "export DMLC_TASK_ID=1" in calls[1]["cmd"][6]
+    cB = by_host["nodeB"]
+    assert cB[3:6] == ["-p", "22", "nodeB"]
+    assert "export DMLC_TASK_ID=1" in cB[6]
 
 
 def test_tpu_localhost_and_remote_shape(monkeypatch, tmp_path):

@@ -18,7 +18,12 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
+
+# 2-process jax.distributed children (~80 s): full tier only
+pytestmark = pytest.mark.slow
 
 _CHILD = r"""
 import json, sys

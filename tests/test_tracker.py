@@ -199,6 +199,7 @@ def test_dmlc_env_info_contract(monkeypatch):
     assert info.coordinator_address == "10.0.0.1:9091"
 
 
+@pytest.mark.slow  # subprocess end-to-end (~20 s): full tier
 def test_dmlc_submit_local_end_to_end(tmp_path):
     """dmlc-submit --cluster=local runs 3 workers that rendezvous and write
     their ranks; the union must be {0,1,2}."""
