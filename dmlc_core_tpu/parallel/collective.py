@@ -69,7 +69,10 @@ def _kernels():
     one = lambda n: 1.0  # noqa: E731
     return {
         "allreduce": (allreduce_fn, P(), one, lambda n: 2.0 * (n - 1) / n),
-        "allgather": (allgather_fn, P(None, "data"), lambda n: float(n),
+        # all_gather returns the FULL [n, shard] array on every device, so
+        # the global result is replicated — P(), not P(None, "data"),
+        # which would mislabel it as an n-fold-duplicated sharded array
+        "allgather": (allgather_fn, P(), lambda n: float(n),
                       lambda n: (n - 1) / n),
         "reducescatter": (reducescatter_fn, P("data"), one,
                           lambda n: (n - 1) / n),
@@ -103,8 +106,13 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
     # divisible by the axis size; rounding down keeps every op valid on
     # non-power-of-two meshes
     nfloats = max(n, nfloats - nfloats % n)
+    # allgather: every device holds the FULL gathered array, so the global
+    # result is replicated (out_specs P()); jax's static vma check cannot
+    # infer all_gather output replication, so it is disabled for that op
+    # only (the other ops keep the check).
+    kwargs = {"check_vma": False} if op == "allgather" else {}
     step = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"),
-                             out_specs=out_spec))
+                             out_specs=out_spec, **kwargs))
     x = jax.device_put(
         np.random.default_rng(0).standard_normal((n * nfloats,),
                                                  dtype=np.float32),
