@@ -6,7 +6,7 @@
 # since no CI runner executes .github/workflows/ci.yml in this environment.
 #
 # Two tiers (measured on this machine, idle):
-#   default      incremental ninja (~s when clean) + 5 native suites (~10s)
+#   default      incremental ninja (~s when clean) + 6 native suites (~10s)
 #                + pytest -m "not slow" (~60-90s)    -> pre-commit
 #   --full       everything incl. @pytest.mark.slow (GBDT fits, 2-process
 #                multihost, interpret-mode pallas forests; ~10 min)
@@ -28,7 +28,7 @@ CHECK_CXX=$(sed -n 's/^CMAKE_CXX_COMPILER:[^=]*=//p' build/CMakeCache.txt)
 "${CHECK_CXX:-c++}" -std=gnu++20 -fsyntax-only -I cpp/include \
     -I cpp/tests/lua_stub cpp/tests/lua_syntax_check.cc
 
-for t in test_core test_runtime test_data test_input_split test_remote_fs; do
+for t in test_core test_runtime test_data test_endian test_input_split test_remote_fs; do
   if ! ./build/"$t" >/tmp/dmlctpu_check_$t.log 2>&1; then
     echo "check.sh: NATIVE SUITE FAILED: $t (log: /tmp/dmlctpu_check_$t.log)" >&2
     exit 1
@@ -45,4 +45,4 @@ fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
 py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier")
-echo "check.sh: green (5 native suites + $py)"
+echo "check.sh: green (6 native suites + $py)"

@@ -10,6 +10,7 @@ The reference ships these launchers untested; asserting the command shape
 is the cheapest meaningful upgrade over that floor.
 """
 import json
+import os
 from pathlib import Path
 
 import pytest
@@ -237,6 +238,105 @@ def test_yarn_command_shape(monkeypatch):
     shell_env = cmd[cmd.index("-shell_env") + 1]
     assert "DMLC_ROLE=worker" in shell_env and "DMLC_TRACKER_URI=10.0.0.9" in shell_env
     assert cmd[cmd.index("-shell_command") + 1] == "python train.py"
+
+
+def _fake_yarn_cli(tmp_path, monkeypatch, fail_first_n):
+    """Install a fake `yarn` CLI on PATH.  `yarn jar` submissions are
+    logged to the returned file and the first ``fail_first_n`` of them
+    fail (-1 = fail always); `yarn application` calls are logged to the
+    sibling `appcalls` file, with -list reporting one RUNNING app named
+    dmlc-worker (so the stale-app sweep has something to kill)."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    count = tmp_path / "invocations"
+    count.write_text("")
+    appcalls = tmp_path / "appcalls"
+    appcalls.write_text("")
+    script = bindir / "yarn"
+    if fail_first_n < 0:
+        body = "exit 1\n"
+    else:
+        body = (f'if [ "$(wc -l < "{count}")" -le {fail_first_n} ]; '
+                "then exit 1; else exit 0; fi\n")
+    script.write_text(f'''#!/bin/sh
+if [ "$1" = "application" ]; then
+  echo "$@" >> "{appcalls}"
+  case "$*" in
+    *-list*) printf 'application_1_0001\\tdmlc-worker\\tDISTRIBUTEDSHELL\\n';;
+  esac
+  exit 0
+fi
+echo "$@" >> "{count}"
+{body}''')
+    script.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("HADOOP_YARN_DS_JAR", "/opt/ds.jar")
+    return count
+
+
+class ConditionTracker(FakeTracker):
+    """alive() until ``done()`` holds (or a generous poll cap, so a
+    regression fails the test instead of hanging it).  Condition-driven,
+    not time-driven: the resubmit loop's progress is scheduler-dependent,
+    and a fixed countdown would race it under load."""
+
+    def __init__(self, done, cap=6000):
+        super().__init__()
+        self.done, self.cap = done, cap
+
+    def alive(self):
+        self.cap -= 1
+        return self.cap > 0 and not self.done()
+
+
+def _yarn_submit(tracker):
+    def submit(num_workers, num_servers, fun_submit, **kw):
+        envs = dict(ENVS)
+        envs["DMLC_NUM_WORKER"] = num_workers
+        envs["DMLC_NUM_SERVER"] = num_servers
+        fun_submit(num_workers, num_servers, envs)
+        return tracker
+    return submit
+
+
+def test_yarn_resubmits_failed_application(monkeypatch, tmp_path):
+    """Reference-AM restart parity: a failed application (its `yarn jar`
+    client exits non-zero) is resubmitted by OUR launcher code, and the
+    job succeeds once the resubmission does."""
+    from dmlc_core_tpu.tracker.launchers import yarn
+    count = _fake_yarn_cli(tmp_path, monkeypatch, fail_first_n=1)
+    monkeypatch.setattr(yarn, "_POLL_S", 0.01)
+    # the tracker stays alive until the resubmission is observable, then
+    # run() falls through to the final wait on the (succeeding) client
+    resubmitted = lambda: len(count.read_text().splitlines()) >= 2  # noqa: E731
+    monkeypatch.setattr(yarn, "submit",
+                        _yarn_submit(ConditionTracker(resubmitted)))
+    args = parse(["--cluster=yarn", "-n", "2", "--", "python", "train.py"])
+    yarn.run(args)  # must NOT raise: attempt 2 succeeded
+    invocations = count.read_text().strip().splitlines()
+    assert len(invocations) == 2  # original + one resubmission
+    assert all("-num_containers 2" in line for line in invocations)
+    # before resubmitting, the launcher must sweep for a still-live app
+    # from the dead client (never two applications' containers per role)
+    appcalls = (count.parent / "appcalls").read_text()
+    assert "-list" in appcalls
+    assert "-kill application_1_0001" in appcalls
+
+
+def test_yarn_gives_up_after_max_attempts(monkeypatch, tmp_path):
+    """DMLC_MAX_ATTEMPT bounds the resubmission loop (the reference AM's
+    maxNumAttempt): a persistently failing application kills the job
+    after exactly that many submissions."""
+    from dmlc_core_tpu.tracker.launchers import yarn
+    count = _fake_yarn_cli(tmp_path, monkeypatch, fail_first_n=-1)
+    monkeypatch.setattr(yarn, "_POLL_S", 0.01)
+    monkeypatch.setattr(yarn, "submit",
+                        _yarn_submit(ConditionTracker(lambda: False)))
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "2")
+    args = parse(["--cluster=yarn", "-n", "1", "--", "python", "train.py"])
+    with pytest.raises(SystemExit, match="after 2 attempt"):
+        yarn.run(args)
+    assert len(count.read_text().strip().splitlines()) == 2
 
 
 def test_kubernetes_manifest_shape(monkeypatch):
