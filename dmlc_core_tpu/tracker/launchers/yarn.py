@@ -13,9 +13,11 @@ and keeps the AM's restart duty HERE, in the launcher.  The mapping:
                                relaunches a crashed container in place)
   * failed-task restart     -> when retries are exhausted the DS app FAILS
                                and its `yarn jar` client exits non-zero;
-                               run() then RESUBMITS that application, up to
-                               DMLC_MAX_ATTEMPT times per role (default 3,
-                               same knob the reference AM reads).  Restarted
+                               run() then RESUBMITS that application.  Each
+                               role gets at most DMLC_MAX_ATTEMPT total
+                               submissions (default 3: the initial one plus
+                               up to two restarts — the same total-attempts
+                               knob the reference AM reads).  Restarted
                                ranks re-rendezvous through the tracker's
                                `recover` path, reclaiming their rank.
   * AM restart              -> the RM re-attempts the DS AM per the
@@ -31,6 +33,7 @@ import os
 import shutil
 import subprocess
 import time
+import uuid
 
 from ..submit import submit
 
@@ -74,6 +77,10 @@ def run(args) -> None:
     if shutil.which("yarn") is None:
         raise SystemExit("--cluster=yarn requires the yarn CLI on PATH")
     max_attempt = max(int(os.environ.get("DMLC_MAX_ATTEMPT", "3")), 1)
+    # unique per-job suffix: the stale-app kill sweep matches by appname,
+    # and a bare "<jobname>-worker" would collide with (and kill) another
+    # job submitted under the same default name on the same cluster
+    job_tag = uuid.uuid4().hex[:8]
     # one entry per submitted application: the client command (for
     # resubmission), the live client process, and the attempt counter
     subs: list[dict] = []
@@ -87,7 +94,7 @@ def run(args) -> None:
             pairs.update({"DMLC_ROLE": role, "DMLC_JOB_CLUSTER": "yarn"})
             shell_env = ",".join(f"{k}={v}" for k, v in pairs.items())
             ds_jar = os.environ.get("HADOOP_YARN_DS_JAR", "distributedshell.jar")
-            appname = (args.jobname or "dmlc") + "-" + role
+            appname = (args.jobname or "dmlc") + "-" + role + "-" + job_tag
             cmd = [
                 "yarn", "jar", ds_jar,
                 "-jar", ds_jar,
@@ -134,13 +141,17 @@ def run(args) -> None:
                 _kill_stale_applications(s["appname"])
                 s["proc"] = subprocess.Popen(s["cmd"])
                 continue
-            for other in subs:  # best-effort cleanup of the other apps
+            # give-up: terminating a client does NOT stop its application
+            # on the RM, so sweep every role's live apps (including this
+            # failed role's, whose last client may have died client-side)
+            for other in subs:
                 if other is not s and other["proc"].poll() is None:
                     other["proc"].terminate()
+            for entry in subs:
+                _kill_stale_applications(entry["appname"])
             LOGGER.warning(
                 "yarn %s application failed %d time(s) (max attempts "
-                "reached); applications already accepted by the RM may "
-                "need `yarn application -kill`", s["role"], max_attempt)
+                "reached); job killed", s["role"], max_attempt)
             raise SystemExit(
                 f"yarn {s['role']} application failed after "
                 f"{max_attempt} attempt(s), client rc={rc}")

@@ -258,15 +258,25 @@ def _fake_yarn_cli(tmp_path, monkeypatch, fail_first_n):
     else:
         body = (f'if [ "$(wc -l < "{count}")" -le {fail_first_n} ]; '
                 "then exit 1; else exit 0; fi\n")
+    # -list echoes back the appname recorded from the last submission, so
+    # the sweep-by-name assertions track the launcher's unique job tag
+    name_file = tmp_path / "last_appname"
     script.write_text(f'''#!/bin/sh
 if [ "$1" = "application" ]; then
   echo "$@" >> "{appcalls}"
   case "$*" in
-    *-list*) printf 'application_1_0001\\tdmlc-worker\\tDISTRIBUTEDSHELL\\n';;
+    *-list*) printf 'application_1_0001\\t%s\\tDISTRIBUTEDSHELL\\n' \
+        "$(cat "{name_file}" 2>/dev/null)";;
   esac
   exit 0
 fi
-echo "$@" >> "{count}"
+all="$*"
+prev=""
+for a in "$@"; do
+  if [ "$prev" = "-appname" ]; then echo "$a" > "{name_file}"; fi
+  prev="$a"
+done
+echo "$all" >> "{count}"
 {body}''')
     script.chmod(0o755)
     monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
