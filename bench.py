@@ -586,6 +586,13 @@ def run_staging(data: Path, fmt: str = "auto") -> dict:
     drain(warmup_batches=3)
     result = drain()
     result["platform"] = platform
+    # producer-side breakdown (BASELINE target 3 diagnosis): shows whether
+    # a slow epoch was parse-bound (native_s), dispatch-bound (stage_s), or
+    # consumer/device-bound (emit_wait_s) — measured, not guessed
+    if getattr(it, "profile", None):
+        result["producer_breakdown"] = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in it.profile.items()}
     return result
 
 
