@@ -10,7 +10,7 @@ ifneq ($(SANITIZER),)
 CMAKE_FLAGS += -DDMLCTPU_ENABLE_SANITIZER=ON -DDMLCTPU_SANITIZER=$(SANITIZER)
 endif
 
-.PHONY: all configure lib test test-native test-python lint docs clean
+.PHONY: all configure lib test test-full test-native test-python lint docs docs-site clean
 
 all: lib
 
@@ -22,6 +22,9 @@ lib: configure
 
 test: lib
 	bash scripts/check.sh
+
+test-full: lib
+	bash scripts/check.sh --full
 
 test-native: lib
 	DMLCTPU_CHECK_FAST=1 bash scripts/check.sh
@@ -35,5 +38,10 @@ lint:
 docs:
 	python scripts/gen_api_docs.py
 
+# published-docs pipeline (reference: Doxyfile + sphinx conf.py ->
+# readthedocs); here: markdown corpus -> static HTML in doc/_site
+docs-site: docs
+	python scripts/build_docs_site.py
+
 clean:
-	rm -rf $(BUILD_DIR)
+	rm -rf $(BUILD_DIR) doc/_site

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Static HTML docs site from the markdown corpus (`make docs-site`).
+
+The reference publishes its docs through a Doxygen + Sphinx pipeline
+(reference doc/Doxyfile, doc/conf.py -> dmlc-core.readthedocs.io); this
+environment ships neither tool, so the published-docs capability is
+provided by this self-contained generator instead: every guide page in
+doc/, the generated API reference (doc/api/, from `make docs`), README
+and PARITY render to doc/_site/*.html with a shared nav, intra-corpus
+.md links rewritten to .html, and fenced code/tables handled by
+python-markdown.  No network, no extra dependencies.
+
+Usage: python scripts/build_docs_site.py   (or `make docs-site`)
+"""
+from __future__ import annotations
+
+import re
+import shutil
+import sys
+from pathlib import Path
+
+import markdown
+
+REPO = Path(__file__).resolve().parent.parent
+OUT = REPO / "doc" / "_site"
+
+# corpus: (source path, output name, nav title); nav order is this order
+PAGES = [
+    (REPO / "doc" / "index.md", "index.html", "Overview"),
+    (REPO / "doc" / "parameter.md", "parameter.html", "Parameters"),
+    (REPO / "doc" / "io.md", "io.html", "IO & filesystems"),
+    (REPO / "doc" / "data.md", "data.html", "Data & staging"),
+    (REPO / "doc" / "tracker.md", "tracker.html", "Tracker & launchers"),
+    (REPO / "doc" / "models.md", "models.html", "Models"),
+    (REPO / "doc" / "api" / "cpp.md", "api-cpp.html", "C++ API"),
+    (REPO / "doc" / "api" / "python.md", "api-python.html", "Python API"),
+    (REPO / "README.md", "readme.html", "README"),
+    (REPO / "PARITY.md", "parity.html", "Parity map"),
+]
+
+_STYLE = """
+body { font-family: system-ui, sans-serif; margin: 0; color: #1a1a1a; }
+.wrap { display: flex; min-height: 100vh; }
+nav { width: 220px; flex-shrink: 0; background: #f6f8fa; padding: 1rem;
+      border-right: 1px solid #d8dee4; }
+nav a { display: block; padding: .3rem .5rem; color: #0550ae;
+        text-decoration: none; border-radius: 4px; }
+nav a.current { background: #0550ae; color: #fff; }
+main { padding: 1.5rem 2.5rem; max-width: 62rem; min-width: 0; }
+pre { background: #f6f8fa; padding: .8rem; overflow-x: auto;
+      border-radius: 6px; font-size: .9em; }
+code { background: #f6f8fa; padding: .1em .3em; border-radius: 3px; }
+pre code { background: none; padding: 0; }
+table { border-collapse: collapse; display: block; overflow-x: auto; }
+th, td { border: 1px solid #d8dee4; padding: .4rem .6rem;
+         text-align: left; vertical-align: top; }
+th { background: #f6f8fa; }
+h1, h2 { border-bottom: 1px solid #d8dee4; padding-bottom: .3rem; }
+"""
+
+_TEMPLATE = """<!doctype html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — dmlc-core-tpu</title><style>{style}</style></head>
+<body><div class="wrap"><nav>{nav}</nav><main>{body}</main></div>
+</body></html>
+"""
+
+
+def _link_map() -> dict:
+    """Every corpus file's repo-relative posix path -> site html name."""
+    return {src.relative_to(REPO).as_posix(): out for src, out, _ in PAGES}
+
+
+def _rewrite_links(text: str, src: Path, links: dict) -> str:
+    """Rewrite intra-corpus markdown links to the generated .html names
+    (resolved relative to the source file); external and non-corpus links
+    pass through untouched."""
+    def sub(m):
+        target = m.group(2)
+        if "://" in target or target.startswith("#"):
+            return m.group(0)
+        path, _, frag = target.partition("#")
+        try:
+            resolved = (src.parent / path).resolve().relative_to(REPO)
+        except (ValueError, OSError):
+            return m.group(0)
+        html = links.get(resolved.as_posix())
+        if html is None:
+            # in-repo but outside the corpus (e.g. examples/README.md):
+            # re-anchor for the site's doc/_site depth so the link reaches
+            # the real source file instead of 404ing inside _site
+            return f"[{m.group(1)}](../../{resolved.as_posix()}" \
+                   f"{'#' + frag if frag else ''})"
+        return f"[{m.group(1)}]({html}{'#' + frag if frag else ''})"
+
+    return re.sub(r"\[([^\]]*)\]\(([^)\s]+)\)", sub, text)
+
+
+def build() -> int:
+    md = markdown.Markdown(extensions=["fenced_code", "tables", "toc"])
+    links = _link_map()
+    missing = [str(s) for s, _, _ in PAGES if not s.exists()]
+    if missing:
+        print(f"build_docs_site: missing sources: {missing}", file=sys.stderr)
+        return 1
+    if OUT.exists():
+        shutil.rmtree(OUT)
+    OUT.mkdir(parents=True)
+    for src, out, title in PAGES:
+        nav = "\n".join(
+            f'<a href="{o}"{" class=current" if o == out else ""}>{t}</a>'
+            for _, o, t in PAGES)
+        text = _rewrite_links(src.read_text(), src, links)
+        md.reset()
+        (OUT / out).write_text(_TEMPLATE.format(
+            title=title, style=_STYLE, nav=nav, body=md.convert(text)))
+    print(f"doc/_site: {len(PAGES)} pages")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(build())
