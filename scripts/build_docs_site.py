@@ -32,8 +32,10 @@ PAGES = [
     (REPO / "doc" / "data.md", "data.html", "Data & staging"),
     (REPO / "doc" / "tracker.md", "tracker.html", "Tracker & launchers"),
     (REPO / "doc" / "models.md", "models.html", "Models"),
+    (REPO / "doc" / "api" / "README.md", "api.html", "API reference"),
     (REPO / "doc" / "api" / "cpp.md", "api-cpp.html", "C++ API"),
     (REPO / "doc" / "api" / "python.md", "api-python.html", "Python API"),
+    (REPO / "examples" / "README.md", "examples.html", "Examples"),
     (REPO / "README.md", "readme.html", "README"),
     (REPO / "PARITY.md", "parity.html", "Parity map"),
 ]
@@ -87,11 +89,10 @@ def _rewrite_links(text: str, src: Path, links: dict) -> str:
             return m.group(0)
         html = links.get(resolved.as_posix())
         if html is None:
-            # in-repo but outside the corpus (e.g. examples/README.md):
-            # re-anchor for the site's doc/_site depth so the link reaches
-            # the real source file instead of 404ing inside _site
-            return f"[{m.group(1)}](../../{resolved.as_posix()}" \
-                   f"{'#' + frag if frag else ''})"
+            # in-repo but outside the corpus (a source file, say): leave
+            # the text, drop the hyperlink — a published site must never
+            # link above its own root (every doc PAGE is in the corpus)
+            return m.group(1)
         return f"[{m.group(1)}]({html}{'#' + frag if frag else ''})"
 
     return re.sub(r"\[([^\]]*)\]\(([^)\s]+)\)", sub, text)

@@ -26,6 +26,24 @@ def test_binner_roundtrip_monotone():
     assert counts.min() > 0.5 * 4096 / 64
 
 
+def test_fast_smoke_tiny_fit_predict_and_validation():
+    """Fast-tier coverage of the full fit->predict path (the slow marks
+    exile the heavier fit tests to the full tier; a regression in the
+    builder should fail the pre-commit gate, not round-end): tiny shapes
+    keep the jit compile to seconds."""
+    rng = np.random.default_rng(9)
+    x = rng.uniform(-1, 1, size=(200, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bins = QuantileBinner(num_bins=8).fit_transform(x)
+    m = GBDT(num_features=3, num_trees=2, max_depth=2, num_bins=8,
+             learning_rate=0.5)
+    p = m.fit(bins, jnp.asarray(y))
+    acc = float(jnp.mean((m.predict(p, bins) > 0.5) == (y > 0.5)))
+    assert acc > 0.9, acc
+    with pytest.raises(ValueError, match="histogram"):
+        GBDT(num_features=3, histogram="bogus")
+
+
 @pytest.mark.slow
 def test_single_tree_recovers_exact_threshold_split():
     """A depth-1 regression tree on y = 1{x > 0} must find the 0 cut and
