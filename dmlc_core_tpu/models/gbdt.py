@@ -433,7 +433,9 @@ class GBDT:
         ``segment_sum`` being GSPMD-partitionable so the compiler inserts
         the histogram psum (the rabit-allreduce analogue); ``pallas_call``
         has no partitioning rule, so routing a row-sharded fit into it
-        would break (or silently replicate) that path.  Off-TPU pallas
+        would break (or silently replicate) that path.  (The supported
+        multi-device kernel route — explicit shard_map + psum — is proven
+        by tests/test_pallas.py's shardmap_psum case.)  Off-TPU pallas
         interpret mode is a correctness tool, not an execution path."""
         if self.histogram != "auto":
             return self.histogram

@@ -86,6 +86,44 @@ def test_histogram_gh_matches_xla():
                                    rtol=1e-5, atol=1e-5)
 
 
+def test_histogram_gh_shardmap_psum_matches_global():
+    """The multi-device route for the Pallas histogram: shard_map over
+    row shards, each device runs the kernel on ITS rows, psum combines —
+    the explicit-collective pattern a sharded-TPU fit uses (GBDT's
+    histogram='auto' declines pallas under GSPMD precisely because
+    pallas_call has no auto-partitioning rule; THIS is the supported
+    sharded path, here proven on the 8-device CPU mesh)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(21)
+    rows, F, B, n_nodes = 8 * 40, 3, 8, 2
+    bins = rng.integers(0, B, (rows, F)).astype(np.int32)
+    rel = rng.integers(0, n_nodes, rows).astype(np.int32)
+    gh = rng.standard_normal((rows, 2)).astype(np.float32)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def local_hist(b, r, g):
+        h = histogram_gh(b, r, g, n_nodes, B, force="pallas")
+        return jax.lax.psum(h, "data")
+
+    # check_vma=False: pallas_call's out_shape carries no varying-axes
+    # annotation in jax 0.9, so the static replication check cannot see
+    # through it; the psum makes the output replicated regardless
+    sharded = jax.jit(jax.shard_map(
+        local_hist, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P(), check_vma=False))
+    rows_sh = NamedSharding(mesh, P("data"))
+    got = sharded(jax.device_put(jnp.asarray(bins), rows_sh),
+                  jax.device_put(jnp.asarray(rel), rows_sh),
+                  jax.device_put(jnp.asarray(gh), rows_sh))
+    want = histogram_gh(jnp.asarray(bins), jnp.asarray(rel),
+                        jnp.asarray(gh), n_nodes, B)  # global, xla
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.slow  # two full fits through interpret-mode pallas (~30 s)
 def test_histogram_gh_gbdt_forests_identical():
     """VERDICT r4 #1 'done' criterion: the SAME forest comes out of a fit
