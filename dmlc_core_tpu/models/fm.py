@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data.staging import PaddedBatch
+from ..ops.pallas_segment import check_force
 from ..ops.sparse import csr_matmul, csr_matvec, csr_row_sumsq_matmul, padded_row_mean
 from .common import logistic_nll
 
@@ -22,15 +23,22 @@ from .common import logistic_nll
 class FactorizationMachine:
     def __init__(self, num_features: int, num_factors: int = 16,
                  objective: str = "logistic", l2: float = 0.0,
-                 learning_rate: float = 0.05, init_scale: float = 0.01):
+                 learning_rate: float = 0.05, init_scale: float = 0.01,
+                 sdot_backend: str | None = None):
         if objective not in ("logistic", "squared"):
             raise ValueError(f"unknown objective '{objective}'")
+        check_force(sdot_backend, "sdot_backend")
         self.num_features = num_features
         self.num_factors = num_factors
         self.objective = objective
         self.l2 = l2
         self.learning_rate = learning_rate
         self.init_scale = init_scale
+        # reduction backend for the three Row::SDot ops (ops.sparse force=):
+        # None/"xla" = scatter-add (GSPMD-partitionable — required for
+        # sharded batches); "pallas" = the scatter-free kernel, a
+        # SINGLE-device TPU knob (pallas_call has no partitioning rule)
+        self.sdot_backend = sdot_backend
 
     def init(self, seed: int = 0) -> dict:
         key = jax.random.PRNGKey(seed)
@@ -44,10 +52,13 @@ class FactorizationMachine:
     def margins(self, params: dict, batch: PaddedBatch) -> jax.Array:
         B = batch.batch_size
         rid = batch.row_ids()  # derived on device; CSE'd across the three uses
-        linear = csr_matvec(params["w"], batch.index, batch.value, rid, B)
-        vx = csr_matmul(params["v"], batch.index, batch.value, rid, B)  # [B,K]
+        fb = self.sdot_backend
+        linear = csr_matvec(params["w"], batch.index, batch.value, rid, B,
+                            force=fb)
+        vx = csr_matmul(params["v"], batch.index, batch.value, rid, B,
+                        force=fb)  # [B,K]
         v2x2 = csr_row_sumsq_matmul(params["v"], batch.index, batch.value,
-                                    rid, B)  # [B,K]
+                                    rid, B, force=fb)  # [B,K]
         second = 0.5 * jnp.sum(vx ** 2 - v2x2, axis=-1)
         return linear + second + params["b"]
 

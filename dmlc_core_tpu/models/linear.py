@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data.staging import PaddedBatch
+from ..ops.pallas_segment import check_force
 from ..ops.sparse import csr_matvec, padded_row_mean
 from .common import logistic_nll
 
@@ -26,13 +27,19 @@ class SparseLinearModel:
     """
 
     def __init__(self, num_features: int, objective: str = "logistic",
-                 l2: float = 0.0, learning_rate: float = 0.1):
+                 l2: float = 0.0, learning_rate: float = 0.1,
+                 sdot_backend: str | None = None):
         if objective not in ("logistic", "squared"):
             raise ValueError(f"unknown objective '{objective}'")
+        check_force(sdot_backend, "sdot_backend")
         self.num_features = num_features
         self.objective = objective
         self.l2 = l2
         self.learning_rate = learning_rate
+        # Row::SDot reduction backend (ops.sparse force=): None/"xla" =
+        # GSPMD-safe scatter-add; "pallas" = scatter-free kernel,
+        # single-device TPU only (no pallas partitioning rule)
+        self.sdot_backend = sdot_backend
 
     def init(self, seed: int = 0) -> dict:
         del seed  # linear model: zero init is canonical
@@ -43,7 +50,8 @@ class SparseLinearModel:
     def margins(self, params: dict, batch: PaddedBatch) -> jax.Array:
         """Per-row scores w·x + b."""
         return csr_matvec(params["w"], batch.index, batch.value,
-                          batch.row_ids(), batch.batch_size) + params["b"]
+                          batch.row_ids(), batch.batch_size,
+                          force=self.sdot_backend) + params["b"]
 
     def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
         m = self.margins(params, batch)

@@ -30,6 +30,17 @@ from jax.experimental import pallas as pl
 _ROW_TILE = 512    # rows per out tile (lane-friendly multiple of 128)
 _NNZ_TILE = 1024   # entries per inner step
 
+# the one authoritative list of reduction backends; every force=/
+# sdot_backend= surface validates through check_force so adding a
+# backend is a one-place change
+VALID_FORCE = (None, "xla", "pallas")
+
+
+def check_force(force, what: str = "backend") -> None:
+    if force not in VALID_FORCE:
+        raise ValueError(f"unknown {what} force={force!r} "
+                         f"(want one of {VALID_FORCE})")
+
 
 def _seg_kernel(row_id_ref, contrib_ref, out_ref):
     rt = pl.program_id(0)
@@ -83,6 +94,34 @@ def _segment_sum_pallas(contrib: jax.Array, row_id: jax.Array,
     )(row_id_p, contrib_p)
     res = out[:, :num_segments]
     return res[0] if contrib.ndim == 1 else res.T
+
+
+# pallas_call has no autodiff rule, but segment-sum's VJP is exact and
+# trivial — d_contrib[k] = g_out[row_id[k]], a gather — so the kernel
+# stays usable under jax.grad (the FM/linear train steps differentiate
+# through their Row::SDot reductions; GBDT alone wouldn't need this, its
+# grad/hess are analytic).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _segment_sum_pallas_diff(contrib, row_id, num_segments, interpret):
+    return _segment_sum_pallas(contrib, row_id, num_segments, interpret)
+
+
+def _segment_sum_fwd(contrib, row_id, num_segments, interpret):
+    out = _segment_sum_pallas(contrib, row_id, num_segments, interpret)
+    # zero-size dtype token: residuals must be JAX types, not dtypes
+    return out, (row_id, jnp.zeros((0,), contrib.dtype))
+
+
+def _segment_sum_bwd(num_segments, interpret, res, g):
+    row_id, dtype_token = res
+    import numpy as _np
+    d_contrib = g[row_id].astype(dtype_token.dtype)
+    # integer primal: cotangent is float0 by JAX convention
+    d_row_id = _np.zeros(row_id.shape, jax.dtypes.float0)
+    return d_contrib, d_row_id
+
+
+_segment_sum_pallas_diff.defvjp(_segment_sum_fwd, _segment_sum_bwd)
 
 
 def _hist_kernel(num_bins: int, seg_tile: int,
@@ -163,13 +202,15 @@ def histogram_gh(bins: jax.Array, rel: jax.Array, gh: jax.Array,
     per feature, so each entry only meets its own feature's segments).
     Wins while ``n_nodes * num_bins`` is modest (early/mid levels, the
     bulk of wall-time at XGBoost-default depth 6); interpret mode
-    off-TPU.
+    off-TPU.  Accumulates in f32; result cast back to gh's dtype so the
+    backends stay drop-in interchangeable.
     """
+    check_force(force, "histogram backend")
     if force == "pallas":
         interpret = jax.default_backend() != "tpu"
         return _histogram_gh_pallas(
             jnp.asarray(bins, jnp.int32).T, jnp.asarray(rel, jnp.int32),
-            gh, n_nodes, num_bins, interpret)
+            gh, n_nodes, num_bins, interpret).astype(gh.dtype)
     rows, F = bins.shape
     feat_cols = jnp.arange(F, dtype=jnp.int32)
     keys = ((rel[:, None] * F + feat_cols[None, :]) * num_bins
@@ -188,9 +229,14 @@ def segment_sum(contrib: jax.Array, row_id: jax.Array, num_segments: int,
     the key/one-hot work is amortized over the lanes).
     force: None/"xla" -> jax.ops.segment_sum (scatter-add);
            "pallas"   -> the tiled one-hot contraction kernel above
-                         (interpret mode off-TPU).
+                         (interpret mode off-TPU; accumulates in f32,
+                         result cast back to contrib's dtype so the two
+                         backends stay drop-in interchangeable).
     """
+    check_force(force, "segment-sum backend")
     if force == "pallas":
         interpret = jax.default_backend() != "tpu"
-        return _segment_sum_pallas(contrib, row_id, num_segments, interpret)
+        out = _segment_sum_pallas_diff(contrib, row_id, num_segments,
+                                       interpret)
+        return out.astype(contrib.dtype)
     return jax.ops.segment_sum(contrib, row_id, num_segments=num_segments)

@@ -4,7 +4,10 @@ reference's Row::SDot loop (include/dmlc/data.h:146-161).
 All ops take flattened COO arrays (index/value/row_id from a PaddedBatch) so
 they jit to gathers + segment-sums with fully static shapes.  The dense-side
 operands (weight vectors / embedding tables) are where the MXU work lives for
-FM-style models; segment_sum lowers to efficient TPU scatter-adds.
+FM-style models.  The reduction backend is selectable per call (``force``,
+threaded to ops.segment_sum): None/"xla" keeps XLA's scatter-add, "pallas"
+runs the tiled one-hot-contraction kernel — the same scatter-free trade the
+GBDT histogram uses, for the Row::SDot reductions of the linear/FM models.
 Padding convention: value == 0 ⇒ the entry contributes nothing.
 """
 from __future__ import annotations
@@ -12,33 +15,39 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .pallas_segment import segment_sum
+
 
 def csr_matvec(weights: jax.Array, index: jax.Array, value: jax.Array,
-               row_id: jax.Array, num_rows: int) -> jax.Array:
+               row_id: jax.Array, num_rows: int,
+               force: str | None = None) -> jax.Array:
     """Per-row sparse dot product: out[r] = Σ_{k: row_id[k]=r} w[index[k]]·value[k].
 
     The vectorized Row::SDot: one gather + one segment-sum.
     """
     contrib = weights[index] * value
-    return jax.ops.segment_sum(contrib, row_id, num_segments=num_rows)
+    return segment_sum(contrib, row_id, num_rows, force=force)
 
 
 def csr_matmul(table: jax.Array, index: jax.Array, value: jax.Array,
-               row_id: jax.Array, num_rows: int) -> jax.Array:
+               row_id: jax.Array, num_rows: int,
+               force: str | None = None) -> jax.Array:
     """Sparse×dense: out[r, :] = Σ_k value[k] · table[index[k], :].
 
     `table` is [num_features, K] (an embedding / factor matrix); output
-    [num_rows, K].  Gather rows, scale, segment-sum.
+    [num_rows, K].  Gather rows, scale, segment-sum (K lanes share one
+    kernel pass under force="pallas").
     """
     gathered = table[index] * value[:, None]
-    return jax.ops.segment_sum(gathered, row_id, num_segments=num_rows)
+    return segment_sum(gathered, row_id, num_rows, force=force)
 
 
 def csr_row_sumsq_matmul(table: jax.Array, index: jax.Array, value: jax.Array,
-                         row_id: jax.Array, num_rows: int) -> jax.Array:
+                         row_id: jax.Array, num_rows: int,
+                         force: str | None = None) -> jax.Array:
     """out[r, :] = Σ_k value[k]² · table[index[k], :]² (FM second-order term)."""
     gathered = (table[index] ** 2) * (value[:, None] ** 2)
-    return jax.ops.segment_sum(gathered, row_id, num_segments=num_rows)
+    return segment_sum(gathered, row_id, num_rows, force=force)
 
 
 def padded_row_mean(per_row: jax.Array, weight: jax.Array) -> jax.Array:
