@@ -1011,6 +1011,13 @@ class GBDT:
         ``missing_aware=True`` bins from ``transform_entries`` (all codes
         >= 1; bin 0 stays empty).
 
+        Deliberately XLA-scatter-only (no ``histogram=`` backend): the
+        Pallas one-hot contraction amortizes its compare work by blocking
+        per feature, which needs feature-sorted keys; COO entries arrive
+        feature-unsorted, so the kernel would pay the full
+        nnz x (nodes*features*bins) compare cost — strictly worse than
+        O(nnz) scatter.
+
         row_id/findex/ebin/emask: [nnz] (emask 0 for padding lanes);
         grad/hess: [rows] weight-scaled.  Returns the same 7-tuple as
         `_build_tree`.
