@@ -71,7 +71,12 @@ Parser<IndexType, DType>* CreateLibFMParser(const std::string& path,
   return CreateTextParser<LibFMParser, IndexType, DType>(path, args, part, num_parts);
 }
 
-/*! \brief resolve type ("auto" → ?format= arg → libsvm) through the registry */
+/*! \brief resolve type ("auto" → ?format= arg → extension → libsvm).
+ * The reference stops at ?format= and defaults straight to libsvm
+ * (reference src/data.cc:70-76); this build additionally sniffs the
+ * path extension first, because a .libfm/.csv file silently parsed as
+ * libsvm yields plausible-looking WRONG data (e.g. the libfm triple
+ * "0:2:1" reads as index 0, value 2), not an error. */
 template <typename IndexType, typename DType>
 Parser<IndexType, DType>* CreateParserImpl(const char* uri_, unsigned part,
                                            unsigned num_parts, const char* type,
@@ -80,7 +85,18 @@ Parser<IndexType, DType>* CreateParserImpl(const char* uri_, unsigned part,
   io::URISpec spec(uri_, part, num_parts);
   if (ptype == "auto") {
     auto it = spec.args.find("format");
-    ptype = (it != spec.args.end()) ? it->second : "libsvm";
+    if (it != spec.args.end()) {
+      ptype = it->second;
+    } else {
+      auto ends_with = [&](const char* suf) {
+        size_t n = std::strlen(suf);
+        return spec.uri.size() >= n &&
+               spec.uri.compare(spec.uri.size() - n, n, suf) == 0;
+      };
+      ptype = ends_with(".libfm") ? "libfm"
+              : ends_with(".csv") ? "csv"
+                                  : "libsvm";
+    }
   }
   const auto* entry = Registry<ParserFactoryReg<IndexType, DType>>::Get()->Find(ptype);
   TCHECK(entry != nullptr) << "unknown data format '" << ptype << "'";

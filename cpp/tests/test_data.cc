@@ -245,6 +245,31 @@ TESTCASE(libfm_triples) {
   EXPECT_EQV(all.max_index, 7u);
 }
 
+TESTCASE(auto_format_sniffs_extension) {
+  // beyond-reference behavior: "auto" with no ?format= infers libfm/csv
+  // from the path extension instead of silently mis-parsing as libsvm
+  TemporaryDirectory tmp;
+  std::string fm = tmp.path + "/a.libfm";
+  WriteFile(fm, "1 0:3:1.5 2:7:0.5\n");
+  auto p1 = Parser<uint32_t>::Create(fm.c_str(), 0, 1, "auto");
+  auto r1 = DrainParser(p1.get());
+  EXPECT_EQV(r1.field.size(), 2u);   // fields parsed => libfm ran
+  EXPECT_EQV(r1.index[1], 7u);
+  std::string csv = tmp.path + "/b.csv";
+  WriteFile(csv, "1,2.5,3\n0,1.5,4\n");
+  auto p2 = Parser<uint32_t>::Create(
+      (csv + "?label_column=0").c_str(), 0, 1, "auto");
+  auto r2 = DrainParser(p2.get());
+  EXPECT_EQV(r2.Size(), 2u);
+  EXPECT_EQV(r2.index.size(), 4u);   // dense 2-col rows => csv ran
+  EXPECT_TRUE(std::abs(r2.value[0] - 2.5f) < kEps);
+  // ?format= still wins over the extension
+  auto p3 = Parser<uint32_t>::Create((fm + "?format=libsvm").c_str(), 0, 1,
+                                     "auto");
+  auto r3 = DrainParser(p3.get());
+  EXPECT_EQV(r3.field.size(), 0u);   // no field lane => libsvm ran
+}
+
 TESTCASE(parser_multirank_union) {
   TemporaryDirectory tmp;
   std::string f = tmp.path + "/big.libsvm";

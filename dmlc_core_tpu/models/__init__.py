@@ -3,7 +3,8 @@ reference delegates to downstream DMLC projects (XGBoost/MXNet), rebuilt as
 jittable JAX models over PaddedBatch pytrees."""
 from .linear import SparseLinearModel
 from .fm import FactorizationMachine
+from .ffm import FieldAwareFactorizationMachine
 from .gbdt import GBDT, QuantileBinner
 
-__all__ = ["SparseLinearModel", "FactorizationMachine", "GBDT",
-           "QuantileBinner"]
+__all__ = ["SparseLinearModel", "FactorizationMachine",
+           "FieldAwareFactorizationMachine", "GBDT", "QuantileBinner"]

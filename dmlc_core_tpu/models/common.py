@@ -2,6 +2,9 @@
 used by linear / FM / GBDT alike)."""
 from __future__ import annotations
 
+import functools
+from typing import Tuple
+
 import jax
 import jax.numpy as jnp
 
@@ -14,3 +17,49 @@ def logistic_nll(margin: jax.Array, label: jax.Array) -> jax.Array:
     y = jnp.where(label > 0.5, 1.0, 0.0)
     return (jnp.maximum(margin, 0) - margin * y
             + jnp.log1p(jnp.exp(-jnp.abs(margin))))
+
+
+class SGDModelMixin:
+    """loss / predict / train_step shared by the margins-based families
+    (linear, FM, field-aware FM) — ONE implementation of the objective
+    dispatch, weighted padding-inert mean, l2 penalty, and jitted SGD
+    step, so the three models cannot drift apart.
+
+    Subclasses provide ``margins(params, batch)`` plus attributes
+    ``objective`` ("logistic"/"squared"), ``l2``, ``learning_rate``, and
+    may override ``_l2_terms(params)`` (default: just ``params["w"]``)
+    to widen the penalty set.
+    """
+
+    def _l2_terms(self, params: dict) -> tuple:
+        return (params["w"],)
+
+    def loss(self, params: dict, batch) -> jax.Array:
+        from ..ops.sparse import padded_row_mean
+        m = self.margins(params, batch)
+        if self.objective == "logistic":
+            per_row = logistic_nll(m, batch.label)  # {-1,1} or {0,1}
+        else:
+            per_row = 0.5 * (m - batch.label) ** 2
+        data_loss = padded_row_mean(per_row, batch.weight)
+        if self.l2 > 0.0:
+            data_loss = data_loss + 0.5 * self.l2 * sum(
+                jnp.sum(t ** 2) for t in self._l2_terms(params))
+        return data_loss
+
+    def predict(self, params: dict, batch) -> jax.Array:
+        m = self.margins(params, batch)
+        return jax.nn.sigmoid(m) if self.objective == "logistic" else m
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, params: dict, batch) -> Tuple[dict, jax.Array]:
+        """One SGD step; returns (new_params, loss).
+
+        Under jit with replicated params and a data-sharded batch, the
+        grad reduction lowers to a psum over the mesh — the
+        rabit-allreduce path.
+        """
+        loss, grads = jax.value_and_grad(self.loss)(params, batch)
+        new_params = jax.tree.map(
+            lambda p, g: p - self.learning_rate * g, params, grads)
+        return new_params, loss

@@ -8,19 +8,16 @@ couple of dense reductions.
 """
 from __future__ import annotations
 
-import functools
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 
 from ..data.staging import PaddedBatch
 from ..ops.pallas_segment import check_force
-from ..ops.sparse import csr_matmul, csr_matvec, csr_row_sumsq_matmul, padded_row_mean
-from .common import logistic_nll
+from ..ops.sparse import csr_matmul, csr_matvec, csr_row_sumsq_matmul
+from .common import SGDModelMixin
 
 
-class FactorizationMachine:
+class FactorizationMachine(SGDModelMixin):
     def __init__(self, num_features: int, num_factors: int = 16,
                  objective: str = "logistic", l2: float = 0.0,
                  learning_rate: float = 0.05, init_scale: float = 0.01,
@@ -62,25 +59,5 @@ class FactorizationMachine:
         second = 0.5 * jnp.sum(vx ** 2 - v2x2, axis=-1)
         return linear + second + params["b"]
 
-    def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
-        m = self.margins(params, batch)
-        if self.objective == "logistic":
-            per_row = logistic_nll(m, batch.label)
-        else:
-            per_row = 0.5 * (m - batch.label) ** 2
-        data_loss = padded_row_mean(per_row, batch.weight)
-        if self.l2 > 0.0:
-            data_loss = data_loss + 0.5 * self.l2 * (
-                jnp.sum(params["w"] ** 2) + jnp.sum(params["v"] ** 2))
-        return data_loss
-
-    def predict(self, params: dict, batch: PaddedBatch) -> jax.Array:
-        m = self.margins(params, batch)
-        return jax.nn.sigmoid(m) if self.objective == "logistic" else m
-
-    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
-    def train_step(self, params: dict, batch: PaddedBatch) -> Tuple[dict, jax.Array]:
-        loss, grads = jax.value_and_grad(self.loss)(params, batch)
-        new_params = jax.tree.map(
-            lambda p, g: p - self.learning_rate * g, params, grads)
-        return new_params, loss
+    def _l2_terms(self, params: dict) -> tuple:
+        return (params["w"], params["v"])

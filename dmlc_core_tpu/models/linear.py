@@ -8,19 +8,16 @@ XLA insert the gradient all-reduce automatically.
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 
 from ..data.staging import PaddedBatch
 from ..ops.pallas_segment import check_force
-from ..ops.sparse import csr_matvec, padded_row_mean
-from .common import logistic_nll
+from ..ops.sparse import csr_matvec
+from .common import SGDModelMixin
 
 
-class SparseLinearModel:
+class SparseLinearModel(SGDModelMixin):
     """Logistic regression / linear regression over sparse batches.
 
     objective: "logistic" (labels in {0,1} or {-1,1}) or "squared".
@@ -52,35 +49,6 @@ class SparseLinearModel:
         return csr_matvec(params["w"], batch.index, batch.value,
                           batch.row_ids(), batch.batch_size,
                           force=self.sdot_backend) + params["b"]
-
-    def loss(self, params: dict, batch: PaddedBatch) -> jax.Array:
-        m = self.margins(params, batch)
-        if self.objective == "logistic":
-            per_row = logistic_nll(m, batch.label)  # accepts {-1,1} or {0,1}
-        else:
-            per_row = 0.5 * (m - batch.label) ** 2
-        data_loss = padded_row_mean(per_row, batch.weight)
-        if self.l2 > 0.0:
-            data_loss = data_loss + 0.5 * self.l2 * jnp.sum(params["w"] ** 2)
-        return data_loss
-
-    def predict(self, params: dict, batch: PaddedBatch) -> jax.Array:
-        m = self.margins(params, batch)
-        if self.objective == "logistic":
-            return jax.nn.sigmoid(m)
-        return m
-
-    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
-    def train_step(self, params: dict, batch: PaddedBatch) -> Tuple[dict, jax.Array]:
-        """One SGD step; returns (new_params, loss).
-
-        Under jit with replicated params and a data-sharded batch, the grad
-        reduction lowers to a psum over the mesh — the rabit-allreduce path.
-        """
-        loss, grads = jax.value_and_grad(self.loss)(params, batch)
-        new_params = jax.tree.map(
-            lambda p, g: p - self.learning_rate * g, params, grads)
-        return new_params, loss
 
     def evaluate(self, params: dict, batches) -> dict:
         """Accuracy/loss over an iterable of batches (host-side reduce)."""
