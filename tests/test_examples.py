@@ -86,3 +86,24 @@ def test_gbdt_rank_example_runs(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert "pairwise_accuracy=" in proc.stdout
+
+
+def test_ffm_example_runs(tmp_path):
+    """The field-aware FM example: libfm file -> field staging -> FFM SGD,
+    fitting a field-pairing signal a plain FM cannot express."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_example_ffm", REPO / "examples" / "train_ffm.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    data = tmp_path / "tiny.libfm"
+    nf = mod.synth_dataset(str(data), rows=4000)
+    assert nf == 16
+    proc = subprocess.run(
+        [sys.executable, "examples/train_ffm.py", "--data", str(data),
+         "--epochs", "60", "--batch-size", "4096"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    acc = float(proc.stdout.rsplit("final accuracy:", 1)[1].strip())
+    assert acc > 0.95, proc.stdout
