@@ -86,6 +86,41 @@ def test_full_rendezvous_eight_workers():
             assert r in links[p]
 
 
+@pytest.mark.slow  # 32 threads through the full wire protocol (~5 s)
+def test_full_rendezvous_thirty_two_workers():
+    """Scale sweep of the rendezvous: the tree+ring topology, rank
+    assignment, and link brokering must hold at 4x the smoke-test world
+    size (the reference's tracker regularly brokered 32+ rabit workers)."""
+    world = 32
+    tracker = RabitTracker("127.0.0.1", world)
+    tracker.start()
+    results = {}
+    threads = [threading.Thread(target=_run_worker,
+                                args=(results, i, tracker.port, world))
+               for i in range(world)]
+    for t in threads:
+        t.start()
+    tracker.join(timeout=90)
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == world
+    assert sorted(r for r, *_ in results.values()) == list(range(world))
+    links = {r: set(p.keys()) for r, _, _, p in results.values()}
+    for r, peers in links.items():
+        for p in peers:
+            assert r in links[p]  # symmetric
+    # the link graph is connected (allreduce reaches everyone)
+    seen = set()
+    stack = [0]
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(links[n])
+    assert seen == set(range(world)), "link graph disconnected"
+
+
 def test_recover_reclaims_rank_and_relinks():
     """Kill a worker mid-job; it reconnects with cmd='recover' (same jobid)
     and must get its old rank back with a working peer link (reference
