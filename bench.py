@@ -544,7 +544,9 @@ def run_gbdt() -> dict:
         outs = {}
         for impl in ("xla", "pallas"):
             force = impl
-            histogram_gh(hbins, hrel, hgh, hn, hb, force=force)  # warmup
+            # block: async dispatch would fold the warmup tail into t0
+            jax.block_until_ready(
+                histogram_gh(hbins, hrel, hgh, hn, hb, force=force))
             t0 = time.monotonic()
             outs[impl] = histogram_gh(hbins, hrel, hgh, hn, hb, force=force)
             jax.block_until_ready(outs[impl])

@@ -107,10 +107,17 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
     # non-power-of-two meshes
     nfloats = max(n, nfloats - nfloats % n)
     # allgather: every device holds the FULL gathered array, so the global
-    # result is replicated (out_specs P()); jax's static vma check cannot
-    # infer all_gather output replication, so it is disabled for that op
-    # only (the other ops keep the check).
-    kwargs = {"check_vma": False} if op == "allgather" else {}
+    # result is replicated (out_specs P()); jax's static replication check
+    # cannot infer all_gather output replication, so it is disabled for
+    # that op only (the other ops keep the check).  The flag is named
+    # check_vma on jax >= 0.8's stable shard_map and check_rep on the
+    # experimental fallback — pick whichever this jax has.
+    kwargs = {}
+    if op == "allgather":
+        import inspect
+        params = inspect.signature(shard_map).parameters
+        kwargs = {("check_vma" if "check_vma" in params
+                   else "check_rep"): False}
     step = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"),
                              out_specs=out_spec, **kwargs))
     x = jax.device_put(
