@@ -569,6 +569,53 @@ def run_gbdt() -> dict:
             "platform": platform}
 
 
+def run_models() -> dict:
+    """Model-family throughput: steady-state train-step rate for the
+    linear / FM / field-aware FM families on one synthetic staged-shape
+    batch (value-add breadth metric; the GBDT flagship has its own
+    phase).  Rows/s = batch_rows * steps / seconds over `iters` jitted
+    steps after one warmup."""
+    jax, platform = pick_backend()
+    import numpy as np
+
+    from dmlc_core_tpu.data.staging import PaddedBatch
+    from dmlc_core_tpu.models import (FactorizationMachine,
+                                      FieldAwareFactorizationMachine,
+                                      SparseLinearModel)
+    jnp = jax.numpy
+    rows, F, nnz_row, A = 65536, 1000, 16, 8
+    rng = np.random.default_rng(3)
+    nnz = rows * nnz_row
+    batch = PaddedBatch(
+        label=jnp.asarray((rng.random(rows) < 0.5).astype(np.float32)),
+        weight=jnp.ones(rows, jnp.float32),
+        row_ptr=jnp.asarray((np.arange(rows + 1) * nnz_row).astype(np.int32)),
+        index=jnp.asarray(rng.integers(0, F, nnz).astype(np.int32)),
+        value=jnp.asarray(rng.random(nnz).astype(np.float32)),
+        num_rows=jnp.asarray(np.int32(rows)),
+        field=jnp.asarray(rng.integers(0, A, nnz).astype(np.int32)))
+    out = {"rows": rows, "nnz": nnz, "platform": platform}
+    iters = 10
+    for name, m in (
+            ("linear", SparseLinearModel(num_features=F)),
+            ("fm", FactorizationMachine(num_features=F, num_factors=16)),
+            ("ffm", FieldAwareFactorizationMachine(
+                num_features=F, num_fields=A, num_factors=4))):
+        try:
+            params = m.init()
+            params, _ = m.train_step(params, batch)  # compile warmup
+            jax.block_until_ready(params)
+            t0 = time.monotonic()
+            for _ in range(iters):
+                params, loss = m.train_step(params, batch)
+            jax.block_until_ready(loss)
+            out[f"{name}_rows_s"] = round(
+                rows * iters / (time.monotonic() - t0))
+        except Exception as e:  # noqa: BLE001 — per-family isolation
+            out[f"{name}_error"] = str(e)[-200:]
+    return out
+
+
 def run_staging(data: Path, fmt: str = "auto") -> dict:
     """Extra: the full native parse -> pad -> HBM staging path."""
     jax, platform = pick_backend()
@@ -712,6 +759,7 @@ def real_allreduce():
     out["platform"] = devices[0].platform
     return out
 phase("allreduce", real_allreduce)
+phase("models", bench.run_models)
 phase("gbdt", bench.run_gbdt)
 """
 
@@ -733,8 +781,15 @@ def _better_observation(entry: dict, prev: dict | None) -> bool:
         return True
     if entry.get("reconstructed") and not prev.get("reconstructed"):
         return False
+    # an entry carrying per-family errors never replaces a clean one
+    def errors(e: dict):
+        return sum(1 for k in e if k.endswith("_error"))
+    if errors(entry) > errors(prev):
+        return False
+
     def throughput(e: dict):
-        return e.get("mb_s") or e.get("gbps") or e.get("row_trees_s")
+        return (e.get("mb_s") or e.get("gbps") or e.get("row_trees_s")
+                or e.get("linear_rows_s"))
 
     key = throughput(entry)
     prev_key = throughput(prev)
@@ -822,18 +877,19 @@ def run_device_phases() -> dict:
                     phases[name] = result
 
     if probe_tpu()["ok"]:
-        # budget sized for the tail phase (gbdt: up to three forest
-        # compiles over a rate-shaped tunnel); phases stream results as
-        # they finish, so a timeout still keeps everything completed
-        run_child("tpu", timeout=720)
+        # budget sized for the tail phases (models: three model compiles;
+        # gbdt: up to three forest compiles — all over a rate-shaped
+        # tunnel); phases stream results as they finish, so a timeout
+        # still keeps everything completed
+        run_child("tpu", timeout=900)
     missing = {"staging", "csv_staging", "recordio_staging",
-               "h2d", "pallas_segment", "gbdt"} - set(phases)
+               "h2d", "pallas_segment", "models", "gbdt"} - set(phases)
     if missing:
         log(f"[bench] filling {sorted(missing)} on the CPU backend")
-        # same tail-phase budget as the TPU child: gbdt now runs last in
+        # same tail-phase budget as the TPU child: models+gbdt run last in
         # the shared child script, and a timeout mid-gbdt would null the
         # headline row-trees/s in the round artifact
-        run_child("cpu", timeout=720)
+        run_child("cpu", timeout=900)
     return phases
 
 
@@ -923,6 +979,10 @@ def main() -> None:
         "allreduce_devices": allreduce.get("devices"),
         "allreduce_note": allreduce.get("note") or allreduce.get("error"),
         "collectives_bus_gbps": allreduce.get("others"),
+        "model_family_rows_s": {
+            k: v for k, v in phases.get("models", {}).items()
+            if k.endswith("_rows_s") or k.endswith("_error")
+            or k == "platform"} or None,
         "gbdt_row_trees_per_sec": phases.get("gbdt", {}).get("row_trees_s"),
         "gbdt_sparse_row_trees_per_sec": phases.get("gbdt", {}).get(
             "sparse_row_trees_s"),
@@ -948,6 +1008,7 @@ def main() -> None:
         "staging_to_hbm_mb_s": full["staging_to_hbm_mb_s"],
         "recordio_staging_mb_s": full["recordio_staging_mb_s"],
         "gbdt_row_trees_per_sec": full["gbdt_row_trees_per_sec"],
+        "model_family_rows_s": full["model_family_rows_s"],
         "gbdt_hist_ab": gbdt.get("hist_ab"),
         "allreduce_bus_gbps": full["allreduce_bus_gbps"],
         "h2d_gbps": full["h2d_gbps_single_chip"],
