@@ -781,11 +781,12 @@ def _better_observation(entry: dict, prev: dict | None) -> bool:
         return True
     if entry.get("reconstructed") and not prev.get("reconstructed"):
         return False
-    # an entry carrying per-family errors never replaces a clean one
+    # fewer per-family errors always wins (an error-carrying run must
+    # never replace a cleaner persisted result, and vice versa)
     def errors(e: dict):
         return sum(1 for k in e if k.endswith("_error"))
-    if errors(entry) > errors(prev):
-        return False
+    if errors(entry) != errors(prev):
+        return errors(entry) < errors(prev)
 
     def throughput(e: dict):
         return (e.get("mb_s") or e.get("gbps") or e.get("row_trees_s")
