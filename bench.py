@@ -236,16 +236,24 @@ def probe_tpu() -> dict:
 def fold_probe_attempts() -> dict | None:
     """Summarize scripts/tpu_probe_daemon.py's attempts log (JSONL appended
     across the whole round) so the judged artifact carries either a TPU
-    success or proof the tunnel stayed down on a multi-attempt cadence."""
-    path = CACHE / "tpu_probe_attempts.jsonl"
-    if not path.exists():
-        return None
-    attempts = []
-    for line in path.read_text().splitlines():
-        try:
-            attempts.append(json.loads(line))
-        except json.JSONDecodeError:
+    success or proof the tunnel stayed down on a multi-attempt cadence.
+
+    Merges the /tmp cache with the repo-committed copy
+    (TPU_PROBE_LOG.jsonl): /tmp does not survive a machine recycle, and
+    round 4 lost exactly this class of evidence to one."""
+    seen = {}
+    for path in (REPO / "TPU_PROBE_LOG.jsonl",
+                 CACHE / "tpu_probe_attempts.jsonl"):
+        if not path.exists():
             continue
+        for line in path.read_text().splitlines():
+            try:
+                a = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(a, dict) and a.get("ts"):
+                seen[a["ts"]] = a
+    attempts = [seen[ts] for ts in sorted(seen)]
     if not attempts:
         return None
     successes = [a for a in attempts if a.get("ok")]
