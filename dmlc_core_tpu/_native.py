@@ -44,27 +44,56 @@ def _candidate_paths():
     yield Path(__file__).resolve().parent / "libdmlctpu.so"
 
 
+def _lock_handle():
+    """Open (creating if needed) the cross-process build lock file.
+
+    Serializes the gate (scripts/check.sh), bench device children, and
+    pytest workers: two concurrent `cmake -B` configures of one tree corrupt
+    each other's CMakeFiles/, and dlopen of a .so that ninja is relinking in
+    place raises invalid-ELF.  Builders take LOCK_EX, loaders LOCK_SH."""
+    build_dir = _REPO_ROOT / "build"
+    build_dir.mkdir(parents=True, exist_ok=True)
+    return open(build_dir / ".dmlctpu_build_lock", "w")
+
+
 def _build_native() -> Path:
     build_dir = _REPO_ROOT / "build"
-    for cmd in (["cmake", "-B", str(build_dir), "-G", "Ninja",
-                 "-DCMAKE_BUILD_TYPE=Release"],
-                ["ninja", "-C", str(build_dir), "dmlctpu"]):
-        proc = subprocess.run(cmd, cwd=_REPO_ROOT, capture_output=True,
-                              text=True)
-        if proc.returncode != 0:
-            # surface the compiler/linker output: an opaque import failure
-            # here makes EVERY Python entry point undiagnosable
-            raise RuntimeError(
-                f"native build failed ({' '.join(cmd[:2])}, "
-                f"rc={proc.returncode}):\n{proc.stderr[-2000:]}")
-    return build_dir / "libdmlctpu.so"
+    so = build_dir / "libdmlctpu.so"
+    import fcntl
+    with _lock_handle() as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if so.exists():  # another process built it while we waited
+            return so
+        for cmd in (["cmake", "-B", str(build_dir), "-G", "Ninja",
+                     "-DCMAKE_BUILD_TYPE=Release"],
+                    ["ninja", "-C", str(build_dir), "dmlctpu"]):
+            proc = subprocess.run(cmd, cwd=_REPO_ROOT, capture_output=True,
+                                  text=True)
+            if proc.returncode != 0:
+                # surface the compiler/linker output: an opaque import
+                # failure here makes EVERY Python entry point undiagnosable
+                raise RuntimeError(
+                    f"native build failed ({' '.join(cmd[:2])}, "
+                    f"rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+    return so
 
 
 def _load() -> ctypes.CDLL:
-    for path in _candidate_paths():
-        if path.exists():
-            return ctypes.CDLL(str(path))
-    return ctypes.CDLL(str(_build_native()))
+    import fcntl
+    # Shared lock around the exists-check + dlopen: a concurrent rebuild
+    # relinks the .so non-atomically, and CDLL on the half-written file
+    # fails with an invalid-ELF OSError.  Held only while loading; released
+    # before _build_native takes its exclusive lock (flock via a second fd
+    # in the same process would otherwise self-deadlock).
+    with _lock_handle() as lock:
+        fcntl.flock(lock, fcntl.LOCK_SH)
+        for path in _candidate_paths():
+            if path.exists():
+                return ctypes.CDLL(str(path))
+    so = _build_native()
+    with _lock_handle() as lock:
+        fcntl.flock(lock, fcntl.LOCK_SH)
+        return ctypes.CDLL(str(so))
 
 
 _LIB = _load()

@@ -104,4 +104,7 @@ class Parser:
         self.close()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: module globals may be gone

@@ -68,7 +68,10 @@ class InputSplit:
         self.close()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: module globals may be gone
 
 
 class RecordIOWriter:
@@ -128,7 +131,10 @@ class RecordIOReader:
         self.close()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: module globals may be gone
 
 
 class FileInfo(NamedTuple):

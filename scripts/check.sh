@@ -18,15 +18,27 @@ cd "$(dirname "$0")/.."
 FULL=0
 [[ "${1:-}" == "--full" ]] && FULL=1
 
+# Build under the same lock _native.py's on-demand build takes: two
+# concurrent `cmake -B` configures of one tree corrupt each other's
+# CMakeFiles/ and both fail (seen: gate racing bench.py's device child).
+mkdir -p build
+exec 9>build/.dmlctpu_build_lock
+flock 9
 cmake -S . -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
 ninja -C build >/dev/null
 
+# Keep the exclusive lock through the native-suite loop: a concurrent
+# rebuilder relinking ./build/test_* while we execute them means ETXTBSY
+# or mixed old/new binaries.  MUST release before pytest — _native.py's
+# loader takes a shared lock on this file from child processes, which
+# would deadlock against our held exclusive one.
 for t in test_core test_runtime test_data test_endian test_input_split test_remote_fs; do
   if ! ./build/"$t" >/tmp/dmlctpu_check_$t.log 2>&1; then
     echo "check.sh: NATIVE SUITE FAILED: $t (log: /tmp/dmlctpu_check_$t.log)" >&2
     exit 1
   fi
 done
+flock -u 9
 
 if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   if [[ "$FULL" == "1" ]]; then
