@@ -50,13 +50,25 @@ def _seg_kernel(row_id_ref, contrib_ref, out_ref):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    # rows covered by this out tile, absolute ids
-    rows = rt * _ROW_TILE + jax.lax.broadcasted_iota(jnp.int32, (1, _ROW_TILE), 1)
+    # All shapes stay 2-D: squeezing basic indexing like rid[0, :, None]
+    # lowers to a gather Mosaic rejects on real TPU ("Shape mismatch in
+    # input, indices and output"); reshape+broadcast lowers cleanly.
+    # rows[n, r] = absolute row id of out-tile column r
+    rows = rt * _ROW_TILE + jax.lax.broadcasted_iota(
+        jnp.int32, (_NNZ_TILE, _ROW_TILE), 1)
     rid = row_id_ref[...]          # [1, NNZ_TILE] int32
     contrib = contrib_ref[...]     # [L, NNZ_TILE] f32 (L lanes)
-    onehot = (rid[0, :, None] == rows[0, None, :]).astype(jnp.float32)
-    # [L, NNZ] @ [NNZ, ROWS] -> [L, ROWS]; accumulate across nnz steps
-    out_ref[...] += jnp.dot(contrib, onehot, preferred_element_type=jnp.float32)
+    rid_col = jnp.broadcast_to(rid.reshape(_NNZ_TILE, 1),
+                               (_NNZ_TILE, _ROW_TILE))
+    onehot = (rid_col == rows).astype(jnp.float32)
+    # [L, NNZ] @ [NNZ, ROWS] -> [L, ROWS]; accumulate across nnz steps.
+    # HIGHEST keeps contrib in f32 on the MXU — DEFAULT rounds the operand
+    # through bf16 (~1e-2 abs error on N(0,1) data), breaking the
+    # documented f32-accumulation contract and the gradients that flow
+    # through the custom VJP below.
+    out_ref[...] += jnp.dot(contrib, onehot,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
 
 
 @functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
@@ -124,24 +136,70 @@ def _segment_sum_bwd(num_segments, interpret, res, g):
 _segment_sum_pallas_diff.defvjp(_segment_sum_fwd, _segment_sum_bwd)
 
 
-def _hist_kernel(num_bins: int, seg_tile: int,
+_KEY_TILE = 512    # (feature, bin) key lanes per out tile
+
+
+def _hist_kernel(nb: int, fpt: int, q: int, n_pad: int,
                  bins_ref, rel_ref, gh_ref, out_ref):
-    st = pl.program_id(1)
-    rt = pl.program_id(2)
+    """One (key-tile, row-tile) step of the histogram-as-matmul:
+
+        out[(lane, node), (feature, bin)] += A^T B
+        A[row, (lane, node)] = gh[lane, row] * [rel[row] == node]
+        B[row, (feature, bin)] = [bins[feature, row] == bin]
+
+    The M axis is (2 lanes x n_pad nodes) — wide enough to feed the MXU
+    (the naive per-feature formulation had M=2, so every matmul paid for
+    128 rows and used 2).  B's one-hot build is the only compare work:
+    O(rows * F * num_bins) instead of O(rows * F * num_bins * n_nodes).
+    Everything stays 2-D (squeezing indexing lowers to a Mosaic-rejected
+    gather) and feature rows are read via dynamic *ref* loads
+    (lax.dynamic_slice on a loaded array is unimplemented in Mosaic)."""
+    kt = pl.program_id(0)
+    rt = pl.program_id(1)
 
     @pl.when(rt == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    # per-(node, bin) key of every row for THIS feature (grid dim 0 picks
-    # the bins_t row); padding rows carry gh == 0 so collisions are inert
-    keys = rel_ref[0] * num_bins + bins_ref[0]          # [ROW_TILE] int32
-    segs = st * seg_tile + jax.lax.broadcasted_iota(
-        jnp.int32, (1, seg_tile), 1)                    # [1, SEG_TILE]
-    onehot = (keys[:, None] == segs).astype(jnp.float32)
-    # [2, ROW] @ [ROW, SEG] -> [2, SEG]; accumulate across row tiles
-    out_ref[0] += jnp.dot(gh_ref[...], onehot,
-                          preferred_element_type=jnp.float32)
+    # A: [ROW, 2*n_pad] node-masked (grad, hess).  Padding rows carry
+    # rel == n_pad (matches no node column) AND gh == 0, so they are inert.
+    node_ids = jax.lax.broadcasted_iota(jnp.int32, (_ROW_TILE, n_pad), 1)
+    rel_col = jnp.broadcast_to(rel_ref[...].reshape(_ROW_TILE, 1),
+                               (_ROW_TILE, n_pad))
+    mask = (rel_col == node_ids).astype(jnp.float32)
+    g_col = jnp.broadcast_to(gh_ref[0:1, :].reshape(_ROW_TILE, 1),
+                             (_ROW_TILE, n_pad))
+    h_col = jnp.broadcast_to(gh_ref[1:2, :].reshape(_ROW_TILE, 1),
+                             (_ROW_TILE, n_pad))
+    a = jnp.concatenate([mask * g_col, mask * h_col], axis=1)
+    # B: [ROW, KEY_TILE] one-hot of this tile's (feature, bin) keys
+    loc = jax.lax.broadcasted_iota(jnp.int32, (_ROW_TILE, _KEY_TILE), 1)
+    b = jnp.zeros((_ROW_TILE, _KEY_TILE), jnp.float32)
+    # bins_ref holds an 8-feature block (see in_specs); the rows this tile
+    # needs are at dynamic offsets *within* the block, hence the pl.ds ref
+    # loads (lax.dynamic_slice on a loaded array is unimplemented, and an
+    # (fpt, ROW) block would break the mult-of-8-or-full tiling rule).
+    if q == 1:
+        # nb <= KEY_TILE: tile kt covers fpt whole features; fpt divides 8,
+        # so all of them live in this 8-feature block
+        base = (kt * fpt) % 8
+        for fl in range(fpt):
+            bf = bins_ref[pl.ds(base + fl, 1), :]       # [1, ROW]
+            bcol = jnp.broadcast_to(bf.reshape(_ROW_TILE, 1),
+                                    (_ROW_TILE, _KEY_TILE))
+            b += (loc == bcol + fl * nb).astype(jnp.float32)
+    else:
+        # nb == q * KEY_TILE: tile kt is slice (kt % q) of feature kt // q
+        bf = bins_ref[pl.ds((kt // q) % 8, 1), :]
+        bcol = jnp.broadcast_to(bf.reshape(_ROW_TILE, 1),
+                                (_ROW_TILE, _KEY_TILE))
+        b += (loc == bcol - (kt % q) * _KEY_TILE).astype(jnp.float32)
+    # contract over rows; HIGHEST keeps f32 exactness on the MXU (DEFAULT
+    # rounds gh through bf16: measured 3.5e-2 abs error on N(0,1) grads)
+    out_ref[...] += jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
 
 
 @functools.partial(jax.jit,
@@ -152,30 +210,53 @@ def _histogram_gh_pallas(bins_t: jax.Array, rel: jax.Array, gh: jax.Array,
     """bins_t: [F, rows] int32; rel: [rows] int32 node ids; gh: [rows, 2].
     Returns [n_nodes, F, num_bins, 2]."""
     F, rows = bins_t.shape
-    seg = n_nodes * num_bins
+    # Keys tile in KEY_TILE lanes, so bins are laid out on a power-of-2
+    # stride >= num_bins: either several whole features per tile (fpt) or
+    # several tiles per feature (q).  Bin codes < num_bins never touch the
+    # padded lanes; they are sliced off below.
+    nb = 1 << max(num_bins - 1, 1).bit_length()   # next pow2 >= num_bins
+    # floor the stride so fpt <= 8: the per-tile feature loop is unrolled,
+    # and tiny num_bins would otherwise unroll KEY_TILE/nb (up to 256)
+    # compare bodies — measured to crash the TPU compiler outright
+    nb = max(nb, _KEY_TILE // 8)
+    if nb <= _KEY_TILE:
+        fpt, q = _KEY_TILE // nb, 1
+    else:
+        fpt, q = 1, nb // _KEY_TILE
     rows_pad = pl.cdiv(max(rows, 1), _ROW_TILE) * _ROW_TILE
-    seg_pad = pl.cdiv(seg, _NNZ_TILE // 2) * (_NNZ_TILE // 2)
-    seg_tile = _NNZ_TILE // 2
-    # zero-padded gh makes out-of-range / collided keys contribute nothing
-    bins_p = jnp.zeros((F, rows_pad), jnp.int32).at[:, :rows].set(bins_t)
-    rel_p = jnp.zeros((1, rows_pad), jnp.int32).at[0, :rows].set(rel)
+    k_pad = pl.cdiv(F * nb, _KEY_TILE) * _KEY_TILE
+    f_pad = k_pad // nb
+    # bins stream in 8-feature blocks (the smallest legal sublane tile), so
+    # each grid step fetches 8 rows of bins instead of all f_pad — the HBM
+    # traffic and VMEM block stay O(1) in F.  The kernel indexes inside the
+    # block with pl.ds; fpt | 8 guarantees a tile's features never straddle
+    # a block boundary.
+    f_pad8 = pl.cdiv(f_pad, 8) * 8
+    n_pad = pl.cdiv(n_nodes, 8) * 8
+    m_pad = 2 * n_pad
+    bins_p = jnp.zeros((f_pad8, rows_pad), jnp.int32).at[:F, :rows].set(bins_t)
+    rel_p = jnp.full((1, rows_pad), n_pad, jnp.int32).at[0, :rows].set(rel)
     gh_p = jnp.zeros((2, rows_pad), jnp.float32).at[:, :rows].set(
         gh.astype(jnp.float32).T)
+    if q == 1:
+        bins_index = lambda kt, rt: ((kt * fpt) // 8, rt)   # noqa: E731
+    else:
+        bins_index = lambda kt, rt: ((kt // q) // 8, rt)    # noqa: E731
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, num_bins, seg_tile),
-        grid=(F, seg_pad // seg_tile, rows_pad // _ROW_TILE),
+        functools.partial(_hist_kernel, nb, fpt, q, n_pad),
+        grid=(k_pad // _KEY_TILE, rows_pad // _ROW_TILE),
         in_specs=[
-            pl.BlockSpec((1, _ROW_TILE), lambda f, st, rt: (f, rt)),
-            pl.BlockSpec((1, _ROW_TILE), lambda f, st, rt: (0, rt)),
-            pl.BlockSpec((2, _ROW_TILE), lambda f, st, rt: (0, rt)),
+            pl.BlockSpec((8, _ROW_TILE), bins_index),
+            pl.BlockSpec((1, _ROW_TILE), lambda kt, rt: (0, rt)),
+            pl.BlockSpec((2, _ROW_TILE), lambda kt, rt: (0, rt)),
         ],
-        out_specs=pl.BlockSpec((1, 2, seg_tile), lambda f, st, rt: (f, 0, st)),
-        out_shape=jax.ShapeDtypeStruct((F, 2, seg_pad), jnp.float32),
+        out_specs=pl.BlockSpec((m_pad, _KEY_TILE), lambda kt, rt: (0, kt)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, k_pad), jnp.float32),
         interpret=interpret,
     )(bins_p, rel_p, gh_p)
-    return (out[:, :, :seg]
-            .reshape(F, 2, n_nodes, num_bins)
-            .transpose(2, 0, 3, 1))                     # [n, F, B, 2]
+    return (out.reshape(2, n_pad, f_pad, nb)
+            [:, :n_nodes, :F, :num_bins]
+            .transpose(1, 2, 3, 0))                     # [n, F, B, 2]
 
 
 def histogram_gh(bins: jax.Array, rel: jax.Array, gh: jax.Array,
@@ -191,19 +272,19 @@ def histogram_gh(bins: jax.Array, rel: jax.Array, gh: jax.Array,
     scatter-add).  NOTE this path materializes a [rows, F] int32 key
     array and a [rows, F, 2] f32 broadcast per call — ~12*rows*F bytes
     of HBM traffic (Higgs-11M x 28 features: ~3.7 GB per level); it is
-    the right trade on CPU and for very deep levels.
+    the right trade on CPU.
 
-    "pallas" -> the dedicated TPU kernel above: grid over (feature,
-    segment-tile, row-tile), each step one-hot-compares a row tile's
-    keys for ONE feature against a segment tile and accumulates a
-    [2, SEG] matmul — scatter-free, nothing materialized at
-    [rows, F] granularity, and F-times less compare work than pushing
-    flattened [rows*F] keys through ``segment_sum`` (keys stay blocked
-    per feature, so each entry only meets its own feature's segments).
-    Wins while ``n_nodes * num_bins`` is modest (early/mid levels, the
-    bulk of wall-time at XGBoost-default depth 6); interpret mode
-    off-TPU.  Accumulates in f32; result cast back to gh's dtype so the
-    backends stay drop-in interchangeable.
+    "pallas" -> the histogram-as-matmul kernel above: per (key-tile,
+    row-tile) step it builds A = node-masked (grad, hess) [ROW, 2*nodes]
+    and B = bin one-hot [ROW, KEY_TILE] and contracts over rows on the
+    MXU at f32 (HIGHEST) precision — scatter-free, nothing materialized
+    at [rows, F] granularity, compare work O(rows*F*bins) independent of
+    n_nodes, and an M axis wide enough to use the systolic array.
+    Measured on TPU v5e (rows=100k, F=28, 256 bins) vs the XLA path:
+    2.2x at n_nodes=1, 3.6x at 32, 8.2x at 64, 2.6x at 512; max abs
+    err vs scatter-add <= 4e-6 (accumulation order only), so the
+    backends stay drop-in interchangeable.  Interpret mode off-TPU is a
+    correctness tool, not an execution path.
     """
     check_force(force, "histogram backend")
     if force == "pallas":

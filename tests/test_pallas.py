@@ -86,6 +86,33 @@ def test_histogram_gh_matches_xla():
                                    rtol=1e-5, atol=1e-5)
 
 
+def test_histogram_gh_wide_and_narrow_bins_match_xla():
+    """The kernel's key-tiling branches beyond the GBDT-default shapes:
+    num_bins > KEY_TILE=512 routes a feature across several key tiles
+    (the q>1 branch — kt//q feature select, kt%q in-feature slice), and
+    tiny num_bins engages the fpt<=8 unroll clamp (effective stride
+    KEY_TILE/8 with most lanes padded).  Neither is reachable from
+    GBDT/QuantileBinner (bins <= 256), so they are pinned here on the
+    op's public surface."""
+    rng = np.random.default_rng(11)
+    for rows, F, B, n_nodes in [
+            (300, 3, 1024, 4),    # q=2: feature spans two key tiles
+            (120, 2, 2048, 2),    # q=4
+            (100, 5, 600, 3),     # non-pow2 > 512 -> nb=1024, q=2
+            (90, 4, 2, 2),        # fpt clamp: nb floors at 64
+            (150, 9, 3, 5),       # non-pow2 tiny bins through the clamp
+    ]:
+        bins = jnp.asarray(rng.integers(0, B, (rows, F)).astype(np.int32))
+        rel = jnp.asarray(rng.integers(0, n_nodes, rows).astype(np.int32))
+        gh = jnp.asarray(rng.standard_normal((rows, 2)).astype(np.float32))
+        want = histogram_gh(bins, rel, gh, n_nodes, B)                # xla
+        got = histogram_gh(bins, rel, gh, n_nodes, B, force="pallas")
+        assert got.shape == (n_nodes, F, B, 2)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
+            err_msg=f"rows={rows} F={F} B={B} n={n_nodes}")
+
+
 def test_csr_ops_pallas_backend_matches_xla():
     """The linear/FM hot ops (Row::SDot reductions) accept force="pallas"
     and match their XLA scatter-add results — the same backend choice the
