@@ -1,17 +1,21 @@
-// dmlctpu/lockfree_queue.h — bounded lock-free MPMC queue.
+// dmlctpu/lockfree_queue.h — lock-free MPMC queues, bounded and unbounded.
 // Inventory parity: the reference vendors moodycamel::ConcurrentQueue /
 // BlockingConcurrentQueue (4.7k LoC of third-party code) for lock-free
-// producer/consumer traffic.  This build provides its own implementation of
-// the classic Vyukov bounded MPMC ring: per-slot sequence numbers, single
-// CAS per operation, no spurious failures, FIFO per producer.  A blocking
-// adapter adds futex-free waiting via condvars for the uncontended-sleep
-// case.
+// producer/consumer traffic.  This build provides its own implementations
+// of both contract shapes: a classic Vyukov bounded MPMC ring (per-slot
+// sequence numbers, single CAS per operation, no spurious failures, FIFO
+// per producer — producers backpressure when full, which is what the
+// prefetch pipelines here want) and a segmented unbounded MPMC queue
+// (producers never block on capacity — moodycamel's growth contract).
+// Blocking adapters add condvar waiting for the uncontended-sleep case.
 #ifndef DMLCTPU_LOCKFREE_QUEUE_H_
 #define DMLCTPU_LOCKFREE_QUEUE_H_
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstddef>
+#include <memory>
 #include <mutex>
 #include <utility>
 #include <vector>
@@ -103,6 +107,176 @@ class LockFreeQueue {
 };
 
 /*!
+ * \brief unbounded MPMC FIFO — the moodycamel-growth side of the contract
+ *        (reference vendors include/dmlc/concurrentqueue.h, whose producers
+ *        NEVER block on capacity; the bounded ring above deliberately
+ *        backpressures instead).
+ *
+ * Design: a linked list of single-use segments.  Within a segment every
+ * claim is a plain atomic fetch-add/CAS (producers reserve a slot index,
+ * consumers claim ready slots).  Each op additionally snapshots its side's
+ * segment pointer under a short per-side mutex (critical section = one
+ * shared_ptr copy, bounded work) — so this is NOT a lock-free algorithm
+ * like the Vyukov ring above: it trades a cheap per-op mutex for the
+ * unbounded-growth contract and simple, provable segment reclamation.
+ * Producers and consumers take different mutexes (tail_mu_/head_mu_), so
+ * in steady state the two sides never contend with each other, and
+ * neither side ever *waits* for the other: Push never blocks on capacity,
+ * TryPop never blocks at all.  Drained segments are freed as soon as the
+ * last consumer drops them (memory returns during the queue's lifetime,
+ * which moodycamel's recycled blocks do not).
+ *
+ * Semantics vs LockFreeQueue: TryPop may return false while a concurrent
+ * Push is mid-write or mid-segment-link ("spurious empty" — same weak
+ * guarantee moodycamel's try_dequeue gives); total FIFO per producer is
+ * preserved across segments because reserve order equals slot order.
+ */
+template <typename T>
+class UnboundedQueue {
+ public:
+  explicit UnboundedQueue(size_t segment_capacity = 1024)
+      : seg_cap_(segment_capacity < 2 ? 2 : segment_capacity) {
+    head_ = tail_ = std::make_shared<Segment>(seg_cap_);
+  }
+
+  ~UnboundedQueue() {
+    // unlink iteratively: a long shared_ptr chain would otherwise recurse
+    for (std::shared_ptr<Segment> s = std::move(head_); s;) {
+      std::shared_ptr<Segment> next = std::move(s->next);
+      s.reset();
+      s = std::move(next);
+    }
+  }
+
+  /*! \brief enqueue; never blocks on capacity (grows instead) */
+  void Push(T value) {
+    while (true) {
+      std::shared_ptr<Segment> seg = SnapshotTail();
+      size_t idx = seg->reserve.fetch_add(1, std::memory_order_relaxed);
+      if (idx < seg_cap_) {
+        Slot& s = seg->slots[idx];
+        s.value = std::move(value);
+        s.state.store(kReady, std::memory_order_release);
+        pushed_.fetch_add(1, std::memory_order_relaxed);
+        return;
+      }
+      AdvanceTail(seg);  // segment exhausted: link the next one, retry
+    }
+  }
+
+  /*! \brief false when empty (or while the only pending push is mid-write) */
+  bool TryPop(T* out) {
+    while (true) {
+      std::shared_ptr<Segment> seg = SnapshotHead();
+      size_t pos = seg->pop.load(std::memory_order_relaxed);
+      while (pos < seg_cap_) {
+        Slot& s = seg->slots[pos];
+        int st = s.state.load(std::memory_order_acquire);
+        if (st == kTaken) {
+          // stale cursor: another consumer already claimed this slot, so
+          // the real `pop` is past pos — reload and keep scanning rather
+          // than mis-reporting empty
+          pos = seg->pop.load(std::memory_order_relaxed);
+          continue;
+        }
+        if (st != kReady) {
+          // kEmpty: nothing produced here yet, or a producer holds the
+          // slot mid-write.  Every idx < seg_cap_ below `reserve` WILL
+          // be written, so mid-write shows as a spurious empty, never a
+          // lost item.
+          return false;
+        }
+        if (seg->pop.compare_exchange_weak(pos, pos + 1,
+                                           std::memory_order_acq_rel)) {
+          *out = std::move(s.value);
+          s.state.store(kTaken, std::memory_order_release);
+          popped_.fetch_add(1, std::memory_order_relaxed);
+          return true;
+        }
+        // CAS failure reloaded pos: another consumer claimed this slot
+      }
+      if (!AdvanceHead(seg)) return false;  // fully drained, no next yet
+    }
+  }
+
+  /*! \brief approximate total size (racy by nature); O(1), lock-free */
+  size_t SizeApprox() const {
+    size_t pushed = pushed_.load(std::memory_order_relaxed);
+    size_t popped = popped_.load(std::memory_order_relaxed);
+    return pushed > popped ? pushed - popped : 0;
+  }
+
+  size_t segment_capacity() const { return seg_cap_; }
+
+ private:
+  static constexpr int kEmpty = 0, kReady = 1, kTaken = 2;
+  struct Slot {
+    std::atomic<int> state{kEmpty};
+    T value{};
+  };
+  static constexpr size_t kCacheLine = 64;
+  struct Segment {
+    explicit Segment(size_t cap) : slots(cap) {}
+    std::vector<Slot> slots;
+    // producer and consumer cursors on separate cache lines: a producer
+    // fetch_add must not invalidate the line consumers CAS on
+    alignas(kCacheLine) std::atomic<size_t> reserve{0};  // may overshoot
+    alignas(kCacheLine) std::atomic<size_t> pop{0};
+    std::shared_ptr<Segment> next;  // guarded by tail_mu_
+  };
+
+  std::shared_ptr<Segment> SnapshotTail() const {
+    std::lock_guard<std::mutex> lk(tail_mu_);
+    return tail_;
+  }
+  std::shared_ptr<Segment> SnapshotHead() const {
+    std::lock_guard<std::mutex> lk(head_mu_);
+    return head_;
+  }
+  void AdvanceTail(const std::shared_ptr<Segment>& seen) {
+    std::lock_guard<std::mutex> lk(tail_mu_);
+    if (tail_ == seen) {  // first overshooter links; the rest just retry
+      if (!seen->next) seen->next = std::make_shared<Segment>(seg_cap_);
+      tail_ = seen->next;
+    }
+  }
+  // true when the caller should retry on a newer head; false = queue empty
+  // beyond drained segments.  Never holds both mutexes at once (the link
+  // is copied under tail_mu_, the head swap happens under head_mu_), so
+  // there is no lock-order constraint anywhere in the class.
+  bool AdvanceHead(const std::shared_ptr<Segment>& seen) {
+    // Lock-free empty probe first: a next segment exists only if some
+    // producer overshot this one (fetch_add past seg_cap_ precedes every
+    // AdvanceTail link), so reserve <= seg_cap_ proves nothing lies
+    // beyond — idle consumers polling a drained queue never touch the
+    // producers' tail_mu_.  A push racing past this load shows as a
+    // spurious empty, same as the mid-write caveat.
+    if (seen->reserve.load(std::memory_order_acquire) <= seg_cap_) {
+      return false;
+    }
+    std::shared_ptr<Segment> next;
+    {
+      std::lock_guard<std::mutex> lk(tail_mu_);
+      next = seen->next;
+    }
+    std::lock_guard<std::mutex> lk(head_mu_);
+    if (head_ != seen) return true;  // someone already advanced
+    if (!next) return false;  // nothing beyond (a racing link shows as a
+                              // spurious empty; the next TryPop sees it)
+    head_ = next;  // drops a ref; segment frees once consumers drop theirs
+    return true;
+  }
+
+  const size_t seg_cap_;
+  mutable std::mutex tail_mu_;  // guards tail_ and every Segment::next
+  mutable std::mutex head_mu_;  // guards head_
+  std::shared_ptr<Segment> head_;
+  std::shared_ptr<Segment> tail_;
+  alignas(kCacheLine) std::atomic<size_t> pushed_{0};
+  alignas(kCacheLine) std::atomic<size_t> popped_{0};
+};
+
+/*!
  * \brief blocking facade: lock-free fast path, condvar sleep when empty/full
  *        (parity surface with moodycamel::BlockingConcurrentQueue).
  */
@@ -149,6 +323,48 @@ class BlockingLockFreeQueue {
   LockFreeQueue<T> q_;
   std::mutex mu_;
   std::condition_variable not_empty_, not_full_;
+  std::atomic<bool> killed_{false};
+};
+
+/*!
+ * \brief blocking facade over UnboundedQueue: Push never waits (growth
+ *        instead of backpressure — the moodycamel::BlockingConcurrentQueue
+ *        shape); Pop sleeps on a condvar until an item or SignalForKill.
+ */
+template <typename T>
+class UnboundedBlockingQueue {
+ public:
+  explicit UnboundedBlockingQueue(size_t segment_capacity = 1024)
+      : q_(segment_capacity) {}
+
+  void Push(T value) {
+    q_.Push(std::move(value));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+    }
+    not_empty_.notify_one();
+  }
+  /*! \brief blocking pop; false once killed and drained */
+  bool Pop(T* out) {
+    while (true) {
+      if (q_.TryPop(out)) return true;
+      std::unique_lock<std::mutex> lk(mu_);
+      if (killed_.load(std::memory_order_acquire)) {
+        return q_.TryPop(out);  // drain race: one last attempt
+      }
+      not_empty_.wait_for(lk, std::chrono::milliseconds(1));
+    }
+  }
+  void SignalForKill() {
+    killed_.store(true, std::memory_order_release);
+    not_empty_.notify_all();
+  }
+  size_t SizeApprox() const { return q_.SizeApprox(); }
+
+ private:
+  UnboundedQueue<T> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_;
   std::atomic<bool> killed_{false};
 };
 

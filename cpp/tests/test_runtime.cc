@@ -156,6 +156,79 @@ TESTCASE(blocking_lockfree_queue_kill) {
   EXPECT_EQV(got.load(), 100);
 }
 
+TESTCASE(unbounded_queue_growth_and_order) {
+  // tiny segments force many segment hops; producers must NEVER see "full"
+  UnboundedQueue<int> q(4);
+  for (int i = 0; i < 1000; ++i) q.Push(i);  // 250 segments deep
+  EXPECT_EQV(q.SizeApprox(), 1000u);
+  int v;
+  for (int i = 0; i < 1000; ++i) {
+    EXPECT_TRUE(q.TryPop(&v));
+    EXPECT_EQV(v, i);  // FIFO across segment boundaries
+  }
+  EXPECT_TRUE(!q.TryPop(&v));  // empty
+  EXPECT_EQV(q.SizeApprox(), 0u);
+}
+
+TESTCASE(unbounded_queue_mpmc_stress) {
+  UnboundedQueue<int> q(64);  // small segments: stress the hop paths
+  constexpr int kProducers = 4, kConsumers = 4, kPerProducer = 20000;
+  std::atomic<long> sum{0};
+  std::atomic<int> popped{0};
+  std::vector<std::thread> threads;
+  for (int p = 0; p < kProducers; ++p) {
+    threads.emplace_back([&q, p] {
+      for (int i = 0; i < kPerProducer; ++i) {
+        q.Push(p * kPerProducer + i);  // no retry loop: push cannot fail
+      }
+    });
+  }
+  for (int c = 0; c < kConsumers; ++c) {
+    threads.emplace_back([&] {
+      int v;
+      while (popped.load() < kProducers * kPerProducer) {
+        if (q.TryPop(&v)) {
+          sum += v;
+          ++popped;
+        } else {
+          std::this_thread::yield();
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  long n = static_cast<long>(kProducers) * kPerProducer;
+  EXPECT_EQV(sum.load(), n * (n - 1) / 2);
+}
+
+TESTCASE(unbounded_queue_reclaims_drained_segments) {
+  // drained segments must be freed during the queue's lifetime (the
+  // growth must not be a leak): track live payloads via shared_ptr count
+  auto token = std::make_shared<int>(7);
+  UnboundedQueue<std::shared_ptr<int>> q(4);
+  for (int i = 0; i < 400; ++i) q.Push(token);
+  EXPECT_EQV(static_cast<int>(token.use_count()), 401);
+  std::shared_ptr<int> out;
+  for (int i = 0; i < 400; ++i) EXPECT_TRUE(q.TryPop(&out));
+  out.reset();
+  // all payload copies released even though the queue object still lives
+  EXPECT_EQV(static_cast<int>(token.use_count()), 1);
+}
+
+TESTCASE(unbounded_blocking_queue_kill) {
+  UnboundedBlockingQueue<int> q(16);
+  std::atomic<int> got{0};
+  std::thread consumer([&] {
+    int v;
+    while (q.Pop(&v)) ++got;
+  });
+  for (int i = 0; i < 500; ++i) q.Push(i);  // 30+ segments, no backpressure
+  while (got.load() < 500) std::this_thread::yield();
+  q.SignalForKill();
+  consumer.join();
+  EXPECT_EQV(got.load(), 500);
+}
+
 TESTCASE(memory_pool_reuse) {
   struct Obj {
     double payload[4];

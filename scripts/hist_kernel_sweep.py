@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Standalone histogram-kernel sweep: pallas histogram-as-matmul vs XLA
+scatter-add across the per-level node counts a depth-wise GBDT actually
+sees (n_nodes = 1..512), on whatever backend is live.
+
+Produces the `hist_kernel_ab` entry of TPU_OBSERVED.json (the ad-hoc
+2026-07-31 01:45 window sweep, now reproducible).  Run with a real TPU
+attached for meaningful numbers; off-TPU the pallas path is interpret
+mode and the script refuses unless --allow-interpret.
+
+Usage: python scripts/hist_kernel_sweep.py [--rows 100000] [--features 28]
+           [--bins 256] [--update-observed]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--bins", type=int, default=256)
+    ap.add_argument("--nodes", type=int, nargs="*",
+                    default=[1, 4, 32, 64, 128, 256, 512])
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--allow-interpret", action="store_true")
+    ap.add_argument("--update-observed", action="store_true",
+                    help="fold the result into TPU_OBSERVED.json")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from dmlc_core_tpu.ops.pallas_segment import histogram_gh
+
+    platform = jax.default_backend()
+    if platform != "tpu" and not args.allow_interpret:
+        raise SystemExit(f"backend is {platform!r}, not tpu; interpret-mode "
+                         "timings are meaningless (--allow-interpret to "
+                         "force a correctness-only run)")
+
+    rng = np.random.default_rng(7)
+    bins = jnp.asarray(rng.integers(0, args.bins,
+                                    (args.rows, args.features)).astype(np.int32))
+    gh = jnp.asarray(rng.standard_normal((args.rows, 2)).astype(np.float32))
+
+    ms_per_call: dict[str, dict[str, float]] = {}
+    max_err = 0.0
+    for n in args.nodes:
+        rel = jnp.asarray(rng.integers(0, n, args.rows).astype(np.int32))
+        outs = {}
+        row = {}
+        for impl in ("pallas", "xla"):
+            fn = jax.jit(lambda b, r, g, impl=impl, n=n: histogram_gh(
+                b, r, g, n, args.bins, force=impl))
+            outs[impl] = jax.block_until_ready(fn(bins, rel, gh))  # warmup
+            t0 = time.monotonic()
+            for _ in range(args.iters):
+                out = fn(bins, rel, gh)
+            jax.block_until_ready(out)
+            row[impl] = round((time.monotonic() - t0) / args.iters * 1e3, 2)
+        err = float(jnp.max(jnp.abs(outs["pallas"] - outs["xla"])))
+        max_err = max(max_err, err)
+        ms_per_call[f"n{n}"] = row
+        print(f"n_nodes={n:4d}  pallas {row['pallas']:8.2f} ms  "
+              f"xla {row['xla']:8.2f} ms  "
+              f"speedup {row['xla'] / max(row['pallas'], 1e-9):.1f}x  "
+              f"max_abs_err {err:.2e}", flush=True)
+
+    entry = {
+        "platform": platform,
+        "ts": datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "method": (f"scripts/hist_kernel_sweep.py: histogram_gh pallas "
+                   f"(histogram-as-matmul, HIGHEST) vs xla scatter-add; "
+                   f"rows={args.rows} F={args.features} bins={args.bins}, "
+                   f"{args.iters} iters/point, jit-wrapped"),
+        "ms_per_call": ms_per_call,
+        "max_abs_err": round(max_err, 7),
+    }
+    print(json.dumps(entry))
+
+    if args.update_observed and platform == "tpu":
+        path = REPO / "TPU_OBSERVED.json"
+        obs = json.loads(path.read_text()) if path.exists() else {}
+        obs["hist_kernel_ab"] = entry
+        path.write_text(json.dumps(obs, indent=1) + "\n")
+        print(f"[sweep] updated {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Round-5 tunnel-window watcher: every INTERVAL seconds, try the
+# standalone histogram-kernel sweep against the TPU backend.  If the
+# tunnel is down the first jax call hangs and `timeout` reaps it (each
+# attempt is also visible in the probe daemon's JSONL).  On the first
+# success the sweep itself rewrites TPU_OBSERVED.json's hist_kernel_ab
+# entry with live post-refactor numbers and the watcher exits.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+INTERVAL="${1:-900}"
+LOG="${TMPDIR:-/tmp}/tpu_window_watcher.log"
+while :; do
+  echo "[watcher] $(date -u +%FT%TZ) attempting sweep" >> "$LOG"
+  if timeout 900 env PYTHONPATH="$REPO:/root/.axon_site" \
+      python "$REPO/scripts/hist_kernel_sweep.py" --update-observed \
+      >> "$LOG" 2>&1; then
+    echo "[watcher] $(date -u +%FT%TZ) sweep SUCCEEDED" >> "$LOG"
+    exit 0
+  else
+    rc=$?  # 124 = timeout reaped a hung backend init (tunnel down)
+    echo "[watcher] $(date -u +%FT%TZ) no window (rc=$rc)" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
