@@ -27,6 +27,7 @@ OUT = REPO / "doc" / "_site"
 # corpus: (source path, output name, nav title); nav order is this order
 PAGES = [
     (REPO / "doc" / "index.md", "index.html", "Overview"),
+    (REPO / "doc" / "migration.md", "migration.html", "Migration map"),
     (REPO / "doc" / "parameter.md", "parameter.html", "Parameters"),
     (REPO / "doc" / "io.md", "io.html", "IO & filesystems"),
     (REPO / "doc" / "data.md", "data.html", "Data & staging"),
