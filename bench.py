@@ -768,6 +768,45 @@ def real_allreduce():
     return out
 phase("allreduce", real_allreduce)
 phase("models", bench.run_models)
+
+def gbdt_mesh():
+    # sharded-kernel route (histogram_mesh): only meaningful with >=2 real
+    # TPU devices — each chip builds its row shard's histogram with the
+    # Pallas kernel under shard_map, explicit psum over ICI.  Skips on this
+    # one-chip rig; auto-runs (xla vs pallas row-trees/s) when a real
+    # multi-chip mesh appears.  Parity is pinned off-hardware by
+    # tests/test_gbdt.py::test_sharded_pallas_fit_matches_xla_fit.
+    import numpy as np
+    import time
+    devices = jax.devices()
+    if len(devices) < 2 or devices[0].platform != "tpu":
+        return {"skipped": f"{len(devices)} {devices[0].platform} device(s)",
+                "platform": devices[0].platform}
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from dmlc_core_tpu.models import GBDT, QuantileBinner
+    rng = np.random.default_rng(5)
+    rows, F = 100_000 // len(devices) * len(devices), 28
+    x = rng.standard_normal((rows, F)).astype(np.float32)
+    y = (rng.random(rows) < 0.5).astype(np.float32)
+    bins_host = np.asarray(QuantileBinner(num_bins=256).fit_transform(x))
+    mesh = Mesh(np.asarray(devices), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    bins_d = jax.device_put(bins_host, sh)
+    y_d = jax.device_put(y, sh)
+    out = {"rows": rows, "devices": len(devices), "platform": "tpu"}
+    for impl, kw in (("xla", {"histogram": "xla"}),
+                     ("pallas", {"histogram": "pallas",
+                                 "histogram_mesh": (mesh, "data")})):
+        m = GBDT(num_features=F, num_trees=5, max_depth=6, num_bins=256,
+                 learning_rate=0.4, **kw)
+        jax.block_until_ready(m.fit(bins_d, y_d)["leaf"])  # warmup/compile
+        t0 = time.monotonic()
+        p = m.fit(bins_d, y_d)
+        jax.block_until_ready(p["leaf"])
+        out[f"row_trees_s_{impl}"] = round(
+            rows * m.num_trees / (time.monotonic() - t0))
+    return out
+phase("gbdt_mesh", gbdt_mesh)
 phase("gbdt", bench.run_gbdt)
 """
 
@@ -996,6 +1035,7 @@ def main() -> None:
         "gbdt_sparse_row_trees_per_sec": phases.get("gbdt", {}).get(
             "sparse_row_trees_s"),
         "gbdt_platform": phases.get("gbdt", {}).get("platform"),
+        "gbdt_mesh": phases.get("gbdt_mesh"),
         "h2d_gbps_single_chip": phases.get("h2d", {}).get("gbps"),
         "h2d_platform": phases.get("h2d", {}).get("platform"),
         "pallas_segment": phases.get("pallas_segment"),

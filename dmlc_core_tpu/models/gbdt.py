@@ -328,7 +328,8 @@ class GBDT:
                  interaction_constraints=None,
                  base_score=None,
                  scale_pos_weight: float = 1.0,
-                 histogram: str = "auto"):
+                 histogram: str = "auto",
+                 histogram_mesh=None):
         if objective not in ("logistic", "squared", "softmax",
                              "rank:pairwise"):
             raise ValueError(f"unknown objective '{objective}'")
@@ -410,6 +411,24 @@ class GBDT:
         if histogram not in ("auto", "xla", "pallas"):
             raise ValueError("histogram must be 'auto', 'xla' or 'pallas'")
         self.histogram = histogram
+        # (jax.sharding.Mesh, axis_name): the explicit multi-device kernel
+        # route.  When set, levels whose backend resolves to "pallas" build
+        # the histogram via shard_map(local pallas kernel) + psum over the
+        # named axis instead of relying on GSPMD to partition segment_sum —
+        # pallas_call has no auto-partitioning rule, so this is the ONLY
+        # way the kernel can serve a row-sharded fit.  fit() inputs must be
+        # sharded over that axis, and shard_map's even-sharding rule
+        # applies: rows must divide by the axis size (the GSPMD/XLA route
+        # tolerates uneven rows; staged PaddedBatch pipelines sized to
+        # the mesh satisfy this by construction).  Tests pin
+        # interpret-mode parity on the 8-device CPU mesh;
+        # tests/test_pallas.py proves the route itself.
+        if histogram_mesh is not None:
+            mesh, axis = histogram_mesh  # unpack early: fail loudly
+            if axis not in mesh.axis_names:
+                raise ValueError(f"histogram_mesh axis {axis!r} not in "
+                                 f"mesh axes {mesh.axis_names}")
+        self.histogram_mesh = histogram_mesh
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -433,21 +452,71 @@ class GBDT:
         SINGLE-device TPU inside its measured-win envelope (it beat XLA
         scatter-add at every measured level, 2.2-8.2x — see
         ops.histogram_gh), XLA elsewhere.  Multi-device
-        meshes stay on XLA even on TPU: the sharded fit path relies on
+        meshes stay on XLA by default: the sharded fit path relies on
         ``segment_sum`` being GSPMD-partitionable so the compiler inserts
         the histogram psum (the rabit-allreduce analogue); ``pallas_call``
-        has no partitioning rule, so routing a row-sharded fit into it
-        would break (or silently replicate) that path.  (The supported
-        multi-device kernel route — explicit shard_map + psum — is proven
-        by tests/test_pallas.py's shardmap_psum case.)  Off-TPU pallas
-        interpret mode is a correctness tool, not an execution path."""
+        has no partitioning rule, so GSPMD cannot route a row-sharded fit
+        into the kernel.  The supported multi-device kernel route is the
+        explicit one: construct with ``histogram_mesh=(mesh, axis)`` and
+        ``_level_histogram`` runs the kernel per-device under shard_map
+        with an explicit psum (proven by tests/test_pallas.py's
+        shardmap_psum case; fit parity by test_gbdt.py's
+        sharded_pallas_fit case).  Off-TPU pallas interpret mode is a
+        correctness tool, not an execution path."""
         if self.histogram != "auto":
             return self.histogram
+        if self.histogram_mesh is not None:
+            # explicit shard_map route declared: multi-device no longer
+            # disqualifies the kernel — only backend and the measured
+            # node-limit envelope do
+            if (jax.default_backend() == "tpu"
+                    and n_nodes <= self._PALLAS_NODE_LIMIT):
+                return "pallas"
+            return "xla"
         if (jax.default_backend() == "tpu"
                 and jax.device_count() == 1
                 and n_nodes <= self._PALLAS_NODE_LIMIT):
             return "pallas"
         return "xla"
+
+    def _level_histogram(self, bins_i: jax.Array, rel: jax.Array,
+                         gh: jax.Array, n_nodes: int) -> jax.Array:
+        """Per-level [nodes, F, bins, 2] histogram with backend routing.
+
+        Plain ``histogram_gh`` call normally (GSPMD partitions the XLA
+        path and inserts the psum on sharded fits).  With
+        ``histogram_mesh=(mesh, axis)`` set and the level resolving to
+        the Pallas backend, the kernel runs per-device on local row
+        shards under ``jax.shard_map`` and the shards combine with an
+        explicit ``psum`` over the axis — the rabit histogram-allreduce
+        with the custom kernel on the device side (pattern proven by
+        tests/test_pallas.py::test_histogram_gh_shardmap_psum_matches_global).
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.collective import shard_map_compat
+
+        impl = self._hist_impl(n_nodes)
+        B = self.num_bins
+        if impl == "pallas" and self.histogram_mesh is not None:
+            mesh, axis = self.histogram_mesh
+
+            def local(b, r, g):
+                h = histogram_gh(b, r, g, n_nodes, B, force="pallas")
+                return jax.lax.psum(h, axis)
+
+            # replication check off: pallas_call's out_shape carries no
+            # varying-axes annotation, so the static check cannot see
+            # through it; the psum replicates the output regardless.
+            # NOTE shard_map's even-sharding rule: rows must divide by
+            # the mesh axis size (see the histogram_mesh ctor comment).
+            spec = P(axis)
+            return shard_map_compat(local, mesh,
+                                    in_specs=(spec, spec, spec),
+                                    out_specs=P(),
+                                    check_replication=False)(
+                                        bins_i, rel, gh)
+        return histogram_gh(bins_i, rel, gh, n_nodes, B, force=impl)
 
     # ---- forest construction ------------------------------------------------
 
@@ -910,8 +979,7 @@ class GBDT:
             # (scatter-free; see ops.histogram_gh for the layout and the
             # HBM-footprint contrast), XLA scatter-add otherwise.
             gh = jnp.stack([grad, hess], axis=-1)  # [rows, 2]
-            hist = histogram_gh(bins_i, rel, gh, n_nodes, B,
-                                force=self._hist_impl(n_nodes))
+            hist = self._level_histogram(bins_i, rel, gh, n_nodes)
             hist_g = hist[..., 0]
             hist_h = hist[..., 1]
             # left cumulative mass for "go right if bin > b" at each cut b

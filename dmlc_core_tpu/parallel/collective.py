@@ -27,6 +27,28 @@ _OPS: dict[str, Callable] = {
 }
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs,
+                     check_replication: bool = True):
+    """``jax.shard_map`` across jax versions: the stable location on
+    >= 0.8, the experimental fallback before.  With
+    ``check_replication=False`` the static replication check is disabled
+    under whichever flag this jax spells it (``check_vma`` stable /
+    ``check_rep`` experimental) — needed when an op's output replication
+    is real but not statically inferable (all_gather, pallas_call)."""
+    try:
+        from jax import shard_map  # jax >= 0.8 stable location
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+    kwargs = {}
+    if not check_replication:
+        import inspect
+        params = inspect.signature(shard_map).parameters
+        kwargs = {("check_vma" if "check_vma" in params
+                   else "check_rep"): False}
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kwargs)
+
+
 def allreduce(x: jax.Array, op: str = "sum", axis_name: str = "data") -> jax.Array:
     """All-reduce across a mesh axis; call inside shard_map/pmap-traced code."""
     try:
@@ -95,11 +117,6 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
     except KeyError:
         raise ValueError(
             f"unknown collective '{op}' (have {sorted(kernels)})") from None
-    try:
-        from jax import shard_map  # jax >= 0.8 stable location
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map
-
     n = mesh.devices.size
     nfloats = int(mib_per_device * (1 << 20) // 4)
     # reducescatter (tiled psum_scatter) needs the per-device count
@@ -109,17 +126,10 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
     # allgather: every device holds the FULL gathered array, so the global
     # result is replicated (out_specs P()); jax's static replication check
     # cannot infer all_gather output replication, so it is disabled for
-    # that op only (the other ops keep the check).  The flag is named
-    # check_vma on jax >= 0.8's stable shard_map and check_rep on the
-    # experimental fallback — pick whichever this jax has.
-    kwargs = {}
-    if op == "allgather":
-        import inspect
-        params = inspect.signature(shard_map).parameters
-        kwargs = {("check_vma" if "check_vma" in params
-                   else "check_rep"): False}
-    step = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"),
-                             out_specs=out_spec, **kwargs))
+    # that op only (the other ops keep the check)
+    step = jax.jit(shard_map_compat(fn, mesh, in_specs=P("data"),
+                                    out_specs=out_spec,
+                                    check_replication=(op != "allgather")))
     x = jax.device_put(
         np.random.default_rng(0).standard_normal((n * nfloats,),
                                                  dtype=np.float32),

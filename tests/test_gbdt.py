@@ -157,6 +157,48 @@ def test_sharded_fit_matches_single_device():
 
 
 @pytest.mark.slow
+def test_sharded_pallas_fit_matches_xla_fit():
+    """histogram_mesh=(mesh, 'data') + histogram='pallas': every level's
+    histogram runs the Pallas kernel per-device under shard_map with an
+    explicit psum (pallas_call has no GSPMD partitioning rule, so this is
+    the only way the kernel serves a row-sharded fit).  The forest must be
+    identical to the plain XLA scatter-add fit — interpret-mode kernel on
+    the 8-device CPU mesh, tiny shapes to keep interpret cost sane."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(11)
+    x = rng.uniform(-1, 1, size=(320, 3)).astype(np.float32)
+    y = ((x[:, 0] > 0.1) ^ (x[:, 2] > 0.4)).astype(np.float32)
+    bins_host = np.asarray(QuantileBinner(num_bins=8).fit_transform(x))
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    rows = NamedSharding(mesh, P("data"))
+    bins_sh = jax.device_put(bins_host, rows)
+    y_sh = jax.device_put(jnp.asarray(y), rows)
+
+    kw = dict(num_features=3, num_trees=2, max_depth=3, num_bins=8,
+              learning_rate=0.5, objective="logistic")
+    p_xla = GBDT(histogram="xla", **kw).fit(bins_sh, y_sh)
+    p_pal = GBDT(histogram="pallas", histogram_mesh=(mesh, "data"),
+                 **kw).fit(bins_sh, y_sh)
+
+    for k in ("feature", "threshold"):
+        np.testing.assert_array_equal(np.asarray(p_xla[k]),
+                                      np.asarray(p_pal[k]))
+    np.testing.assert_allclose(np.asarray(p_xla["leaf"]),
+                               np.asarray(p_pal["leaf"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_histogram_mesh_validates_axis():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    with pytest.raises(ValueError, match="histogram_mesh axis"):
+        GBDT(num_features=3, histogram_mesh=(mesh, "model"))
+
+
+@pytest.mark.slow
 def test_forest_checkpoint_roundtrip(tmp_path):
     """The forest pytree checkpoints through the RecordIO substrate."""
     from dmlc_core_tpu import checkpoint
