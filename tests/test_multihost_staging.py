@@ -274,7 +274,22 @@ def test_two_process_checkpoint_save(tmp_path):
     assert "CKPT_OK" in outs[0]
 
 
-_GBDT_CHILD = r"""
+# ONE source of truth for the 2-process GBDT tests: the global-dataset
+# recipe (exec'd by the in-parent reference, concatenated into both child
+# scripts) and the model hyperparameters (eval'd by the parent, pasted
+# into the children) — edits here reach all three fits, so the tests
+# cannot silently stop pinning the same forest.
+_GBDT_RECIPE = r"""
+halves = [np.random.default_rng(100 + p).uniform(-1, 1, (256, 4))
+          .astype(np.float32) for p in (0, 1)]
+x_all = np.concatenate(halves)
+y_all = ((x_all[:, 0] > 0) ^ (x_all[:, 1] * x_all[:, 2] > 0.1)).astype(np.float32)
+bins_all = np.asarray(QuantileBinner(num_bins=16).fit_transform(x_all))
+"""
+_GBDT_KW_SRC = ("dict(num_features=4, num_trees=2, max_depth=3, "
+                "num_bins=16, learning_rate=0.5)")
+
+_GBDT_CHILD_PRELUDE = r"""
 import json, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -287,21 +302,16 @@ from dmlc_core_tpu.models import GBDT, QuantileBinner
 
 # both processes deterministically regenerate the GLOBAL dataset, bin with
 # shared global cuts, then contribute only their half of the rows
-halves = [np.random.default_rng(100 + p).uniform(-1, 1, (256, 4))
-          .astype(np.float32) for p in (0, 1)]
-x_all = np.concatenate(halves)
-y_all = ((x_all[:, 0] > 0) ^ (x_all[:, 1] * x_all[:, 2] > 0.1)).astype(np.float32)
-bins_all = np.asarray(QuantileBinner(num_bins=16).fit_transform(x_all))
-
+""" + _GBDT_RECIPE + r"""
 mesh = Mesh(np.asarray(jax.devices()), ("data",))
 sharding = NamedSharding(mesh, P("data"))
 lo, hi = pid * 256, (pid + 1) * 256
 bins_g = jax.make_array_from_process_local_data(sharding, bins_all[lo:hi])
 label_g = jax.make_array_from_process_local_data(sharding, y_all[lo:hi])
+kw = """ + _GBDT_KW_SRC + "\n"
 
-model = GBDT(num_features=4, num_trees=2, max_depth=3, num_bins=16,
-             learning_rate=0.5)
-forest = model.fit(bins_g, label_g)
+_GBDT_CHILD = _GBDT_CHILD_PRELUDE + r"""
+forest = GBDT(**kw).fit(bins_g, label_g)
 print("RESULT " + json.dumps({
     "pid": pid,
     "feature": np.asarray(forest["feature"]).tolist(),
@@ -327,24 +337,58 @@ def test_two_process_gbdt_histogram_allreduce():
     assert ({k: v for k, v in results[0].items() if k != "pid"}
             == {k: v for k, v in results[1].items() if k != "pid"})
 
-    # single-process reference on the concatenated data
+    # single-process reference on the concatenated data — same recipe
+    # string the children embed, exec'd here
     from dmlc_core_tpu.models import GBDT, QuantileBinner
-    halves = [np.random.default_rng(100 + p).uniform(-1, 1, (256, 4))
-              .astype(np.float32) for p in (0, 1)]
-    x_all = np.concatenate(halves)
-    y_all = ((x_all[:, 0] > 0) ^ (x_all[:, 1] * x_all[:, 2] > 0.1)
-             ).astype(np.float32)
     import jax.numpy as jnp
-    bins_all = QuantileBinner(num_bins=16).fit_transform(x_all)
-    model = GBDT(num_features=4, num_trees=2, max_depth=3, num_bins=16,
-                 learning_rate=0.5)
-    ref = model.fit(bins_all, jnp.asarray(y_all))
+    ns = {"np": np, "QuantileBinner": QuantileBinner}
+    exec(_GBDT_RECIPE, ns)  # noqa: S102 — shared single-source recipe
+    ref = GBDT(**eval(_GBDT_KW_SRC)).fit(ns["bins_all"],
+                                         jnp.asarray(ns["y_all"]))
     assert results[0]["feature"] == np.asarray(ref["feature"]).tolist()
     assert results[0]["threshold"] == np.asarray(ref["threshold"]).tolist()
     np.testing.assert_allclose(np.asarray(results[0]["leaf"]),
                                np.asarray(ref["leaf"]), rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(results[0]["base"], float(ref["base"]),
                                atol=2e-6)
+
+
+# same prelude (dataset + sharded global arrays) as _GBDT_CHILD; here the
+# per-level histogram runs the Pallas kernel PER PROCESS-LOCAL DEVICE
+# under shard_map and the explicit psum crosses the process boundary over
+# Gloo — the sharded-kernel route (histogram_mesh) in a real multi-host
+# setting
+_GBDT_MESH_CHILD = _GBDT_CHILD_PRELUDE + r"""
+forest_x = GBDT(histogram="xla", **kw).fit(bins_g, label_g)
+forest_p = GBDT(histogram="pallas",
+                histogram_mesh=(mesh, "data"), **kw).fit(bins_g, label_g)
+match = (np.array_equal(np.asarray(forest_x["feature"]),
+                        np.asarray(forest_p["feature"]))
+         and np.array_equal(np.asarray(forest_x["threshold"]),
+                            np.asarray(forest_p["threshold"]))
+         and np.allclose(np.asarray(forest_x["leaf"]),
+                         np.asarray(forest_p["leaf"]),
+                         rtol=1e-3, atol=1e-4))
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "routes_match": bool(match),
+    "feature": np.asarray(forest_p["feature"]).tolist(),
+    "leaf": np.round(np.asarray(forest_p["leaf"]), 5).tolist()}), flush=True)
+"""
+
+
+def test_two_process_gbdt_histogram_mesh_kernel_route():
+    """The sharded-kernel route across a REAL process boundary: two
+    jax.distributed processes, each running the Pallas histogram kernel
+    (interpret mode on CPU) on its local row shard under shard_map, the
+    explicit psum riding Gloo — and the forest must equal the GSPMD/XLA
+    route's fit of the same global data, in-child, on both processes."""
+    results, _ = _run_two(_GBDT_MESH_CHILD, label="gbdt mesh process")
+    assert set(results) == {0, 1}
+    assert results[0]["routes_match"] and results[1]["routes_match"]
+    # both processes hold the identical replicated kernel-route forest
+    assert results[0]["feature"] == results[1]["feature"]
+    assert results[0]["leaf"] == results[1]["leaf"]
 
 
 _SPARSE_GBDT_CHILD = r"""
