@@ -56,6 +56,11 @@ def main() -> int:
     ap.add_argument("--batch-size", type=int, default=16384)
     ap.add_argument("--shard", action="store_true",
                     help="row-shard over all local devices (data parallel)")
+    ap.add_argument("--kernel-mesh", action="store_true",
+                    help="with --shard: run the Pallas histogram kernel "
+                         "per-device under shard_map + explicit psum "
+                         "(histogram_mesh) instead of the GSPMD scatter-add "
+                         "route; interpret-mode (slow) off-TPU")
     ap.add_argument("--missing", action="store_true",
                     help="sparsity-aware mode: absent libsvm features are "
                          "MISSING (NaN -> reserved bin, learned per-node "
@@ -225,9 +230,8 @@ def main() -> int:
     binner = QuantileBinner(num_bins=args.bins, missing_aware=args.missing)
     bins_host = np.asarray(binner.fit_transform(x))
 
-    model = GBDT(num_features=args.dim, num_trees=args.trees,
-                 max_depth=args.depth, num_bins=args.bins,
-                 learning_rate=0.4, missing_aware=args.missing)
+    if args.kernel_mesh and not args.shard:
+        raise SystemExit("--kernel-mesh requires --shard")
 
     if args.shard:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -245,6 +249,17 @@ def main() -> int:
     else:
         bins_in, label_in, weight = (jnp.asarray(bins_host),
                                      jnp.asarray(y), None)
+
+    mesh_kw = {}
+    if args.kernel_mesh:
+        # the sharded-kernel route: the row padding above already makes
+        # rows divide by the device count (shard_map's even-sharding rule)
+        mesh_kw = dict(histogram="pallas", histogram_mesh=(mesh, "data"))
+        print("histogram route: pallas kernel per-device under shard_map "
+              "+ psum", flush=True)
+    model = GBDT(num_features=args.dim, num_trees=args.trees,
+                 max_depth=args.depth, num_bins=args.bins,
+                 learning_rate=0.4, missing_aware=args.missing, **mesh_kw)
 
     t0 = time.monotonic()
     params = model.fit(bins_in, label_in, weight=weight)
