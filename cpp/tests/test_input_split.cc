@@ -5,6 +5,7 @@
 // simulated distributed reads without a cluster.  Covers NOEOL, CRLF,
 // multi-file seams, recordio with magic collisions, indexed recordio with
 // shuffle, the cache-file path, and the shuffle wrapper.
+#include <cstdlib>
 #include <algorithm>
 #include <map>
 #include <random>
@@ -323,8 +324,16 @@ TESTCASE(fuzz_exactly_once_random_configs) {
   // file counts, and shard counts must preserve the exactly-once union for
   // BOTH text and recordio splitters.  Complements the hand-built seam
   // cases above with configurations nobody thought to write down.
-  std::mt19937 rng(20260730);
-  for (int trial = 0; trial < 6; ++trial) {
+  // Extended soaks: DMLCTPU_FUZZ_TRIALS / DMLCTPU_FUZZ_SEED override the
+  // gate's fast defaults (6 trials, pinned seed).
+  const char* env_trials = std::getenv("DMLCTPU_FUZZ_TRIALS");
+  const char* env_seed = std::getenv("DMLCTPU_FUZZ_SEED");
+  const int ntrials = env_trials ? std::atoi(env_trials) : 6;
+  std::mt19937 rng(env_seed
+                       ? static_cast<uint32_t>(std::strtoul(env_seed,
+                                                            nullptr, 10))
+                       : 20260730u);
+  for (int trial = 0; trial < ntrials; ++trial) {
     TemporaryDirectory tmp;
     int nfiles = 1 + static_cast<int>(rng() % 3);
     int nrows = 50 + static_cast<int>(rng() % 300);
