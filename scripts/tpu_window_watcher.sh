@@ -14,7 +14,13 @@ while :; do
   if timeout 900 env PYTHONPATH="$REPO:/root/.axon_site" \
       python "$REPO/scripts/hist_kernel_sweep.py" --update-observed \
       >> "$LOG" 2>&1; then
-    echo "[watcher] $(date -u +%FT%TZ) sweep SUCCEEDED" >> "$LOG"
+    echo "[watcher] $(date -u +%FT%TZ) sweep SUCCEEDED; launching full bench" >> "$LOG"
+    # ride the same window for a full bench: device phases refresh
+    # TPU_OBSERVED best-per-phase entries (killable subprocesses, safe
+    # even if the tunnel wedges mid-run)
+    ( cd "$REPO" && timeout 2400 python bench.py \
+        > "${TMPDIR:-/tmp}/bench_window_$(date -u +%H%M).log" 2>&1 )
+    echo "[watcher] $(date -u +%FT%TZ) window bench done (rc=$?)" >> "$LOG"
     exit 0
   else
     rc=$?  # 124 = timeout reaped a hung backend init (tunnel down)
