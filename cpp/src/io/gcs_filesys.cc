@@ -1,0 +1,457 @@
+// GCS JSON-API backend (see gcs_filesys.h for the design rationale).
+// Wire shapes handled:
+//   object metadata  -> {"name":"a/b","size":"1234",...}   (size is a string)
+//   list             -> {"items":[{...}],"prefixes":["a/"],"nextPageToken":"t"}
+//   token (metadata) -> {"access_token":"ya29...","expires_in":3599,...}
+//   resumable upload -> POST ...uploadType=resumable => Location: session URL,
+//                       PUT chunks with Content-Range (308 until the final one)
+#include "./gcs_filesys.h"
+
+#include <algorithm>
+#include <ctime>
+#include <cstdlib>
+#include <mutex>
+#include <sstream>
+#include <string_view>
+#include <utility>
+
+#include "./http.h"
+#include "./ranged_stream.h"
+#include "dmlctpu/json.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/parameter.h"
+
+namespace dmlctpu {
+namespace io {
+namespace {
+
+/*! \brief one GCS object entry (only the fields we use) */
+struct GcsObject {
+  std::string name;
+  size_t size = 0;
+};
+
+void ReadObjectEntry(JSONReader* r, GcsObject* out) {
+  r->BeginObject();
+  std::string key;
+  while (r->NextObjectItem(&key)) {
+    if (key == "name") {
+      r->ReadString(&out->name);
+    } else if (key == "size") {
+      // the JSON API serialises uint64 size as a string
+      std::string s;
+      r->ReadString(&s);
+      out->size = s.empty() ? 0 : static_cast<size_t>(std::stoull(s));
+    } else {
+      r->SkipValue();
+    }
+  }
+}
+
+GcsObject ParseObjectMetadata(const std::string& body) {
+  std::istringstream is(body);
+  JSONReader r(&is);
+  GcsObject obj;
+  ReadObjectEntry(&r, &obj);
+  return obj;
+}
+
+/*! \brief one page of a list response */
+struct GcsListPage {
+  std::vector<GcsObject> items;
+  std::vector<std::string> prefixes;
+  std::string next_page_token;
+};
+
+GcsListPage ParseListPage(const std::string& body) {
+  std::istringstream is(body);
+  JSONReader r(&is);
+  GcsListPage page;
+  r.BeginObject();
+  std::string key;
+  while (r.NextObjectItem(&key)) {
+    if (key == "items") {
+      r.BeginArray();
+      while (r.NextArrayItem()) {
+        GcsObject obj;
+        ReadObjectEntry(&r, &obj);
+        page.items.push_back(std::move(obj));
+      }
+    } else if (key == "prefixes") {
+      r.BeginArray();
+      while (r.NextArrayItem()) {
+        std::string p;
+        r.ReadString(&p);
+        page.prefixes.push_back(std::move(p));
+      }
+    } else if (key == "nextPageToken") {
+      r.ReadString(&page.next_page_token);
+    } else {
+      r.SkipValue();
+    }
+  }
+  return page;
+}
+
+using http::ParsedUrl;
+using http::ParseUrl;
+
+/*! \brief fetch a service-account token from the GCE/TPU-VM metadata server */
+std::string FetchMetadataToken(time_t* expiry) {
+  std::string addr = GetEnv("DMLCTPU_GCS_METADATA_ADDR", std::string());
+  if (addr.empty()) addr = GetEnv("GCE_METADATA_HOST", std::string());
+  if (addr.empty()) addr = "metadata.google.internal";
+  std::string host = addr;
+  int port = 80;
+  size_t colon = addr.find(':');
+  if (colon != std::string::npos) {
+    host = addr.substr(0, colon);
+    port = std::atoi(addr.c_str() + colon + 1);
+  }
+  http::Response resp = http::Request(
+      host, port, "GET",
+      "/computeMetadata/v1/instance/service-accounts/default/token",
+      {{"Metadata-Flavor", "Google"}});
+  if (resp.status != 200) {
+    // an HTTP-error answer caches anonymous like a connect failure does —
+    // never a zero expiry (that would re-fetch on every request)
+    *expiry = ::time(nullptr) + 300;
+    return "";
+  }
+  std::istringstream is(resp.body);
+  JSONReader r(&is);
+  std::string token, key;
+  uint64_t expires_in = 0;
+  r.BeginObject();
+  while (r.NextObjectItem(&key)) {
+    if (key == "access_token") {
+      r.ReadString(&token);
+    } else if (key == "expires_in") {
+      r.ReadNumber(&expires_in);
+    } else {
+      r.SkipValue();
+    }
+  }
+  // refresh 2 min early (but never sooner than 1 min from now, so a short
+  // or missing expires_in cannot turn every request into a token fetch);
+  // a failed fetch is re-tried after 5 min
+  time_t lifetime = token.empty() ? 300
+                                  : std::max<time_t>(
+                                        static_cast<time_t>(expires_in) - 120, 60);
+  *expiry = ::time(nullptr) + lifetime;
+  return token;
+}
+
+std::map<std::string, std::string> AuthHeaders() {
+  std::map<std::string, std::string> headers;
+  std::string token = GcsFileSystem::AccessToken();
+  if (!token.empty()) headers["Authorization"] = "Bearer " + token;
+  return headers;
+}
+
+/*! \brief "/storage/v1/b/{bucket}/o/{object}" with the object fully encoded */
+std::string ObjectPath(const URI& uri) {
+  std::string object = uri.name.empty() ? "" : uri.name.substr(1);
+  return "/storage/v1/b/" + uri.host + "/o/" + http::PercentEncodeQuery(object);
+}
+
+/*! \brief Opener for the shared RangedReadStream: alt=media with a Range
+ *  header, re-authorized per request (tokens rotate under long reads) */
+RangedReadStream::Opener GcsMediaOpener(GcsFileSystem::Endpoint ep,
+                                        std::string media_path) {
+  return [ep = std::move(ep),
+          media_path = std::move(media_path)](size_t offset) {
+    auto headers = AuthHeaders();
+    headers["Range"] = "bytes=" + std::to_string(offset) + "-";
+    auto body = http::RequestStream(ep.host, ep.port, "GET",
+                                    media_path + "?alt=media", headers, "",
+                                    ep.tls);
+    // only 206 proves a nonzero offset was honored (a 200 would silently
+    // serve the object from byte 0)
+    TCHECK(body->status() == 206 || (offset == 0 && body->status() == 200))
+        << "GCS media GET at offset " << offset << " failed or ignored Range ("
+        << body->status() << ")";
+    return body;
+  };
+}
+
+/*! \brief resumable-upload write stream: one session, Content-Range chunks */
+class GcsWriteStream : public Stream {
+ public:
+  // non-final chunks must be multiples of 256 KiB (JSON API contract)
+  static constexpr size_t kChunkAlign = 256u << 10;
+
+  GcsWriteStream(GcsFileSystem::Endpoint ep, URI uri)
+      : ep_(std::move(ep)), uri_(std::move(uri)) {
+    flush_bytes_ = static_cast<size_t>(GetEnv("DMLCTPU_GCS_WRITE_BUFFER_MB", 64))
+                   << 20;
+    if (flush_bytes_ < kChunkAlign) flush_bytes_ = kChunkAlign;
+  }
+  ~GcsWriteStream() override {
+    try {
+      Close();
+    } catch (const std::exception& e) {
+      TLOG(Error) << "gcs: discarding write-stream flush failure in "
+                     "destructor (call Close() to observe it): " << e.what();
+    }
+  }
+  void Close() override {
+    if (closed_) return;
+    // flush everything, marking the last chunk final (total now known);
+    // a never-written "w" stream still creates an empty object
+    PutChunk(buffer_, /*final=*/true);
+    buffer_.clear();
+    closed_ = true;
+  }
+
+  size_t Read(void*, size_t) override {
+    TLOG(Fatal) << "GcsWriteStream is write-only";
+    return 0;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    buffer_.append(static_cast<const char*>(ptr), size);
+    if (buffer_.size() >= flush_bytes_) {
+      // send the aligned head in place (no copy), keep the remainder
+      size_t aligned = buffer_.size() / kChunkAlign * kChunkAlign;
+      PutChunk(std::string_view(buffer_).substr(0, aligned), /*final=*/false);
+      buffer_.erase(0, aligned);
+    }
+    return size;
+  }
+
+ private:
+  void StartSession() {
+    std::string object = uri_.name.empty() ? "" : uri_.name.substr(1);
+    std::string path = "/upload/storage/v1/b/" + uri_.host +
+                       "/o?uploadType=resumable&name=" +
+                       http::PercentEncodeQuery(object);
+    auto headers = AuthHeaders();
+    headers["x-upload-content-type"] = "application/octet-stream";
+    http::Response resp =
+        http::Request(ep_.host, ep_.port, "POST", path, headers, "", ep_.tls);
+    TCHECK_EQ(resp.status, 200)
+        << "GCS resumable-upload start for " << uri_.str() << " failed ("
+        << resp.status << "): " << resp.body.substr(0, 200);
+    auto it = resp.headers.find("location");
+    TCHECK(it != resp.headers.end() && !it->second.empty())
+        << "GCS resumable-upload start returned no session Location";
+    session_ = ParseUrl(it->second);
+  }
+
+  void PutChunk(std::string_view data, bool final) {
+    if (session_.host.empty()) StartSession();
+    auto headers = AuthHeaders();
+    if (final) {
+      size_t total = offset_ + data.size();
+      headers["Content-Range"] =
+          data.empty() ? "bytes */" + std::to_string(total)
+                       : "bytes " + std::to_string(offset_) + "-" +
+                             std::to_string(total - 1) + "/" +
+                             std::to_string(total);
+    } else {
+      headers["Content-Range"] = "bytes " + std::to_string(offset_) + "-" +
+                                 std::to_string(offset_ + data.size() - 1) +
+                                 "/*";
+    }
+    http::Response resp =
+        http::Request(session_.host, session_.port, "PUT",
+                      session_.path_and_query, headers, data, session_.tls);
+    if (final) {
+      TCHECK(resp.status == 200 || resp.status == 201)
+          << "GCS upload finalize for " << uri_.str() << " failed ("
+          << resp.status << "): " << resp.body.substr(0, 200);
+    } else {
+      TCHECK_EQ(resp.status, 308)  // Resume Incomplete = chunk accepted
+          << "GCS upload chunk for " << uri_.str() << " failed ("
+          << resp.status << "): " << resp.body.substr(0, 200);
+    }
+    offset_ += data.size();
+  }
+
+  GcsFileSystem::Endpoint ep_;
+  URI uri_;
+  ParsedUrl session_;
+  std::string buffer_;
+  size_t flush_bytes_;
+  size_t offset_ = 0;
+  bool closed_ = false;
+};
+
+}  // namespace
+
+GcsFileSystem* GcsFileSystem::GetInstance() {
+  static GcsFileSystem inst;
+  return &inst;
+}
+
+GcsFileSystem::Endpoint GcsFileSystem::ResolveEndpoint() {
+  std::string ep_url = GetEnv("STORAGE_EMULATOR_HOST", std::string());
+  if (ep_url.empty()) ep_url = GetEnv("DMLCTPU_GCS_ENDPOINT", std::string());
+  Endpoint ep;
+  if (ep_url.empty()) {
+    ep.host = "storage.googleapis.com";
+    return ep;
+  }
+  if (ep_url.rfind("http://", 0) == 0 || ep_url.rfind("https://", 0) == 0) {
+    http::ParsedUrl parsed = http::ParseUrl(ep_url);
+    ep.host = parsed.host;
+    ep.port = parsed.port;
+    ep.tls = parsed.tls;
+    return ep;
+  }
+  // bare host[:port] → https without a port, plain http with one (the
+  // emulator convention STORAGE_EMULATOR_HOST=localhost:4443 implies)
+  size_t colon = ep_url.find(':');
+  if (colon == std::string::npos) {
+    ep.host = ep_url;
+  } else {
+    ep.host = ep_url.substr(0, colon);
+    ep.port = std::atoi(ep_url.c_str() + colon + 1);
+    ep.tls = false;
+  }
+  return ep;
+}
+
+std::string GcsFileSystem::AccessToken() {
+  // cache keyed on the auth-relevant env so tests (and credential rotation
+  // via env) re-resolve; metadata tokens refresh ahead of expiry
+  static std::mutex mu;
+  static std::string cached_fingerprint, cached_token;
+  static time_t cached_expiry = 0;
+
+  std::string direct = GetEnv("GOOGLE_ACCESS_TOKEN", std::string());
+  if (!direct.empty()) return direct;
+  if (GetEnv("DMLCTPU_GCS_ANONYMOUS", 0) != 0) return "";
+
+  std::string fingerprint = GetEnv("DMLCTPU_GCS_METADATA_ADDR", std::string()) +
+                            "|" + GetEnv("GCE_METADATA_HOST", std::string());
+  std::lock_guard<std::mutex> lk(mu);
+  if (fingerprint == cached_fingerprint && ::time(nullptr) < cached_expiry) {
+    return cached_token;
+  }
+  time_t expiry = 0;
+  std::string token;
+  try {
+    token = FetchMetadataToken(&expiry);
+  } catch (const Error&) {
+    // no metadata server (off-GCP): anonymous, re-probed after 5 min
+    expiry = ::time(nullptr) + 300;
+  }
+  cached_fingerprint = fingerprint;
+  cached_token = token;
+  cached_expiry = expiry;
+  return token;
+}
+
+FileInfo GcsFileSystem::GetPathInfo(const URI& path) {
+  Endpoint ep = ResolveEndpoint();
+  if (path.name.empty() || path.name == "/") {
+    FileInfo info;
+    info.path = path;
+    info.type = FileType::kDirectory;
+    return info;
+  }
+  http::Response resp = http::Request(ep.host, ep.port, "GET", ObjectPath(path),
+                                      AuthHeaders(), "", ep.tls);
+  if (resp.status == 200) {
+    GcsObject obj = ParseObjectMetadata(resp.body);
+    FileInfo info;
+    info.path = path;
+    info.size = obj.size;
+    info.type = (!obj.name.empty() && obj.name.back() == '/')
+                    ? FileType::kDirectory : FileType::kFile;
+    return info;
+  }
+  TCHECK_EQ(resp.status, 404) << "GCS stat " << path.str() << " failed ("
+                              << resp.status << "): " << resp.body.substr(0, 200);
+  // no such object — a one-entry prefix list decides if it is a "directory"
+  std::string prefix = path.name.substr(1);
+  if (prefix.back() != '/') prefix += '/';
+  std::string list_path = "/storage/v1/b/" + path.host + "/o?maxResults=1&prefix=" +
+                          http::PercentEncodeQuery(prefix);
+  resp = http::Request(ep.host, ep.port, "GET", list_path, AuthHeaders(), "",
+                       ep.tls);
+  TCHECK_EQ(resp.status, 200) << "GCS list failed (" << resp.status << "): "
+                              << resp.body.substr(0, 200);
+  GcsListPage page = ParseListPage(resp.body);
+  TCHECK(!page.items.empty() || !page.prefixes.empty())
+      << "GCS: no such object " << path.str();
+  FileInfo info;
+  info.path = path;
+  info.type = FileType::kDirectory;
+  return info;
+}
+
+void GcsFileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
+  Endpoint ep = ResolveEndpoint();
+  std::string prefix = path.name.empty() ? "" : path.name.substr(1);
+  if (!prefix.empty() && prefix.back() != '/') prefix += '/';
+  std::string proto = path.protocol + path.host + "/";
+  std::string page_token;
+  do {
+    std::string list_path = "/storage/v1/b/" + path.host + "/o?delimiter=%2F" +
+                            "&prefix=" + http::PercentEncodeQuery(prefix);
+    if (!page_token.empty()) {
+      list_path += "&pageToken=" + http::PercentEncodeQuery(page_token);
+    }
+    http::Response resp = http::Request(ep.host, ep.port, "GET", list_path,
+                                        AuthHeaders(), "", ep.tls);
+    TCHECK_EQ(resp.status, 200) << "GCS list " << path.str() << " failed ("
+                                << resp.status << "): "
+                                << resp.body.substr(0, 200);
+    GcsListPage page = ParseListPage(resp.body);
+    for (const GcsObject& obj : page.items) {
+      FileInfo info;
+      info.path = URI(proto + obj.name);
+      info.size = obj.size;
+      info.type = (!obj.name.empty() && obj.name.back() == '/')
+                      ? FileType::kDirectory : FileType::kFile;
+      out->push_back(info);
+    }
+    for (const std::string& p : page.prefixes) {
+      FileInfo info;
+      info.path = URI(proto + p);
+      info.type = FileType::kDirectory;
+      out->push_back(info);
+    }
+    page_token = page.next_page_token;
+  } while (!page_token.empty());
+}
+
+std::unique_ptr<SeekStream> GcsFileSystem::OpenForRead(const URI& path,
+                                                       bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    TCHECK(info.type == FileType::kFile) << "gcs: not a file: " << path.str();
+    return std::make_unique<RangedReadStream>(
+        GcsMediaOpener(ResolveEndpoint(), ObjectPath(path)), info.size, "GCS");
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+std::unique_ptr<Stream> GcsFileSystem::Open(const URI& path, const char* mode,
+                                            bool allow_null) {
+  std::string m(mode);
+  if (m.find('r') != std::string::npos) return OpenForRead(path, allow_null);
+  TCHECK(m.find('a') == std::string::npos)
+      << "gcs: objects are immutable — append is not supported (read, "
+         "rewrite, or use compose)";
+  TCHECK(m.find('w') != std::string::npos) << "gcs: unsupported mode " << mode;
+  return std::make_unique<GcsWriteStream>(ResolveEndpoint(), path);
+}
+
+namespace {
+struct RegisterGcsBackend {
+  RegisterGcsBackend() {
+    FileSystem::RegisterBackend("gs://", [] {
+      return static_cast<FileSystem*>(GcsFileSystem::GetInstance());
+    });
+  }
+};
+RegisterGcsBackend register_gcs_backend_;
+}  // namespace
+
+}  // namespace io
+}  // namespace dmlctpu

@@ -1,0 +1,74 @@
+// gcs_filesys.h — Google Cloud Storage backend over the GCS JSON API.
+// The reference has no GCS backend (its remote set is S3/HDFS/Azure,
+// src/io.cc:30-71); SURVEY §7 step 4 names GCS as the idiomatic TPU-era
+// addition behind the same FileSystem interface — TPU VMs live next to GCS,
+// and gs:// is the storage protocol every TPU pipeline actually reads.
+//
+// Design (no SDK, no libcurl — the same raw-socket HTTP+TLS client the other
+// remote backends ride):
+//   read   GET /storage/v1/b/{bkt}/o/{obj}?alt=media with a Range header;
+//          seekable, reopens at the cursor if the connection drops.
+//   stat   GET /storage/v1/b/{bkt}/o/{obj} (metadata JSON; `size` is a
+//          JSON *string* per the API).  404 falls back to a one-entry
+//          prefix list to recognise "directories".
+//   list   GET /storage/v1/b/{bkt}/o?prefix=&delimiter=/ with
+//          nextPageToken pagination; `prefixes` become kDirectory entries.
+//   write  resumable upload session (POST uploadType=resumable → session
+//          URL, then PUT chunks with Content-Range; non-final chunks are
+//          256 KiB-aligned as the API requires; 308 = chunk accepted).
+//          Objects are immutable — mode "a" is rejected.
+//
+// Auth: Authorization: Bearer <token>, resolved in order
+//   1. $GOOGLE_ACCESS_TOKEN                      (explicit token)
+//   2. the GCE/TPU-VM metadata server            (service-account token,
+//      cached until ~2 min before expiry; address from
+//      $DMLCTPU_GCS_METADATA_ADDR or $GCE_METADATA_HOST, default
+//      metadata.google.internal)
+//   3. anonymous                                  (public buckets; also the
+//      cached result when no metadata server answers)
+// $DMLCTPU_GCS_ANONYMOUS=1 skips straight to 3.
+//
+// Endpoint: https://storage.googleapis.com, overridden by
+// $STORAGE_EMULATOR_HOST (the standard GCS-emulator contract, e.g.
+// "http://127.0.0.1:4443") or $DMLCTPU_GCS_ENDPOINT.
+#ifndef DMLCTPU_SRC_IO_GCS_FILESYS_H_
+#define DMLCTPU_SRC_IO_GCS_FILESYS_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "dmlctpu/io/filesystem.h"
+
+namespace dmlctpu {
+namespace io {
+
+class GcsFileSystem : public FileSystem {
+ public:
+  static GcsFileSystem* GetInstance();
+
+  FileInfo GetPathInfo(const URI& path) override;
+  void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
+  std::unique_ptr<Stream> Open(const URI& path, const char* mode,
+                               bool allow_null = false) override;
+  std::unique_ptr<SeekStream> OpenForRead(const URI& path,
+                                          bool allow_null = false) override;
+
+  struct Endpoint {
+    std::string host;
+    int port = 443;
+    bool tls = true;
+  };
+  /*! \brief resolve the API endpoint (exposed for tests) */
+  static Endpoint ResolveEndpoint();
+  /*! \brief current bearer token, "" = anonymous (exposed for tests) */
+  static std::string AccessToken();
+
+ private:
+  GcsFileSystem() = default;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_GCS_FILESYS_H_

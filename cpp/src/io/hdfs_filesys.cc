@@ -11,6 +11,7 @@
 #include <utility>
 
 #include "./http.h"
+#include "./ranged_stream.h"
 #include "dmlctpu/json.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
@@ -111,35 +112,8 @@ std::string ParseLocation(const std::string& body) {
   return loc;
 }
 
-/*! \brief split "http://host:port/path?query" into pieces */
-struct ParsedUrl {
-  std::string host;
-  int port = 80;
-  bool tls = false;
-  std::string path_and_query;  // begins with '/'
-};
-ParsedUrl ParseUrl(const std::string& url) {
-  ParsedUrl out;
-  std::string rest = url;
-  if (rest.rfind("http://", 0) == 0) {
-    rest = rest.substr(7);
-  } else if (rest.rfind("https://", 0) == 0) {
-    rest = rest.substr(8);
-    out.tls = true;
-    out.port = 443;
-  }
-  size_t slash = rest.find('/');
-  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
-  out.path_and_query = slash == std::string::npos ? "/" : rest.substr(slash);
-  size_t colon = hostport.find(':');
-  if (colon == std::string::npos) {
-    out.host = hostport;
-  } else {
-    out.host = hostport.substr(0, colon);
-    out.port = std::atoi(hostport.c_str() + colon + 1);
-  }
-  return out;
-}
+using http::ParsedUrl;
+using http::ParseUrl;
 
 /*! \brief build "/webhdfs/v1<path>?op=X[&user.name=u][&extra]" */
 std::string OpPath(const HdfsFileSystem::Endpoint& ep, const std::string& path,
@@ -157,42 +131,16 @@ http::Response NamenodeRequest(const HdfsFileSystem::Endpoint& ep,
   return http::Request(ep.host, ep.port, method, path, {}, "", ep.tls);
 }
 
-/*! \brief ranged-OPEN seekable read stream (reopens on seek / drop) */
-class WebHdfsReadStream : public SeekStream {
- public:
-  WebHdfsReadStream(HdfsFileSystem::Endpoint ep, std::string path, size_t size)
-      : ep_(std::move(ep)), path_(std::move(path)), size_(size) {}
-
-  size_t Read(void* ptr, size_t size) override {
-    if (pos_ >= size_) return 0;
-    if (body_ == nullptr) OpenAt(pos_);
-    size_t n = body_->Read(ptr, size);
-    if (n == 0 && pos_ < size_) {
-      OpenAt(pos_);  // connection dropped mid-stream: resume at cursor
-      n = body_->Read(ptr, size);
-    }
-    pos_ += n;
-    return n;
-  }
-  size_t Write(const void*, size_t) override {
-    TLOG(Fatal) << "WebHdfsReadStream is read-only";
-    return 0;
-  }
-  void Seek(size_t pos) override {
-    if (pos != pos_) {
-      pos_ = pos;
-      body_.reset();
-    }
-  }
-  size_t Tell() override { return pos_; }
-  bool AtEnd() override { return pos_ >= size_; }
-
- private:
-  void OpenAt(size_t offset) {
-    std::string nn_path = OpPath(ep_, path_, "OPEN",
+/*! \brief Opener for the shared RangedReadStream: two-step OPEN — the
+ *  namenode hop carries the byte offset, then the datanode GET streams
+ *  from there (the offset is honored by the OPEN op itself, not Range) */
+RangedReadStream::Opener WebHdfsOpener(HdfsFileSystem::Endpoint ep,
+                                       std::string path) {
+  return [ep = std::move(ep), path = std::move(path)](size_t offset) {
+    std::string nn_path = OpPath(ep, path, "OPEN",
                                  "offset=" + std::to_string(offset) +
                                  "&noredirect=true");
-    http::Response hop = NamenodeRequest(ep_, "GET", nn_path);
+    http::Response hop = NamenodeRequest(ep, "GET", nn_path);
     std::string location;
     if (hop.status == 200) {
       location = ParseLocation(hop.body);
@@ -200,21 +148,16 @@ class WebHdfsReadStream : public SeekStream {
       auto it = hop.headers.find("location");
       if (it != hop.headers.end()) location = it->second;
     }
-    TCHECK(!location.empty()) << "WebHDFS OPEN " << path_ << " failed ("
+    TCHECK(!location.empty()) << "WebHDFS OPEN " << path << " failed ("
                               << hop.status << "): " << hop.body.substr(0, 200);
     ParsedUrl dn = ParseUrl(location);
-    body_ = http::RequestStream(dn.host, dn.port, "GET", dn.path_and_query,
-                                {}, "", dn.tls);
-    TCHECK(body_->status() == 200 || body_->status() == 206)
-        << "WebHDFS datanode GET failed (" << body_->status() << ")";
-  }
-
-  HdfsFileSystem::Endpoint ep_;
-  std::string path_;
-  size_t size_;
-  size_t pos_ = 0;
-  std::unique_ptr<http::BodyStream> body_;
-};
+    auto body = http::RequestStream(dn.host, dn.port, "GET", dn.path_and_query,
+                                    {}, "", dn.tls);
+    TCHECK(body->status() == 200 || body->status() == 206)
+        << "WebHDFS datanode GET failed (" << body->status() << ")";
+    return body;
+  };
+}
 
 /*! \brief buffered write stream: CREATE on first flush, APPEND after */
 class WebHdfsWriteStream : public Stream {
@@ -355,8 +298,8 @@ std::unique_ptr<SeekStream> HdfsFileSystem::OpenForRead(const URI& path,
   try {
     FileInfo info = GetPathInfo(path);
     TCHECK(info.type == FileType::kFile) << "hdfs: not a file: " << path.str();
-    return std::make_unique<WebHdfsReadStream>(ResolveEndpoint(path), path.name,
-                                               info.size);
+    return std::make_unique<RangedReadStream>(
+        WebHdfsOpener(ResolveEndpoint(path), path.name), info.size, "WebHDFS");
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

@@ -6,6 +6,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cstdlib>
 #include <cstring>
 #include <sstream>
 
@@ -76,19 +77,23 @@ class Socket {
   std::unique_ptr<tls::Connection> tls_;
 };
 
-std::string BuildRequest(const std::string& host, const std::string& method,
-                         const std::string& path,
-                         const std::map<std::string, std::string>& headers,
-                         const std::string& body) {
+std::string BuildRequestHead(const std::string& host, int port, bool use_tls,
+                             const std::string& method, const std::string& path,
+                             const std::map<std::string, std::string>& headers,
+                             size_t body_size) {
   std::ostringstream os;
   os << method << " " << path << " HTTP/1.1\r\n";
   if (headers.find("host") == headers.end() && headers.find("Host") == headers.end()) {
-    os << "Host: " << host << "\r\n";
+    // RFC 7230: the Host header carries the port unless it is the scheme
+    // default (servers build redirect/session URLs from it)
+    bool default_port = use_tls ? port == 443 : port == 80;
+    os << "Host: " << host;
+    if (!default_port) os << ":" << port;
+    os << "\r\n";
   }
   for (const auto& [k, v] : headers) os << k << ": " << v << "\r\n";
-  os << "Content-Length: " << body.size() << "\r\n";
+  os << "Content-Length: " << body_size << "\r\n";
   os << "Connection: close\r\n\r\n";
-  os << body;
   return os.str();
 }
 
@@ -97,10 +102,14 @@ class BodyStreamImpl : public BodyStream {
   BodyStreamImpl(const std::string& host, int port, const std::string& method,
                  const std::string& path,
                  const std::map<std::string, std::string>& headers,
-                 const std::string& body, bool use_tls)
+                 std::string_view body, bool use_tls)
       : sock_(host, port, use_tls) {
-    std::string req = BuildRequest(host, method, path, headers, body);
-    sock_.SendAll(req.data(), req.size());
+    // head and body go out as separate sends — a large body (upload chunk)
+    // is never copied into the request buffer
+    std::string head =
+        BuildRequestHead(host, port, use_tls, method, path, headers, body.size());
+    sock_.SendAll(head.data(), head.size());
+    if (!body.empty()) sock_.SendAll(body.data(), body.size());
     ParseHead();
   }
 
@@ -211,7 +220,7 @@ class BodyStreamImpl : public BodyStream {
 std::unique_ptr<BodyStream> RequestStream(
     const std::string& host, int port, const std::string& method,
     const std::string& path, const std::map<std::string, std::string>& headers,
-    const std::string& body, bool use_tls) {
+    std::string_view body, bool use_tls) {
   return std::make_unique<BodyStreamImpl>(host, port, method, path, headers,
                                           body, use_tls);
 }
@@ -219,7 +228,7 @@ std::unique_ptr<BodyStream> RequestStream(
 Response Request(const std::string& host, int port, const std::string& method,
                  const std::string& path,
                  const std::map<std::string, std::string>& headers,
-                 const std::string& body, bool use_tls) {
+                 std::string_view body, bool use_tls) {
   auto stream = RequestStream(host, port, method, path, headers, body, use_tls);
   Response resp;
   resp.status = stream->status();
@@ -249,6 +258,29 @@ std::string PercentEncode(const std::string& s, bool keep_slash) {
   return out;
 }
 }  // namespace
+
+ParsedUrl ParseUrl(const std::string& url) {
+  ParsedUrl out;
+  std::string rest = url;
+  if (rest.rfind("http://", 0) == 0) {
+    rest = rest.substr(7);
+  } else if (rest.rfind("https://", 0) == 0) {
+    rest = rest.substr(8);
+    out.tls = true;
+    out.port = 443;
+  }
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  out.path_and_query = slash == std::string::npos ? "/" : rest.substr(slash);
+  size_t colon = hostport.find(':');
+  if (colon == std::string::npos) {
+    out.host = hostport;
+  } else {
+    out.host = hostport.substr(0, colon);
+    out.port = std::atoi(hostport.c_str() + colon + 1);
+  }
+  return out;
+}
 
 std::string PercentEncodePath(const std::string& path) {
   return PercentEncode(path, /*keep_slash=*/true);

@@ -9,6 +9,7 @@
 #include <map>
 #include <memory>
 #include <string>
+#include <string_view>
 
 namespace dmlctpu {
 namespace http {
@@ -34,14 +35,23 @@ class BodyStream {
 Response Request(const std::string& host, int port, const std::string& method,
                  const std::string& path_and_query,
                  const std::map<std::string, std::string>& headers,
-                 const std::string& body = "", bool use_tls = false);
+                 std::string_view body = {}, bool use_tls = false);
 
 /*! \brief as Request but hands back a stream over the response body */
 std::unique_ptr<BodyStream> RequestStream(
     const std::string& host, int port, const std::string& method,
     const std::string& path_and_query,
     const std::map<std::string, std::string>& headers,
-    const std::string& body = "", bool use_tls = false);
+    std::string_view body = {}, bool use_tls = false);
+
+/*! \brief "http(s)://host[:port]/path?query" split into request pieces */
+struct ParsedUrl {
+  std::string host;
+  int port = 80;
+  bool tls = false;
+  std::string path_and_query;  // begins with '/'
+};
+ParsedUrl ParseUrl(const std::string& url);
 
 /*! \brief percent-encode a URL path, keeping '/' separators */
 std::string PercentEncodePath(const std::string& path);

@@ -1,0 +1,68 @@
+// ranged_stream.h — the one seekable ranged-read stream all HTTP-speaking
+// remote backends share (S3, plain HTTP(S), GCS, WebHDFS).  Semantics:
+// reopen at the cursor on Seek, and resume at the cursor when a connection
+// drops mid-body (one reopened attempt per Read call).  Each backend
+// supplies an Opener that issues its signed/authorized request for
+// "everything from byte `offset`" and validates the response status
+// (a nonzero offset must be proven honored — 206/equivalent — before the
+// body is trusted).
+#ifndef DMLCTPU_SRC_IO_RANGED_STREAM_H_
+#define DMLCTPU_SRC_IO_RANGED_STREAM_H_
+
+#include <functional>
+#include <memory>
+#include <string>
+#include <utility>
+
+#include "./http.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/stream.h"
+
+namespace dmlctpu {
+namespace io {
+
+class RangedReadStream : public SeekStream {
+ public:
+  /*! \brief open the remote object at a byte offset; throws or TCHECKs on
+   *  failure, never returns a body positioned anywhere but `offset` */
+  using Opener = std::function<std::unique_ptr<http::BodyStream>(size_t offset)>;
+
+  RangedReadStream(Opener opener, size_t total_size, std::string what)
+      : opener_(std::move(opener)), size_(total_size), what_(std::move(what)) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    if (pos_ >= size_) return 0;
+    if (body_ == nullptr) body_ = opener_(pos_);
+    size_t n = body_->Read(ptr, size);
+    if (n == 0 && pos_ < size_) {
+      // connection dropped mid-range: reopen at the current position
+      body_ = opener_(pos_);
+      n = body_->Read(ptr, size);
+    }
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void*, size_t) override {
+    TLOG(Fatal) << what_ << " read stream is read-only";
+    return 0;
+  }
+  void Seek(size_t pos) override {
+    if (pos != pos_) {
+      pos_ = pos;
+      body_.reset();
+    }
+  }
+  size_t Tell() override { return pos_; }
+  bool AtEnd() override { return pos_ >= size_; }
+
+ private:
+  Opener opener_;
+  size_t size_;
+  std::string what_;
+  size_t pos_ = 0;
+  std::unique_ptr<http::BodyStream> body_;
+};
+
+}  // namespace io
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_IO_RANGED_STREAM_H_

@@ -8,6 +8,7 @@
 
 #include "./crypto.h"
 #include "./http.h"
+#include "./ranged_stream.h"
 #include "./xml_scan.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
@@ -219,61 +220,27 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
 
 namespace {
 
-/*! \brief ranged-GET seekable read stream with per-request retry */
-class S3ReadStream : public SeekStream {
- public:
-  S3ReadStream(S3FileSystem::Endpoint ep, const SigV4* signer, std::string req_path,
-               size_t total_size)
-      : ep_(std::move(ep)), signer_(signer), req_path_(std::move(req_path)),
-        size_(total_size) {}
-
-  size_t Read(void* ptr, size_t size) override {
-    if (pos_ >= size_) return 0;
-    if (body_ == nullptr) OpenAt(pos_);
-    size_t n = body_->Read(ptr, size);
-    if (n == 0 && pos_ < size_) {
-      // connection dropped mid-range: reopen at the current position
-      OpenAt(pos_);
-      n = body_->Read(ptr, size);
-    }
-    pos_ += n;
-    return n;
-  }
-  size_t Write(const void*, size_t) override {
-    TLOG(Fatal) << "S3ReadStream is read-only";
-    return 0;
-  }
-  void Seek(size_t pos) override {
-    if (pos != pos_) {
-      pos_ = pos;
-      body_.reset();
-    }
-  }
-  size_t Tell() override { return pos_; }
-  bool AtEnd() override { return pos_ >= size_; }
-
- private:
-  void OpenAt(size_t offset) {
+/*! \brief Opener for the shared RangedReadStream: SigV4-signed (or, for
+ *  plain http://, unsigned via an empty-credential signer) ranged GET */
+RangedReadStream::Opener S3RangedOpener(S3FileSystem::Endpoint ep,
+                                        const SigV4* signer,
+                                        std::string req_path) {
+  return [ep = std::move(ep), signer,
+          req_path = std::move(req_path)](size_t offset) {
     std::map<std::string, std::string> headers{
         {"range", "bytes=" + std::to_string(offset) + "-"}};
-    auto signed_req = signer_->Sign("GET", ep_.host, req_path_, {}, headers,
-                                    kUnsignedPayload, NowAmzDate());
-    body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
-                                signed_req.headers, "", ep_.tls);
+    auto signed_req = signer->Sign("GET", ep.host, req_path, {}, headers,
+                                   kUnsignedPayload, NowAmzDate());
+    auto body = http::RequestStream(ep.host, ep.port, "GET", req_path,
+                                    signed_req.headers, "", ep.tls);
     // only 206 proves a nonzero offset was honored (a 200 would silently
     // serve the object from byte 0)
-    TCHECK(body_->status() == 206 || (offset == 0 && body_->status() == 200))
-        << "S3 GET " << req_path_ << " at offset " << offset
-        << " failed or ignored Range (" << body_->status() << ")";
-  }
-
-  S3FileSystem::Endpoint ep_;
-  const SigV4* signer_;
-  std::string req_path_;
-  size_t size_;
-  size_t pos_ = 0;
-  std::unique_ptr<http::BodyStream> body_;
-};
+    TCHECK(body->status() == 206 || (offset == 0 && body->status() == 200))
+        << "S3 GET " << req_path << " at offset " << offset
+        << " failed or ignored Range (" << body->status() << ")";
+    return body;
+  };
+}
 
 /*! \brief buffered write stream: multipart upload above the part threshold */
 class S3WriteStream : public Stream {
@@ -383,8 +350,9 @@ std::unique_ptr<SeekStream> S3FileSystem::OpenForRead(const URI& path, bool allo
   try {
     FileInfo info = GetPathInfo(path);
     Endpoint ep = ResolveEndpoint(path.host);
-    return std::make_unique<S3ReadStream>(ep, &signer_, "/" + path.host + path.name,
-                                          info.size);
+    return std::make_unique<RangedReadStream>(
+        S3RangedOpener(ep, &signer_, "/" + path.host + path.name), info.size,
+        "S3");
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;
@@ -446,7 +414,8 @@ std::unique_ptr<SeekStream> HttpFileSystem::OpenForRead(const URI& path, bool al
     // reuse the S3 read stream machinery without signing via a null signer
     static SigV4 anonymous;  // empty credentials → unsigned headers still fine for GET
     S3FileSystem::Endpoint ep = HttpEndpoint(path);
-    return std::make_unique<S3ReadStream>(ep, &anonymous, path.name, info.size);
+    return std::make_unique<RangedReadStream>(
+        S3RangedOpener(ep, &anonymous, path.name), info.size, "HTTP");
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;
