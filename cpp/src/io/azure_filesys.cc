@@ -10,6 +10,7 @@
 
 #include "./crypto.h"
 #include "./http.h"
+#include "./ranged_stream.h"
 #include "./xml_scan.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
@@ -270,62 +271,28 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
 
 namespace {
 
-/*! \brief ranged-GET seekable blob read stream (resumes on drop) */
-class AzureReadStream : public SeekStream {
- public:
-  AzureReadStream(AzureFileSystem::Endpoint ep, const AzureSharedKey* signer,
-                  std::string resource, size_t total_size)
-      : ep_(std::move(ep)), signer_(signer), resource_(std::move(resource)),
-        req_path_(WirePath(ep_, resource_)), size_(total_size) {}
-
-  size_t Read(void* ptr, size_t size) override {
-    if (pos_ >= size_) return 0;
-    if (body_ == nullptr) OpenAt(pos_);
-    size_t n = body_->Read(ptr, size);
-    if (n == 0 && pos_ < size_) {
-      OpenAt(pos_);
-      n = body_->Read(ptr, size);
-    }
-    pos_ += n;
-    return n;
-  }
-  size_t Write(const void*, size_t) override {
-    TLOG(Fatal) << "AzureReadStream is read-only";
-    return 0;
-  }
-  void Seek(size_t pos) override {
-    if (pos != pos_) {
-      pos_ = pos;
-      body_.reset();
-    }
-  }
-  size_t Tell() override { return pos_; }
-  bool AtEnd() override { return pos_ >= size_; }
-
- private:
-  void OpenAt(size_t offset) {
+/*! \brief Opener for the shared RangedReadStream: SharedKey-signed ranged
+ *  blob GET, re-signed per request (x-ms-date must be fresh) */
+RangedReadStream::Opener AzureRangedOpener(AzureFileSystem::Endpoint ep,
+                                           const AzureSharedKey* signer,
+                                           std::string resource) {
+  std::string req_path = WirePath(ep, resource);
+  return [ep = std::move(ep), signer, resource = std::move(resource),
+          req_path = std::move(req_path)](size_t offset) {
     std::map<std::string, std::string> headers{
         {"Range", "bytes=" + std::to_string(offset) + "-"}};
-    auto signed_req = signer_->Sign("GET", ep_.path_prefix + resource_, {},
-                                    headers, 0, NowRfc1123());
-    body_ = http::RequestStream(ep_.host, ep_.port, "GET", req_path_,
-                                signed_req.headers, "", ep_.tls);
+    auto signed_req = signer->Sign("GET", ep.path_prefix + resource, {},
+                                   headers, 0, NowRfc1123());
+    auto body = http::RequestStream(ep.host, ep.port, "GET", req_path,
+                                    signed_req.headers, "", ep.tls);
     // a server that ignores Range and replies 200 with the full body would
     // silently serve bytes from 0 — only 206 proves the offset was honored
-    int want_partial = offset > 0 ? 206 : 0;
-    TCHECK(body_->status() == 206 || (want_partial == 0 && body_->status() == 200))
-        << "azure GET " << req_path_ << " at offset " << offset << " failed or "
-        << "ignored Range (" << body_->status() << ")";
-  }
-
-  AzureFileSystem::Endpoint ep_;
-  const AzureSharedKey* signer_;
-  std::string resource_;
-  std::string req_path_;
-  size_t size_;
-  size_t pos_ = 0;
-  std::unique_ptr<http::BodyStream> body_;
-};
+    TCHECK(body->status() == 206 || (offset == 0 && body->status() == 200))
+        << "azure GET " << req_path << " at offset " << offset << " failed or "
+        << "ignored Range (" << body->status() << ")";
+    return body;
+  };
+}
 
 /*! \brief block-blob write stream: small objects go as one Put Blob; larger
  *         ones stage Put Block chunks and commit with Put Block List */
@@ -428,8 +395,9 @@ std::unique_ptr<SeekStream> AzureFileSystem::OpenForRead(const URI& path,
   try {
     FileInfo info = GetPathInfo(path);
     Endpoint ep = ResolveEndpoint();
-    return std::make_unique<AzureReadStream>(
-        ep, &signer_, "/" + path.host + path.name, info.size);
+    return std::make_unique<RangedReadStream>(
+        AzureRangedOpener(ep, &signer_, "/" + path.host + path.name),
+        info.size, "azure");
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

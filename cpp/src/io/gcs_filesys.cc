@@ -38,10 +38,18 @@ void ReadObjectEntry(JSONReader* r, GcsObject* out) {
     if (key == "name") {
       r->ReadString(&out->name);
     } else if (key == "size") {
-      // the JSON API serialises uint64 size as a string
+      // the JSON API serialises uint64 size as a string; malformed wire
+      // data must surface as Error (the contract allow_null relies on),
+      // never a raw std exception
       std::string s;
       r->ReadString(&s);
-      out->size = s.empty() ? 0 : static_cast<size_t>(std::stoull(s));
+      size_t size = 0;
+      for (char c : s) {
+        TCHECK(c >= '0' && c <= '9')
+            << "GCS: non-numeric object size '" << s << "'";
+        size = size * 10 + static_cast<size_t>(c - '0');
+      }
+      out->size = size;
     } else {
       r->SkipValue();
     }
@@ -325,10 +333,15 @@ std::string GcsFileSystem::AccessToken() {
 
   std::string fingerprint = GetEnv("DMLCTPU_GCS_METADATA_ADDR", std::string()) +
                             "|" + GetEnv("GCE_METADATA_HOST", std::string());
-  std::lock_guard<std::mutex> lk(mu);
-  if (fingerprint == cached_fingerprint && ::time(nullptr) < cached_expiry) {
-    return cached_token;
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    if (fingerprint == cached_fingerprint && ::time(nullptr) < cached_expiry) {
+      return cached_token;
+    }
   }
+  // fetch OUTSIDE the lock: a slow/blackholed metadata server must not
+  // stall every other thread's GCS I/O behind the mutex (threads racing
+  // here each fetch once; last writer wins, all get valid tokens)
   time_t expiry = 0;
   std::string token;
   try {
@@ -337,6 +350,7 @@ std::string GcsFileSystem::AccessToken() {
     // no metadata server (off-GCP): anonymous, re-probed after 5 min
     expiry = ::time(nullptr) + 300;
   }
+  std::lock_guard<std::mutex> lk(mu);
   cached_fingerprint = fingerprint;
   cached_token = token;
   cached_expiry = expiry;

@@ -119,6 +119,8 @@ struct HttpReply {
   std::string body;
   std::string extra_headers;   // raw "K: v\r\n" lines
   bool head_no_body = false;   // HEAD: extra_headers carry the size
+  size_t truncate_after = 0;   // nonzero: claim full length, send this many
+                               // body bytes, then drop the connection
 };
 
 class MiniHttpServer {
@@ -200,6 +202,13 @@ class MiniHttpServer {
     if (reply.head_no_body) {
       resp << "HTTP/1.1 " << reply.status << "\r\n" << reply.extra_headers
            << "Connection: close\r\n\r\n";
+    } else if (reply.truncate_after != 0) {
+      // simulate a dropped connection mid-body: full Content-Length, then
+      // only the first truncate_after bytes before close
+      resp << "HTTP/1.1 " << reply.status << "\r\n" << reply.extra_headers
+           << "Content-Length: " << reply.body.size()
+           << "\r\nConnection: close\r\n\r\n"
+           << reply.body.substr(0, reply.truncate_after);
     } else {
       resp << "HTTP/1.1 " << reply.status << "\r\n" << reply.extra_headers
            << "Content-Length: " << reply.body.size()
@@ -493,6 +502,9 @@ class MiniGcsServer : public MiniHttpServer {
   std::string expected_token = "testtoken";
   std::atomic<int> auth_rejects{0};
   std::atomic<int> unaligned_chunks{0};
+  std::atomic<int> media_hits{0};
+  std::atomic<int> truncate_next_media{0};  // next media GET: drop the
+                                            // connection after this many bytes
 
  protected:
   void Handle(const HttpRequest& req, HttpReply* reply) override {
@@ -579,6 +591,7 @@ class MiniGcsServer : public MiniHttpServer {
         reply->status = "404 Not Found";
         reply->body = R"({"error":{"code":404,"message":"no such object"}})";
       } else if (QueryParam(req.query, "alt") == "media") {
+        ++media_hits;
         size_t begin = 0;
         auto range = req.headers.find("range");
         if (range != req.headers.end()) {
@@ -586,6 +599,8 @@ class MiniGcsServer : public MiniHttpServer {
           reply->status = "206 Partial Content";
         }
         reply->body = it->second.substr(std::min(begin, it->second.size()));
+        reply->truncate_after =
+            static_cast<size_t>(truncate_next_media.exchange(0));
       } else {
         reply->body = R"({"name":")" + name + R"(","size":")" +
                       std::to_string(it->second.size()) + R"("})";
@@ -921,6 +936,28 @@ TESTCASE(gcs_roundtrip_against_mini_server) {
   // every request above carried the bearer token
   EXPECT_EQV(server.auth_rejects.load(), 0);
   ::unsetenv("DMLCTPU_GCS_WRITE_BUFFER_MB");
+  ::unsetenv("GOOGLE_ACCESS_TOKEN");
+  ::unsetenv("STORAGE_EMULATOR_HOST");
+}
+
+TESTCASE(gcs_read_resumes_after_midbody_drop) {
+  // the shared RangedReadStream must transparently reopen at the cursor
+  // when a connection dies mid-body (full Content-Length claimed, fewer
+  // bytes delivered) — the payload must still come back byte-exact
+  MiniGcsServer server;
+  ::setenv("STORAGE_EMULATOR_HOST",
+           ("http://127.0.0.1:" + std::to_string(server.port())).c_str(), 1);
+  ::setenv("GOOGLE_ACCESS_TOKEN", "testtoken", 1);
+  std::string payload;
+  for (int i = 0; i < 6000; ++i) payload += "drop-rec-" + std::to_string(i) + "\n";
+  server.objects["data/flaky.txt"] = payload;
+
+  server.truncate_next_media = static_cast<int>(payload.size() / 3);
+  auto in = SeekStream::CreateForRead("gs://bkt/data/flaky.txt");
+  std::string got(payload.size(), '\0');
+  in->ReadAll(got.data(), got.size());
+  EXPECT_TRUE(got == payload);
+  EXPECT_TRUE(server.media_hits.load() >= 2);  // initial + resumed request
   ::unsetenv("GOOGLE_ACCESS_TOKEN");
   ::unsetenv("STORAGE_EMULATOR_HOST");
 }
