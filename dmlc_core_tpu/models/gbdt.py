@@ -56,13 +56,22 @@ class QuantileBinner:
     the semantics sparse libsvm data wants: absent feature != 0).
     """
 
-    def __init__(self, num_bins: int = 256, missing_aware: bool = False):
+    def __init__(self, num_bins: int = 256, missing_aware: bool = False,
+                 sketch_size: int = 4096, sketch_seed: int = 0):
         if not 2 <= num_bins <= 256:
             raise ValueError("num_bins must be in [2, 256] (uint8 codes)")
         if missing_aware and num_bins < 3:
             raise ValueError("missing_aware needs >= 3 bins")
+        if sketch_size < num_bins:
+            raise ValueError("sketch_size must be >= num_bins")
         self.num_bins = num_bins
         self.missing_aware = missing_aware
+        # streaming-sketch knobs (partial_fit*/finalize): per-feature
+        # reservoir capacity (memory = features x sketch_size x 4 B;
+        # nearest-rank quantile error ~ 1/sqrt(sketch_size)) and the seed
+        # making a streamed fit deterministic
+        self.sketch_size = sketch_size
+        self.sketch_seed = sketch_seed
         # f32 [features, value_bins - 1] where value_bins excludes bin 0
         # in missing_aware mode
         self.cuts: Optional[jax.Array] = None
@@ -169,6 +178,130 @@ class QuantileBinner:
             hi = jnp.where(go, hi, mid)
         # NaN entries read as missing (code 0), matching the dense transform
         return jnp.where(jnp.isnan(v), 0, lo + 1).astype(jnp.int32)
+
+    # ---- streaming (bounded-memory, mergeable) sketch -----------------------
+    #
+    # The one-shot fit/fit_sparse need the whole sample in memory at once;
+    # at the Higgs-11M scale (BASELINE target 5) the dataset only ever
+    # exists as a stream of staged batches.  partial_fit/partial_fit_sparse
+    # accumulate a UNIFORM k-reservoir per feature across any number of
+    # chunks — the merge draws a hypergeometric split of the union, so the
+    # combined reservoir is an exact uniform subsample of everything seen —
+    # and finalize() turns the reservoirs into cut points.  Memory is
+    # features x sketch_size x 4 bytes, independent of stream length.
+    # While a feature's stream still fits its reservoir the sketch is
+    # lossless: finalize() cuts equal the one-shot fit_sparse cuts.
+    # (This is the role XGBoost's streaming quantile sketch plays for
+    # hist boosters; same nearest-rank cut rule as fit_sparse.)
+
+    def partial_fit(self, x: np.ndarray) -> "QuantileBinner":
+        """Accumulate a dense ``[rows, features]`` chunk into the sketch."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2:
+            raise ValueError("partial_fit expects [rows, features]")
+        if not self.missing_aware and np.isnan(x).any():
+            raise ValueError(
+                "chunk contains NaN but missing_aware=False; construct "
+                "QuantileBinner(..., missing_aware=True)")
+        self._sketch_ensure(x.shape[1])
+        for f in range(x.shape[1]):
+            col = x[:, f]
+            self._sketch_absorb(f, col[~np.isnan(col)])
+        return self
+
+    def partial_fit_sparse(self, index: np.ndarray, value: np.ndarray,
+                           num_features: int) -> "QuantileBinner":
+        """Accumulate a COO entry chunk (e.g. one staged batch's
+        ``index``/``value`` with padding masked off) into the sketch."""
+        if not self.missing_aware:
+            raise ValueError("partial_fit_sparse requires missing_aware=True "
+                             "(absent cells are missing, not 0)")
+        index = np.asarray(index, np.int64)
+        value = np.asarray(value, np.float32)
+        # malformed COO entries: NaN values and indices outside
+        # [0, num_features) are quietly dropped, matching fit_sparse
+        # (whose arange(num_features) never visits a stray index)
+        keep = (~np.isnan(value)) & (index >= 0) & (index < num_features)
+        index, value = index[keep], value[keep]
+        self._sketch_ensure(num_features)
+        order = np.argsort(index, kind="stable")
+        idx_s, val_s = index[order], value[order]
+        feats = np.unique(idx_s)
+        starts = np.searchsorted(idx_s, feats)
+        ends = np.searchsorted(idx_s, feats + 1)
+        for f, lo, hi in zip(feats, starts, ends):
+            self._sketch_absorb(int(f), val_s[lo:hi])
+        return self
+
+    def finalize(self) -> "QuantileBinner":
+        """Compute cuts from the accumulated reservoirs (nearest-rank, the
+        fit_sparse rule) and drop the sketch state."""
+        if getattr(self, "_sketch_values", None) is None:
+            raise RuntimeError("finalize before partial_fit/partial_fit_sparse")
+        res, fill = self._sketch_values, self._sketch_fill
+        k = res.shape[1]
+        value_bins = self.num_bins - 1 if self.missing_aware else self.num_bins
+        qs = np.linspace(0.0, 1.0, value_bins + 1)[1:-1]
+        # sort with +inf padding so every row's live prefix is its sample
+        padded = np.where(np.arange(k)[None, :] < fill[:, None], res, np.inf)
+        srt = np.sort(padded, axis=1)
+        pos = np.round(qs[None, :] * np.maximum(fill[:, None] - 1, 0)
+                       ).astype(np.int64)
+        cuts = np.take_along_axis(srt, pos, axis=1).astype(np.float32)
+        cuts[fill == 0] = 0.0  # feature never present: degenerate cuts
+        self.cuts = jnp.asarray(np.maximum.accumulate(cuts, axis=1))
+        self._sketch_values = None
+        self._sketch_fill = None
+        self._sketch_seen = None
+        return self
+
+    def _sketch_ensure(self, num_features: int) -> None:
+        """Create (or grow, for sparse streams that discover new feature
+        indices) the per-feature reservoir state."""
+        if getattr(self, "_sketch_values", None) is None:
+            self._sketch_rng = np.random.default_rng(self.sketch_seed)
+            self._sketch_values = np.zeros((num_features, self.sketch_size),
+                                           np.float32)
+            self._sketch_fill = np.zeros(num_features, np.int64)
+            self._sketch_seen = np.zeros(num_features, np.int64)
+            return
+        have = self._sketch_values.shape[0]
+        if num_features > have:
+            grow = num_features - have
+            self._sketch_values = np.concatenate(
+                [self._sketch_values,
+                 np.zeros((grow, self.sketch_size), np.float32)])
+            self._sketch_fill = np.concatenate(
+                [self._sketch_fill, np.zeros(grow, np.int64)])
+            self._sketch_seen = np.concatenate(
+                [self._sketch_seen, np.zeros(grow, np.int64)])
+
+    def _sketch_absorb(self, f: int, chunk: np.ndarray) -> None:
+        """Merge one feature's chunk into its reservoir, keeping the
+        reservoir a uniform sample of everything seen for that feature."""
+        m = chunk.size
+        if m == 0:
+            return
+        k = self.sketch_size
+        fill = int(self._sketch_fill[f])
+        seen = int(self._sketch_seen[f])
+        rng = self._sketch_rng
+        if seen + m <= k:
+            # everything still fits: the reservoir is the complete stream
+            self._sketch_values[f, fill:fill + m] = chunk
+            self._sketch_fill[f] = fill + m
+        else:
+            # union sample: t slots from the old side (a uniform sub-sample
+            # of a uniform sample is uniform), k - t from the new chunk
+            t = int(rng.hypergeometric(seen, m, k))
+            t = min(t, fill)  # guard the degenerate fill < seen edge
+            old = self._sketch_values[f, rng.choice(fill, t, replace=False)] \
+                if t else np.empty(0, np.float32)
+            new = chunk[rng.choice(m, k - t, replace=False)]
+            self._sketch_values[f, :t] = old
+            self._sketch_values[f, t:k] = new
+            self._sketch_fill[f] = k
+        self._sketch_seen[f] = seen + m
 
 
 from .common import logistic_nll

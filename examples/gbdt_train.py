@@ -84,16 +84,25 @@ def main() -> int:
     from dmlc_core_tpu.models import GBDT, QuantileBinner
     from dmlc_core_tpu.ops.sparse import csr_to_dense, csr_to_dense_missing
 
-    def concat_staged(uri, with_qid=False):
+    def concat_staged(uri, with_qid=False, sketch=None):
         """Drain ALL staged batches of a dataset into one host PaddedBatch
-        (hist-GBDT needs the full dataset per level); None if no rows."""
+        (hist-GBDT needs the full dataset per level); None if no rows.
+        With ``sketch`` (a QuantileBinner), each batch's entries feed the
+        streaming quantile sketch as it goes by — bounded-memory cuts over
+        the whole stream, the XGBoost-sketch pattern for data that never
+        fits in one sample (caller runs ``sketch.finalize()`` after)."""
         from dmlc_core_tpu.data.staging import PaddedBatch
         it = DeviceStagingIter(uri, batch_size=args.batch_size,
                                with_qid=with_qid)
-        parts = [(np.asarray(b.label), np.asarray(b.weight),
-                  np.asarray(b.row_ptr), np.asarray(b.index),
-                  np.asarray(b.value),
-                  np.asarray(b.qid) if with_qid else None) for b in it]
+        parts = []
+        for b in it:
+            idxs, vals = np.asarray(b.index), np.asarray(b.value)
+            if sketch is not None:
+                m = vals != 0  # padding slots carry value 0
+                sketch.partial_fit_sparse(idxs[m], vals[m], args.dim)
+            parts.append((np.asarray(b.label), np.asarray(b.weight),
+                          np.asarray(b.row_ptr), idxs, vals,
+                          np.asarray(b.qid) if with_qid else None))
         if not parts:
             return None
         nnz_off = np.cumsum([0] + [p[4].shape[0] for p in parts])
@@ -176,21 +185,26 @@ def main() -> int:
 
     if args.native_sparse:
         # no densify: staged CSR batches concatenated into one host batch
-        # for fit_batch (hist-GBDT needs the full dataset per level)
+        # for fit_batch (hist-GBDT needs the full dataset per level); bin
+        # cuts come from the STREAMING sketch fed batch-by-batch during
+        # the drain.  The reservoir is sized past this dataset's
+        # per-feature counts so the streamed cuts stay EXACTLY the
+        # one-shot fit_sparse cuts; at real Higgs scale you would let the
+        # default (smaller) reservoir subsample — that bounded memory is
+        # the point of the streaming path.
+        binner = QuantileBinner(num_bins=args.bins, missing_aware=True,
+                                sketch_size=1 << 16)
         t0 = time.monotonic()
-        batch = concat_staged(data)
+        batch = concat_staged(data, sketch=binner)
         if batch is None:
             print(f"error: no rows staged from {data}", file=sys.stderr)
             return 1
         t_stage = time.monotonic() - t0
+        binner.finalize()
         mask = np.asarray(batch.value) != 0
         n_real = int(np.asarray(batch.weight).sum())
         print(f"staged {n_real} rows ({int(mask.sum())} nnz) "
-              f"in {t_stage:.2f}s", flush=True)
-        binner = QuantileBinner(num_bins=args.bins, missing_aware=True)
-        binner.fit_sparse(np.asarray(batch.index)[mask],
-                          np.asarray(batch.value)[mask],
-                          num_features=args.dim)
+              f"in {t_stage:.2f}s (bin cuts streamed per batch)", flush=True)
         model = GBDT(num_features=args.dim, num_trees=args.trees,
                      max_depth=args.depth, num_bins=args.bins,
                      learning_rate=0.4, missing_aware=True)

@@ -1,0 +1,136 @@
+"""Streaming quantile sketch: bounded-memory binner cuts over chunked data.
+
+The reference stack's hist boosters (XGBoost downstream of dmlc-core's data
+layer) build their bin cuts with a streaming quantile sketch because the
+dataset only exists as a stream of parsed batches; these tests pin our
+equivalent: QuantileBinner.partial_fit / partial_fit_sparse / finalize.
+"""
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+
+
+def _coo(rows, features, density, seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    nnz = int(rows * features * density)
+    index = rng.integers(0, features, nnz).astype(np.int64)
+    value = (rng.standard_normal(nnz) * scale).astype(np.float32)
+    return index, value
+
+
+def test_streamed_cuts_lossless_when_reservoir_fits():
+    # every feature sees < sketch_size values: the streamed cuts must be
+    # EXACTLY the one-shot fit_sparse cuts, regardless of chunking
+    index, value = _coo(rows=800, features=13, density=0.3, seed=0)
+    one_shot = QuantileBinner(num_bins=32, missing_aware=True)
+    one_shot.fit_sparse(index, value, 13)
+
+    streamed = QuantileBinner(num_bins=32, missing_aware=True,
+                              sketch_size=4096)
+    for chunk in np.array_split(np.arange(index.size), 7):
+        streamed.partial_fit_sparse(index[chunk], value[chunk], 13)
+    streamed.finalize()
+    np.testing.assert_array_equal(np.asarray(one_shot.cuts),
+                                  np.asarray(streamed.cuts))
+
+
+def test_streamed_cuts_quantile_accuracy_when_subsampled():
+    # 60k values/feature through a 4096-slot reservoir: every cut's true
+    # quantile rank must stay within a few percent of its target
+    features, per_feat = 4, 60_000
+    rng = np.random.default_rng(1)
+    index = np.repeat(np.arange(features), per_feat).astype(np.int64)
+    value = rng.standard_normal(index.size).astype(np.float32)
+
+    binner = QuantileBinner(num_bins=64, missing_aware=True, sketch_size=4096)
+    for chunk in np.array_split(np.arange(index.size), 23):
+        binner.partial_fit_sparse(index[chunk], value[chunk], features)
+    binner.finalize()
+
+    cuts = np.asarray(binner.cuts)  # [features, 62]
+    targets = np.linspace(0.0, 1.0, 64)[1:-1]
+    for f in range(features):
+        vals = np.sort(value[index == f])
+        ranks = np.searchsorted(vals, cuts[f]) / vals.size
+        assert np.abs(ranks - targets).max() < 0.04, f
+
+
+def test_streamed_dense_matches_probabilistically_and_rejects_nan():
+    x = np.random.default_rng(2).standard_normal((500, 6)).astype(np.float32)
+    streamed = QuantileBinner(num_bins=16, sketch_size=1024)
+    for chunk in np.array_split(x, 3):
+        streamed.partial_fit(chunk)
+    streamed.finalize()
+    # nearest-rank streamed cuts vs interpolated one-shot cuts: same data,
+    # so every cut sits within one sample step of the one-shot value
+    one_shot = QuantileBinner(num_bins=16).fit(x)
+    a, b = np.asarray(streamed.cuts), np.asarray(one_shot.cuts)
+    assert np.abs(np.searchsorted(np.sort(x[:, 0]), a[0]) -
+                  np.searchsorted(np.sort(x[:, 0]), b[0])).max() <= 1
+
+    plain = QuantileBinner(num_bins=16)
+    with pytest.raises(ValueError, match="missing_aware"):
+        plain.partial_fit(np.array([[np.nan]], np.float32))
+
+
+def test_streamed_sketch_is_deterministic_under_seed():
+    index, value = _coo(rows=5000, features=3, density=0.9, seed=3)
+    cuts = []
+    for _ in range(2):
+        b = QuantileBinner(num_bins=32, missing_aware=True, sketch_size=256,
+                           sketch_seed=7)
+        for chunk in np.array_split(np.arange(index.size), 5):
+            b.partial_fit_sparse(index[chunk], value[chunk], 3)
+        cuts.append(np.asarray(b.finalize().cuts))
+    np.testing.assert_array_equal(cuts[0], cuts[1])
+
+
+def test_sparse_stream_drops_malformed_entries_like_fit_sparse():
+    # stray indices (>= num_features, negative) and NaN values are quietly
+    # dropped — same contract as fit_sparse, never a crash or a polluted
+    # neighbor reservoir
+    good = QuantileBinner(num_bins=8, missing_aware=True, sketch_size=64)
+    good.partial_fit_sparse(np.array([0, 1, 1]),
+                            np.array([1.0, 2.0, 3.0], np.float32), 2)
+    dirty = QuantileBinner(num_bins=8, missing_aware=True, sketch_size=64)
+    dirty.partial_fit_sparse(
+        np.array([0, 1, 1, 5, -1, 0]),
+        np.array([1.0, 2.0, 3.0, 9.0, 9.0, np.nan], np.float32), 2)
+    np.testing.assert_array_equal(np.asarray(good.finalize().cuts),
+                                  np.asarray(dirty.finalize().cuts))
+
+
+def test_sparse_stream_grows_feature_space():
+    # later chunks may reveal higher feature indices than earlier ones
+    b = QuantileBinner(num_bins=8, missing_aware=True, sketch_size=64)
+    b.partial_fit_sparse(np.array([0, 1]), np.array([1.0, 2.0]), 2)
+    b.partial_fit_sparse(np.array([4]), np.array([3.0]), 5)
+    b.finalize()
+    assert np.asarray(b.cuts).shape[0] == 5
+
+
+def test_finalized_sketch_forest_is_chunking_invariant_when_lossless():
+    # while lossless, the cuts cannot depend on how the stream was chunked
+    # — so neither can the downstream GBDT forest
+    rng = np.random.default_rng(4)
+    rows, features = 400, 5
+    x = rng.standard_normal((rows, features)).astype(np.float32)
+    y = (x[:, 0] * x[:, 1] > 0).astype(np.float32)
+
+    def forest(n_chunks):
+        binner = QuantileBinner(num_bins=16, missing_aware=True,
+                                sketch_size=rows + 1)
+        for chunk in np.array_split(x, n_chunks):
+            binner.partial_fit(chunk)
+        binner.finalize()
+        model = GBDT(num_features=features, num_trees=4, max_depth=3,
+                     num_bins=16, missing_aware=True, seed=0)
+        params = model.fit(binner.transform(x), y)
+        return binner, params
+
+    b1, f1 = forest(1)
+    b4, f4 = forest(4)
+    np.testing.assert_array_equal(np.asarray(b1.cuts), np.asarray(b4.cuts))
+    np.testing.assert_array_equal(np.asarray(f1["leaf"]),
+                                  np.asarray(f4["leaf"]))
