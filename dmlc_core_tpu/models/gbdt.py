@@ -1202,6 +1202,52 @@ class GBDT:
         return leaf[node - (2 ** self.max_depth - 1)]
 
     @functools.partial(jax.jit, static_argnums=0)
+    def _level_splits_from_hist(self, hist, gh_node, depth, col_mask,
+                                col_key, lo, hi, active):
+        """Split finding for one level given its accumulated
+        ``[n_nodes, F, B, 2]`` (grad, hess) histogram and ``[n_nodes, 2]``
+        node totals: missing-mass derivation, dual-direction gains,
+        monotone bounds, per-level feature masks, interaction-group
+        propagation.  Shared verbatim by the resident sparse tree builder
+        and the out-of-core streamed builder, so the two produce identical
+        forests from identical data — only how the histogram was
+        accumulated differs.  Returns
+        ``(split_f, split_b, split_d, split_g, lo, hi, active)``."""
+        lam = self.lambda_
+        mono = self.monotone_constraints is not None
+        miss = gh_node[:, None, :] - jnp.sum(hist, axis=2)   # [n, F, 2]
+        gl = jnp.cumsum(hist, axis=2)                   # present mass
+        g_tot = gh_node[:, 0][:, None, None]            # [n, 1, 1]
+        h_tot = gh_node[:, 1][:, None, None]
+
+        def split_gain(gl_, hl_):
+            gr_ = g_tot - gl_
+            hr_ = h_tot - hl_
+            g = (gl_ ** 2 / (hl_ + lam) + gr_ ** 2 / (hr_ + lam)
+                 - g_tot ** 2 / (h_tot + lam))
+            ok = ((hl_ >= self.min_child_weight) &
+                  (hr_ >= self.min_child_weight))
+            return jnp.where(ok, g, -jnp.inf)
+
+        # dir 0: missing left (GL gains the missing mass); dir 1: right
+        dirs = [(gl[..., 0] + miss[:, :, None, 0],
+                 gl[..., 1] + miss[:, :, None, 1]),
+                (gl[..., 0], gl[..., 1])]
+        gain = jnp.stack([split_gain(a, b) for a, b in dirs], axis=3)
+        if mono:
+            wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
+            gain = self._apply_monotone(gain, wl, wr, lo, hi)
+        node_mask = self._level_feature_mask(col_mask, col_key, depth,
+                                             active)
+        split_f, split_b, split_d, split_g = self._pick_splits(gain,
+                                                               node_mask)
+        if mono:
+            lo, hi = self._child_bounds(split_f, split_b, split_d,
+                                        wl, wr, lo, hi)
+        if active is not None:
+            active = self._next_active(active, split_f, split_b)
+        return split_f, split_b, split_d, split_g, lo, hi, active
+
     def _build_tree_sparse(self, row_id: jax.Array, findex: jax.Array,
                            ebin: jax.Array, emask: jax.Array,
                            grad: jax.Array, hess: jax.Array,
@@ -1229,7 +1275,7 @@ class GBDT:
         """
         F, B = self.num_features, self.num_bins
         rows = grad.shape[0]
-        lam = self.lambda_
+        mono = self.monotone_constraints is not None
         rid = row_id.astype(jnp.int32)
         fi = findex.astype(jnp.int32)
         # entry-level (grad, hess) lanes; padding lanes carry 0 mass
@@ -1238,7 +1284,6 @@ class GBDT:
         gh_row = jnp.stack([grad, hess], axis=-1)          # [rows, 2]
 
         node = jnp.zeros(rows, jnp.int32)
-        mono = self.monotone_constraints is not None
         lo = jnp.full(1, -jnp.inf)
         hi = jnp.full(1, jnp.inf)
         active = (jnp.ones((1, self._interaction_groups.shape[0]), bool)
@@ -1254,37 +1299,9 @@ class GBDT:
             ).reshape(n_nodes, F, B, 2)                     # bin 0 is empty
             gh_node = jax.ops.segment_sum(gh_row, rel,
                                           num_segments=n_nodes)  # [n, 2]
-            miss = gh_node[:, None, :] - jnp.sum(hist, axis=2)   # [n, F, 2]
-            gl = jnp.cumsum(hist, axis=2)                   # present mass
-            g_tot = gh_node[:, 0][:, None, None]            # [n, 1, 1]
-            h_tot = gh_node[:, 1][:, None, None]
-
-            def split_gain(gl_, hl_):
-                gr_ = g_tot - gl_
-                hr_ = h_tot - hl_
-                g = (gl_ ** 2 / (hl_ + lam) + gr_ ** 2 / (hr_ + lam)
-                     - g_tot ** 2 / (h_tot + lam))
-                ok = ((hl_ >= self.min_child_weight) &
-                      (hr_ >= self.min_child_weight))
-                return jnp.where(ok, g, -jnp.inf)
-
-            # dir 0: missing left (GL gains the missing mass); dir 1: right
-            dirs = [(gl[..., 0] + miss[:, :, None, 0],
-                     gl[..., 1] + miss[:, :, None, 1]),
-                    (gl[..., 0], gl[..., 1])]
-            gain = jnp.stack([split_gain(a, b) for a, b in dirs], axis=3)
-            if mono:
-                wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
-                gain = self._apply_monotone(gain, wl, wr, lo, hi)
-            node_mask = self._level_feature_mask(col_mask, col_key, depth,
-                                                 active)
-            split_f, split_b, split_d, split_g = self._pick_splits(gain,
-                                                                   node_mask)
-            if mono:
-                lo, hi = self._child_bounds(split_f, split_b, split_d,
-                                            wl, wr, lo, hi)
-            if active is not None:
-                active = self._next_active(active, split_f, split_b)
+            (split_f, split_b, split_d, split_g,
+             lo, hi, active) = self._level_splits_from_hist(
+                hist, gh_node, depth, col_mask, col_key, lo, hi, active)
             features.append(split_f)
             thresholds.append(split_b)
             defaults.append(split_d)
@@ -1486,6 +1503,177 @@ class GBDT:
             label, w,
             lambda g, h, cm, ck: self._build_tree_sparse(
                 row_id, findex, ebin, emask, g, h, cm, ck),
+            eval_margin=eval_margin, eval_label=eval_label,
+            eval_weight=eval_weight,
+            early_stopping_rounds=early_stopping_rounds)
+
+    def fit_streamed(self, batches, binner: QuantileBinner,
+                     eval_set=None, early_stopping_rounds: int = 0) -> dict:
+        """Out-of-core training — XGBoost's external-memory mode, the
+        workload the reference's disk-cache layer exists to feed
+        (`/root/reference/src/data/disk_row_iter.h:94-141` replays 64MB
+        pages per epoch so hist boosters can train past RAM).
+
+        ``batches``: a replayable source of staged ``PaddedBatch``es —
+        either a zero-arg callable returning a fresh iterator (e.g.
+        ``lambda: DeviceStagingIter("data.libsvm#cache", ...)``, where the
+        chunk-level cache makes every replay a sequential local read) or a
+        materialized sequence.  Every replay must yield the same batches
+        in the same order; the staging layer's determinism guarantees
+        this for a fixed URI/config.
+
+        Residency contract: row-level state (label, weight, margins, node
+        positions, grad/hess — a few words per row, ~50 MB at Higgs-11M)
+        stays in memory; entry-level data (indices/values, the dominant
+        term) is re-streamed ``max_depth + 1`` passes per tree — routing
+        for the previous level rides the same pass as the next level's
+        histogram accumulation, and per-batch entry bins are recomputed
+        per pass (compute is cheap next to the IO it avoids holding).
+        Builds the IDENTICAL forest to ``fit_batch`` on the concatenated
+        data: histogram accumulation is associative and split finding is
+        shared (`_level_splits_from_hist`).
+
+        All objectives and training controls of ``fit_batch`` work here
+        (rank:pairwise needs ``with_qid=True`` batches); ``eval_set`` is a
+        resident held-out PaddedBatch, as in ``fit_batch``.
+        """
+        if not (self.missing_aware and binner.missing_aware):
+            raise ValueError("fit_streamed requires missing_aware=True on "
+                             "both the GBDT and the QuantileBinner")
+        replay = batches if callable(batches) else (lambda: iter(batches))
+
+        # pass 0: resident row-level state + per-batch row offsets
+        labels, weights, qids, offsets = [], [], [], [0]
+        for b in replay():
+            labels.append(np.asarray(b.label, np.float32))
+            weights.append(np.asarray(b.weight, np.float32))
+            if b.qid is not None:
+                qids.append(np.asarray(b.qid))
+            offsets.append(offsets[-1] + int(b.label.shape[0]))
+        if not labels:
+            raise ValueError("fit_streamed: the batch source is empty")
+        label = jnp.asarray(np.concatenate(labels))
+        w = jnp.asarray(np.concatenate(weights))
+        qid = (jnp.asarray(np.concatenate(qids))
+               if len(qids) == len(labels) else None)
+        rows = int(label.shape[0])
+        F, B = self.num_features, self.num_bins
+
+        def stream():
+            for i, b in enumerate(replay()):
+                yield offsets[i], b
+
+        def batch_entries(b):
+            rid, fi, emask = self._entry_arrays(b)
+            return (rid.astype(jnp.int32), fi.astype(jnp.int32),
+                    binner.transform_entries(fi, b.value), emask)
+
+        def build_tree(grad, hess, col_mask, ck):
+            gh_row = jnp.stack([grad, hess], axis=-1)      # [rows, 2]
+            node = jnp.zeros(rows, jnp.int32)
+            lo = jnp.full(1, -jnp.inf)
+            hi = jnp.full(1, jnp.inf)
+            active = (jnp.ones((1, self._interaction_groups.shape[0]), bool)
+                      if self._interaction_groups is not None else None)
+            features, thresholds, defaults, gains, covers = [], [], [], [], []
+            prev = None  # previous level's (split_f, split_b, split_d)
+            for depth in range(self.max_depth):
+                first = 2 ** depth - 1
+                n_nodes = 2 ** depth
+                hist = jnp.zeros((n_nodes * F * B, 2), jnp.float32)
+                routed = []
+                for off, b in stream():
+                    nb = int(b.label.shape[0])
+                    rid, fi, ebin, emask = batch_entries(b)
+                    node_b = node[off:off + nb]
+                    if prev is not None:
+                        # route through the previous level's splits in the
+                        # same pass that accumulates this level's histogram
+                        pf, pb, pd = prev
+                        rel_p = node_b - (2 ** (depth - 1) - 1)
+                        go_right = self._route_sparse(
+                            fi, ebin, emask, rid, pf[rel_p], pb[rel_p],
+                            pd[rel_p], nb)
+                        node_b = 2 * node_b + 1 + go_right.astype(jnp.int32)
+                        routed.append(node_b)
+                    rel = node_b - first
+                    gh_k = (gh_row[off:off + nb][rid]
+                            * emask.astype(jnp.float32)[:, None])
+                    keys = (rel[rid] * F + fi) * B + ebin
+                    hist = hist + jax.ops.segment_sum(
+                        gh_k, keys, num_segments=n_nodes * F * B)
+                if prev is not None:
+                    node = jnp.concatenate(routed)
+                gh_node = jax.ops.segment_sum(gh_row, node - first,
+                                              num_segments=n_nodes)
+                (split_f, split_b, split_d, split_g,
+                 lo, hi, active) = self._level_splits_from_hist(
+                    hist.reshape(n_nodes, F, B, 2), gh_node, depth,
+                    col_mask, col_key=ck, lo=lo, hi=hi, active=active)
+                features.append(split_f)
+                thresholds.append(split_b)
+                defaults.append(split_d)
+                gains.append(split_g)
+                covers.append(gh_node[:, 1])
+                prev = (split_f, split_b, split_d)
+
+            # final pass: route through the deepest splits to the leaves
+            routed = []
+            first = 2 ** (self.max_depth - 1) - 1
+            for off, b in stream():
+                nb = int(b.label.shape[0])
+                rid, fi, ebin, emask = batch_entries(b)
+                node_b = node[off:off + nb]
+                pf, pb, pd = prev
+                rel_p = node_b - first
+                go_right = self._route_sparse(fi, ebin, emask, rid,
+                                              pf[rel_p], pb[rel_p],
+                                              pd[rel_p], nb)
+                routed.append(2 * node_b + 1 + go_right.astype(jnp.int32))
+            node = jnp.concatenate(routed)
+
+            n_leaves = 2 ** self.max_depth
+            leaf_rel = node - (n_leaves - 1)
+            gh_leaf = jax.ops.segment_sum(gh_row, leaf_rel,
+                                          num_segments=n_leaves)
+            leaf_w = -gh_leaf[:, 0] / (gh_leaf[:, 1] + self.lambda_)
+            if self.monotone_constraints is not None:
+                leaf_w = jnp.clip(leaf_w, lo, hi)
+            leaf = self.learning_rate * leaf_w
+            return (jnp.concatenate(features), jnp.concatenate(thresholds),
+                    jnp.concatenate(defaults), jnp.concatenate(gains),
+                    jnp.concatenate(covers), leaf, leaf_rel)
+
+        eval_margin = eval_label = eval_weight = None
+        if eval_set is not None:
+            ev = eval_set
+            ev_rid, ev_fi, ev_mask = self._entry_arrays(ev)
+            ev_bin = binner.transform_entries(ev_fi, ev.value)
+            eval_label = ev.label.astype(jnp.float32)
+            eval_weight = ev.weight
+            eval_margin = (lambda f, t, d, leaf:
+                           self._tree_margins_sparse_one(
+                               f, t, d, leaf, ev_rid, ev_fi, ev_bin,
+                               ev_mask, ev.label))
+        if self.objective == "rank:pairwise":
+            if qid is None:
+                raise ValueError("rank:pairwise fit_streamed needs batches "
+                                 "staged with_qid=True")
+            grad_hess, eval_loss_fn = self._rank_fns(
+                qid, w,
+                eval_qid=(eval_set.qid if eval_set is not None else None),
+                eval_w=(eval_set.weight if eval_set is not None else None),
+                have_eval=eval_set is not None)
+            return self._boost(
+                label, w, build_tree,
+                eval_margin=eval_margin, eval_label=eval_label,
+                eval_weight=eval_weight,
+                early_stopping_rounds=early_stopping_rounds,
+                grad_hess=grad_hess, eval_loss_fn=eval_loss_fn)
+        driver = (self._boost_multi if self.objective == "softmax"
+                  else self._boost)
+        return driver(
+            label, w, build_tree,
             eval_margin=eval_margin, eval_label=eval_label,
             eval_weight=eval_weight,
             early_stopping_rounds=early_stopping_rounds)

@@ -1,0 +1,188 @@
+"""Out-of-core GBDT (`fit_streamed`): forest-identical to `fit_batch`.
+
+The oracle is the resident sparse path on the concatenation of the same
+batches — histogram accumulation is associative and split finding is
+shared, so every array of the fitted forest must match exactly, across
+objectives and every training control that rides the shared drivers.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dmlc_core_tpu.data.staging import PaddedBatch
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+
+FEATURES = 10
+
+
+def _batch(rng, rows, pad_rows=2, nnz_pad=8, with_qid=False, n_class=0):
+    """One synthetic PaddedBatch with trailing padding rows + pad lanes."""
+    counts = rng.integers(1, 6, rows)
+    total = rows + pad_rows
+    row_ptr = np.zeros(total + 1, np.int32)
+    row_ptr[1:rows + 1] = np.cumsum(counts)
+    row_ptr[rows + 1:] = row_ptr[rows]
+    index = np.concatenate(
+        [np.sort(rng.choice(FEATURES, c, replace=False)) for c in counts]
+    ).astype(np.int32)
+    value = rng.uniform(0.5, 2.0, index.size).astype(np.float32)
+    dense0 = np.zeros(rows, np.float32)
+    for r in range(rows):
+        span = slice(row_ptr[r], row_ptr[r + 1])
+        if 0 in index[span]:
+            dense0[r] = value[span][index[span] == 0][0]
+    if n_class:
+        label = (rng.integers(0, n_class, rows)).astype(np.float32)
+    else:
+        label = ((dense0 > 1.2) ^ (rng.uniform(size=rows) > 0.9)
+                 ).astype(np.float32)
+    qid = rng.integers(0, 6, rows).astype(np.int32) if with_qid else None
+    pad = np.zeros(nnz_pad, np.float32)
+    return PaddedBatch(
+        label=jnp.asarray(np.concatenate([label, np.zeros(pad_rows)])),
+        weight=jnp.asarray(np.concatenate([np.ones(rows, np.float32),
+                                           np.zeros(pad_rows, np.float32)])),
+        row_ptr=jnp.asarray(row_ptr),
+        index=jnp.asarray(np.concatenate([index, pad.astype(np.int32)])),
+        value=jnp.asarray(np.concatenate([value, pad])),
+        num_rows=jnp.asarray(np.int32(rows)),
+        field=None,
+        qid=(jnp.asarray(np.concatenate([qid, np.zeros(pad_rows, np.int32)]))
+             if with_qid else None))
+
+
+def _concat(batches):
+    """The resident oracle: one PaddedBatch over all rows of `batches`."""
+    nnz_off = np.cumsum(
+        [0] + [int(b.index.shape[0]) for b in batches])[:-1]
+    row_ptr = np.concatenate(
+        [np.asarray(batches[0].row_ptr)]
+        + [np.asarray(b.row_ptr)[1:] + off
+           for b, off in zip(batches[1:], nnz_off[1:])])
+    cat = lambda f: jnp.asarray(np.concatenate(
+        [np.asarray(f(b)) for b in batches]))
+    return PaddedBatch(
+        label=cat(lambda b: b.label), weight=cat(lambda b: b.weight),
+        row_ptr=jnp.asarray(row_ptr),
+        index=cat(lambda b: b.index), value=cat(lambda b: b.value),
+        num_rows=jnp.asarray(np.int32(sum(int(b.num_rows) for b in batches))),
+        field=None,
+        qid=(cat(lambda b: b.qid) if batches[0].qid is not None else None))
+
+
+def _fitted(params):
+    return {k: np.asarray(v) for k, v in params.items()
+            if k in ("feature", "threshold", "default_right", "leaf", "base")}
+
+
+def _binner(batches):
+    b = QuantileBinner(num_bins=16, missing_aware=True)
+    for batch in batches:
+        v = np.asarray(batch.value)
+        m = v != 0
+        b.partial_fit_sparse(np.asarray(batch.index)[m], v[m], FEATURES)
+    return b.finalize()
+
+
+def _assert_same_forest(p1, p2):
+    f1, f2 = _fitted(p1), _fitted(p2)
+    assert f1.keys() == f2.keys() and f1
+    for k in f1:
+        np.testing.assert_array_equal(f1[k], f2[k], err_msg=k)
+
+
+def _model(**kw):
+    kw.setdefault("num_features", FEATURES)
+    kw.setdefault("num_trees", 3)
+    kw.setdefault("max_depth", 3)
+    kw.setdefault("num_bins", 16)
+    kw.setdefault("missing_aware", True)
+    kw.setdefault("seed", 0)
+    return GBDT(**kw)
+
+
+@pytest.fixture
+def batches():
+    rng = np.random.default_rng(0)
+    return [_batch(rng, rows=120) for _ in range(3)]
+
+
+def test_streamed_forest_identical_to_fit_batch(batches):
+    binner = _binner(batches)
+    streamed = _model().fit_streamed(batches, binner)
+    resident = _model().fit_batch(_concat(batches), binner)
+    _assert_same_forest(streamed, resident)
+
+
+def test_streamed_accepts_replayable_callable(batches):
+    binner = _binner(batches)
+    calls = []
+
+    def replay():
+        calls.append(1)
+        return iter(batches)
+
+    streamed = _model().fit_streamed(replay, binner)
+    resident = _model().fit_batch(_concat(batches), binner)
+    _assert_same_forest(streamed, resident)
+    # pass 0 + (max_depth + 1) passes per tree
+    assert len(calls) == 1 + 3 * (3 + 1)
+
+
+@pytest.mark.slow
+def test_streamed_with_sampling_and_constraints_identical(batches):
+    binner = _binner(batches)
+    kw = dict(subsample=0.7, colsample_bytree=0.8, colsample_bylevel=0.8,
+              gamma=0.01, min_child_weight=0.5,
+              monotone_constraints=[1] + [0] * (FEATURES - 1),
+              interaction_constraints=[[0, 1, 2, 3, 4],
+                                       [4, 5, 6, 7, 8, 9]])
+    streamed = _model(**kw).fit_streamed(batches, binner)
+    resident = _model(**kw).fit_batch(_concat(batches), binner)
+    _assert_same_forest(streamed, resident)
+
+
+@pytest.mark.slow
+def test_streamed_softmax_identical(batches):
+    rng = np.random.default_rng(1)
+    multi = [_batch(rng, rows=100, n_class=3) for _ in range(3)]
+    binner = _binner(multi)
+    kw = dict(objective="softmax", num_class=3)
+    streamed = _model(**kw).fit_streamed(multi, binner)
+    resident = _model(**kw).fit_batch(_concat(multi), binner)
+    _assert_same_forest(streamed, resident)
+
+
+@pytest.mark.slow
+def test_streamed_rank_identical():
+    rng = np.random.default_rng(2)
+    ranked = [_batch(rng, rows=90, with_qid=True) for _ in range(3)]
+    binner = _binner(ranked)
+    kw = dict(objective="rank:pairwise")
+    streamed = _model(**kw).fit_streamed(ranked, binner)
+    resident = _model(**kw).fit_batch(_concat(ranked), binner)
+    _assert_same_forest(streamed, resident)
+
+    plain = [_batch(rng, rows=30) for _ in range(2)]
+    with pytest.raises(ValueError, match="with_qid"):
+        _model(**kw).fit_streamed(plain, _binner(plain))
+
+
+@pytest.mark.slow
+def test_streamed_early_stopping_identical(batches):
+    rng = np.random.default_rng(3)
+    ev = _batch(rng, rows=80)
+    binner = _binner(batches)
+    kw = dict(num_trees=8)
+    streamed = _model(**kw).fit_streamed(
+        batches, binner, eval_set=ev, early_stopping_rounds=2)
+    resident = _model(**kw).fit_batch(
+        _concat(batches), binner, eval_set=ev, early_stopping_rounds=2)
+    _assert_same_forest(streamed, resident)
+
+
+def test_streamed_empty_source_raises():
+    with pytest.raises(ValueError, match="empty"):
+        _model().fit_streamed([], QuantileBinner(num_bins=16,
+                                                 missing_aware=True))
