@@ -624,50 +624,96 @@ def run_models() -> dict:
     return out
 
 
-def run_staging(data: Path, fmt: str = "auto") -> dict:
-    """Extra: the full native parse -> pad -> HBM staging path."""
+def run_staging(data: Path, fmt: str = "auto", num_workers: int = 4) -> dict:
+    """Extra: the full native parse -> pad -> HBM staging path, single-worker
+    (the schema-stable headline numbers) THEN through the sharded worker
+    pool, with the per-stage counters and an order-identity check.
+
+    DETAIL-line schema: top-level rows/bytes/secs/mb_s/rows_s and
+    producer_breakdown are the single-worker run (unchanged keys);
+    ``parallel`` holds the pooled run — num_workers, mb_s/rows_s, speedup
+    vs single-worker, order_identical (first batches bit-compare against
+    the 1-worker stream), counters (per-stage seconds from
+    DeviceStagingIter.counters), and cpu_count: on a 1-core container the
+    workers timeshare one core, so speedup ~<=1 there is expected and the
+    honest result — scaling needs real cores."""
     jax, platform = pick_backend()
     from dmlc_core_tpu.data import DeviceStagingIter
 
     uri = str(data) if fmt == "auto" else f"{data}?format={fmt}&label_column=0"
 
-    it = DeviceStagingIter(uri, batch_size=131072, nnz_bucket=1 << 18,
-                           prefetch=4)
+    def epoch(nw: int) -> tuple:
+        it = DeviceStagingIter(uri, batch_size=131072, nnz_bucket=1 << 18,
+                               prefetch=4, num_workers=nw)
 
-    def drain(warmup_batches: int = 0) -> dict:
-        t0 = time.monotonic()
-        rows = None  # device-side accumulation: a per-batch int() readback
-        last = None  # would block the pipeline on a D2H sync every batch
-        n = 0
-        for batch in it:
-            rows = batch.num_rows if rows is None else rows + batch.num_rows
-            last = batch
-            n += 1
-            if warmup_batches and n >= warmup_batches:
+        def drain(warmup_batches: int = 0) -> dict:
+            t0 = time.monotonic()
+            rows = None  # device-side accumulation: a per-batch int()
+            last = None  # readback would block the pipeline on a D2H sync
+            n = 0
+            for batch in it:
+                rows = batch.num_rows if rows is None else rows + batch.num_rows
+                last = batch
+                n += 1
+                if warmup_batches and n >= warmup_batches:
+                    break
+            jax.block_until_ready((rows, last.label, last.index, last.value))
+            secs = time.monotonic() - t0
+            rows = int(rows)
+            nbytes = it.bytes_read - drain.bytes0
+            drain.bytes0 = it.bytes_read
+            return {"rows": rows, "bytes": nbytes, "secs": secs,
+                    "mb_s": (nbytes / (1 << 20)) / secs, "rows_s": rows / secs}
+
+        drain.bytes0 = 0
+        # truncated warmup: enough to compile device_put layouts and warm
+        # the page cache without draining the axon tunnel's token bucket
+        # (the tunnel rate-shapes H2D: ~1.9 GB/s burst, ~0.2 GB/s
+        # sustained — a full warmup epoch would spend the burst budget the
+        # measured epoch needs)
+        drain(warmup_batches=3)
+        out = drain()
+        return out, it
+
+    def first_batch_sigs(nw: int, limit: int = 4) -> list:
+        """Bit-level signature of the first batches (order-identity probe
+        kept off the timed epochs)."""
+        import hashlib
+        import numpy as np
+        it = DeviceStagingIter(uri, batch_size=131072, nnz_bucket=1 << 18,
+                               num_workers=nw)
+        sigs = []
+        for i, b in enumerate(it):
+            h = hashlib.sha1()
+            for a in (b.label, b.row_ptr, b.index, b.value):
+                h.update(np.asarray(a).tobytes())
+            sigs.append((int(b.num_rows), h.hexdigest()))
+            if i + 1 >= limit:
                 break
-        jax.block_until_ready((rows, last.label, last.index, last.value))
-        secs = time.monotonic() - t0
-        rows = int(rows)
-        nbytes = it.bytes_read - drain.bytes0
-        drain.bytes0 = it.bytes_read
-        return {"rows": rows, "bytes": nbytes, "secs": secs,
-                "mb_s": (nbytes / (1 << 20)) / secs, "rows_s": rows / secs}
+        it.close()
+        return sigs
 
-    drain.bytes0 = 0
-    # truncated warmup: enough to compile device_put layouts and warm the
-    # page cache without draining the axon tunnel's token bucket (the
-    # tunnel rate-shapes H2D: ~1.9 GB/s burst, ~0.2 GB/s sustained — a full
-    # warmup epoch would spend the burst budget the measured epoch needs)
-    drain(warmup_batches=3)
-    result = drain()
+    result, it1 = epoch(1)
     result["platform"] = platform
     # producer-side breakdown (BASELINE target 3 diagnosis): shows whether
     # a slow epoch was parse-bound (native_s), dispatch-bound (stage_s), or
     # consumer/device-bound (emit_wait_s) — measured, not guessed
-    if getattr(it, "profile", None):
+    if getattr(it1, "profile", None):
         result["producer_breakdown"] = {
             k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in it.profile.items()}
+            for k, v in it1.profile.items()}
+
+    par, itp = epoch(num_workers)
+    counters = {k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in itp.counters.items()}
+    result["parallel"] = {
+        "num_workers": num_workers,
+        "mb_s": par["mb_s"], "rows_s": par["rows_s"], "secs": par["secs"],
+        "speedup": round(par["rows_s"] / max(result["rows_s"], 1e-9), 3),
+        "order_identical": first_batch_sigs(1) == first_batch_sigs(num_workers),
+        "counters": counters,
+        "cpu_count": os.cpu_count(),
+    }
     return result
 
 

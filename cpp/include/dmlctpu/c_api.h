@@ -32,6 +32,16 @@ const char* DmlcTpuGetLastError(void);
 typedef void* DmlcTpuParserHandle;
 int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
                         const char* format, DmlcTpuParserHandle* out);
+/*! \brief parser with a parallel sharded parse pool.  num_workers <= 1 is
+ *  exactly DmlcTpuParserCreate (bit-identical stream); num_workers > 1 fans
+ *  the parse over worker threads driving per-virtual-part inner parsers.
+ *  reorder != 0 (recommended) re-emits blocks in deterministic part order,
+ *  so the row stream is IDENTICAL for any worker count; reorder == 0 emits
+ *  in arrival order.  buffer_bytes caps buffered parsed bytes (0 = default
+ *  64 MiB).  Needs a seekable byte-range source (not stdin). */
+int DmlcTpuParserCreateEx(const char* uri, unsigned part, unsigned num_parts,
+                          const char* format, int num_workers, int reorder,
+                          uint64_t buffer_bytes, DmlcTpuParserHandle* out);
 int DmlcTpuParserNext(DmlcTpuParserHandle handle, DmlcTpuRowBlockC* out);
 int DmlcTpuParserBeforeFirst(DmlcTpuParserHandle handle);
 int64_t DmlcTpuParserBytesRead(DmlcTpuParserHandle handle);
@@ -133,6 +143,18 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
                                uint64_t nnz_bucket, uint64_t nnz_max,
                                int with_field, int with_qid,
                                DmlcTpuStagedBatcherHandle* out);
+/*! \brief staged batcher over a parallel sharded parse pool.  Batch packing
+ *  is a pure function of the row stream, so with reorder != 0 every staged
+ *  batch is bit-identical to the single-stream batcher for ANY num_workers
+ *  — only parse throughput changes.  num_workers <= 1 falls back to the
+ *  plain single-stream path; buffer_bytes 0 = default (64 MiB). */
+int DmlcTpuStagedBatcherCreateEx(const char* uri, unsigned part,
+                                 unsigned num_parts, const char* format,
+                                 uint64_t batch_size, uint64_t nnz_bucket,
+                                 uint64_t nnz_max, int with_field, int with_qid,
+                                 int num_workers, int reorder,
+                                 uint64_t buffer_bytes,
+                                 DmlcTpuStagedBatcherHandle* out);
 /*! \brief next batch (1/0/-1); buffers stay valid until the following call
  *  to Next/BeforeFirst/Free on this handle */
 int DmlcTpuStagedBatcherNext(DmlcTpuStagedBatcherHandle handle, DmlcTpuStagedBatchC* out);

@@ -8,8 +8,11 @@
 #ifndef DMLCTPU_STRTONUM_H_
 #define DMLCTPU_STRTONUM_H_
 
+#include <algorithm>
 #include <charconv>
 #include <cstdint>
+#include <cstdlib>
+#include <cstring>
 #include <limits>
 #include <type_traits>
 
@@ -136,6 +139,7 @@ DMLCTPU_ALWAYS_INLINE bool TryParseNumTokenImpl(const char** p, const char* end,
     }
     // from_chars does not accept a leading '+'
     if (*s == '+') ++s;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
     r = std::from_chars(s, end, *out);
     if (r.ec == std::errc()) {
       // "inf"/"nan" handled by from_chars
@@ -143,6 +147,24 @@ DMLCTPU_ALWAYS_INLINE bool TryParseNumTokenImpl(const char** p, const char* end,
       return true;
     }
     return false;
+#else
+    // libstdc++ < 11 ships integer-only from_chars: bounded strtod fallback
+    // for the slow path (long mantissas, exponents, inf/nan).  strtod needs
+    // NUL termination, so the token is copied to a stack buffer; it also
+    // accepts leading whitespace, which from_chars rejects — match that.
+    (void)r;
+    if (s == end || IsSpaceChar(*s)) return false;
+    char buf[128];
+    size_t n = std::min<size_t>(static_cast<size_t>(end - s), sizeof(buf) - 1);
+    std::memcpy(buf, s, n);
+    buf[n] = '\0';
+    char* endp = nullptr;
+    double v = std::strtod(buf, &endp);
+    if (endp == buf) return false;
+    *out = static_cast<T>(v);
+    *p = s + (endp - buf);
+    return true;
+#endif
   } else {
     // fast digit-loop path for short integers (feature ids, counts);
     // Bounded=false uses the terminator contract of ParseDigitRun

@@ -8,6 +8,7 @@
 #include <vector>
 
 #include "./data/record_batcher.h"
+#include "./data/sharded_parser.h"
 #include "./data/staged_batcher.h"
 #include "dmlctpu/data.h"
 #include "dmlctpu/input_split.h"
@@ -64,6 +65,23 @@ struct RecordBatcherCtx {
   uint64_t records_cap = 0;
   uint64_t bytes_cap = 0;
 };
+
+// num_workers > 1 → parallel sharded parse pool; otherwise the plain
+// single-stream parser, so the single-worker path stays bit-identical to
+// the V1 entry points
+template <typename IndexType>
+std::unique_ptr<dmlctpu::Parser<IndexType, float>> MakeParser(
+    const char* uri, unsigned part, unsigned num_parts, const char* format,
+    int num_workers, int reorder, uint64_t buffer_bytes) {
+  if (num_workers > 1) {
+    size_t buf = buffer_bytes != 0
+        ? static_cast<size_t>(buffer_bytes)
+        : dmlctpu::data::ShardedParser<IndexType, float>::kDefaultBufferBytes;
+    return std::make_unique<dmlctpu::data::ShardedParser<IndexType, float>>(
+        uri, part, num_parts, format, num_workers, reorder != 0, buf);
+  }
+  return dmlctpu::Parser<IndexType, float>::Create(uri, part, num_parts, format);
+}
 
 }  // namespace
 
@@ -205,6 +223,19 @@ int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
   return Guard([&] {
     auto ctx = std::make_unique<ParserCtx>();
     ctx->parser = dmlctpu::Parser<uint64_t, float>::Create(uri, part, num_parts, format);
+    ctx->parser->BeforeFirst();
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuParserCreateEx(const char* uri, unsigned part, unsigned num_parts,
+                          const char* format, int num_workers, int reorder,
+                          uint64_t buffer_bytes, DmlcTpuParserHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<ParserCtx>();
+    ctx->parser = MakeParser<uint64_t>(uri, part, num_parts, format,
+                                       num_workers, reorder, buffer_bytes);
     ctx->parser->BeforeFirst();
     *out = ctx.release();
     return 0;
@@ -366,6 +397,26 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
     // uint32 parse type: the staged device layout is int32, so the index
     // column packs with a straight memcpy (see staged_batcher.h)
     auto parser = dmlctpu::Parser<uint32_t, float>::Create(uri, part, num_parts, format);
+    ctx->batcher = std::make_unique<dmlctpu::data::StagedBatcher>(
+        std::move(parser), batch_size, nnz_bucket, with_field != 0, nnz_max,
+        with_qid != 0);
+    ctx->batch_size = batch_size;
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuStagedBatcherCreateEx(const char* uri, unsigned part,
+                                 unsigned num_parts, const char* format,
+                                 uint64_t batch_size, uint64_t nnz_bucket,
+                                 uint64_t nnz_max, int with_field, int with_qid,
+                                 int num_workers, int reorder,
+                                 uint64_t buffer_bytes,
+                                 DmlcTpuStagedBatcherHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<BatcherCtx>();
+    auto parser = MakeParser<uint32_t>(uri, part, num_parts, format,
+                                       num_workers, reorder, buffer_bytes);
     ctx->batcher = std::make_unique<dmlctpu::data::StagedBatcher>(
         std::move(parser), batch_size, nnz_bucket, with_field != 0, nnz_max,
         with_qid != 0);

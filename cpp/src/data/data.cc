@@ -44,9 +44,18 @@ Parser<IndexType, DType>* CreateTextParser(const std::string& path,
     nthread = std::atoi(it->second.c_str());
     parser_args.erase("nthread");
   }
+  // ?parseahead=0 skips the ThreadedParser wrap: the sharded producer pool
+  // (sharded_parser.h) drives many inner parsers from its own worker
+  // threads and wants CallParseNext's owned containers, not another queue
+  bool parseahead = true;
+  auto pa = parser_args.find("parseahead");
+  if (pa != parser_args.end()) {
+    parseahead = std::atoi(pa->second.c_str()) != 0;
+    parser_args.erase("parseahead");
+  }
   auto base = std::make_unique<ParserCls<IndexType, DType>>(std::move(source),
                                                             parser_args, nthread);
-  if (!io::UsePipelineThreads()) {
+  if (!parseahead || !io::UsePipelineThreads()) {
     return base.release();  // single-core: skip the parse-ahead stage too
   }
   return new ThreadedParser<IndexType, DType>(std::move(base));

@@ -56,13 +56,37 @@ def _lock_handle():
     return open(build_dir / ".dmlctpu_build_lock", "w")
 
 
+def _build_direct(build_dir: Path, so: Path) -> None:
+    """cmake-less fallback: one g++ invocation over every .cc (containers
+    that ship only a bare toolchain still get a working runtime)."""
+    import shutil
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise RuntimeError("native build failed: no cmake and no C++ "
+                           "compiler (g++/c++/clang++) on PATH")
+    sources = sorted(
+        str(p) for sub in ("cpp/src", "cpp/src/io", "cpp/src/data")
+        for p in (_REPO_ROOT / sub).glob("*.cc"))
+    cmd = [cxx, "-O3", "-g", "-std=c++20", "-fPIC", "-shared", "-pthread",
+           "-fvisibility-inlines-hidden", "-I", str(_REPO_ROOT / "cpp/include"),
+           *sources, "-o", str(so)]
+    proc = subprocess.run(cmd, cwd=_REPO_ROOT, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed ({cxx}, "
+                           f"rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+
+
 def _build_native() -> Path:
     build_dir = _REPO_ROOT / "build"
     so = build_dir / "libdmlctpu.so"
     import fcntl
+    import shutil
     with _lock_handle() as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         if so.exists():  # another process built it while we waited
+            return so
+        if shutil.which("cmake") is None or shutil.which("ninja") is None:
+            _build_direct(build_dir, so)
             return so
         for cmd in (["cmake", "-B", str(build_dir), "-G", "Ninja",
                      "-DCMAKE_BUILD_TYPE=Release"],
@@ -104,6 +128,10 @@ _LIB.DmlcTpuVersion.restype = ctypes.c_char_p
 
 _LIB.DmlcTpuParserCreate.argtypes = [
     ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuParserCreateEx.argtypes = [
+    ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
+    ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
     ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuParserNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(RowBlockC)]
 _LIB.DmlcTpuParserBeforeFirst.argtypes = [ctypes.c_void_p]

@@ -72,13 +72,22 @@ class Parser:
     """Stream RowBlocks from shard `part` of `num_parts` of a dataset URI.
 
     format: "libsvm" | "csv" | "libfm" | "auto" (reads '?format=' URI arg).
+    num_workers > 1 fans the parse over a native sharded worker pool
+    (cpp/src/data/sharded_parser.h); with reorder=True (default) the block
+    stream is bit-identical to the single-worker stream, with reorder=False
+    blocks arrive in completion order (faster first block, order not
+    reproducible).  buffer_mb caps parsed-but-unconsumed bytes.
     """
 
     def __init__(self, uri: str, part: int = 0, num_parts: int = 1,
-                 format: str = "auto"):  # noqa: A002 - dmlc name
+                 format: str = "auto",  # noqa: A002 - dmlc name
+                 num_workers: int = 1, reorder: bool = True,
+                 buffer_mb: int = 64):
         self._handle = ctypes.c_void_p()
-        check(lib().DmlcTpuParserCreate(uri.encode(), part, num_parts, format.encode(),
-                                        ctypes.byref(self._handle)))
+        check(lib().DmlcTpuParserCreateEx(
+            uri.encode(), part, num_parts, format.encode(),
+            int(num_workers), int(reorder), int(buffer_mb) << 20,
+            ctypes.byref(self._handle)))
 
     def __iter__(self) -> Iterator[RowBlock]:
         c = RowBlockC()

@@ -19,9 +19,16 @@ Design (SURVEY.md §7 step 7):
     jit (``PaddedBatch.row_ids``), where they fuse into the consumer;
   * padded nnz slots carry value 0 (and derive row ``batch_size-1``) —
     numerically inert in segment-sum compute;
-  * a Python thread stages one batched ``device_put`` per batch ahead of the
-    consumer (double buffering): the host→HBM DMA of batch N+1 overlaps the
-    device compute of batch N;
+  * ``num_workers > 1`` fans the PARSE over a native sharded worker pool
+    (cpp/src/data/sharded_parser.h): each worker drives an independent
+    parser over a small virtual InputSplit part and the blocks re-emerge in
+    deterministic part order, so every staged batch is bit-identical to the
+    single-worker stream while parse throughput scales with cores;
+  * the host side is a two-stage pipeline: a pack-driver thread drains the
+    native batcher into a host queue (depth ``prefetch_depth``), and a
+    dedicated stager thread turns host batches into device arrays through a
+    double-buffered ``device_put`` feed — the host→HBM DMA of batch k+1
+    overlaps the device compute of batch k;
   * with a mesh, batches are laid out sharded over the data axis via
     ``jax.make_array_from_process_local_data`` (multi-host: each process
     contributes its local InputSplit shard; single host: plain sharded put);
@@ -113,6 +120,118 @@ def _staged_iter(produce, prefetch: int):
             # failure instead of swallowing it in generator close
             LOGGER.warning("staging producer failed after consumer break: %r",
                            error[0])
+
+
+def _parallel_parts_iter(open_part, num_virtual: int, num_workers: int,
+                         reorder: bool, max_buffered: int):
+    """Python-level mirror of the native sharded parse pool, for cursors
+    that only exist per-part at the Python layer (RecordBatcher).
+
+    ``open_part(j)`` yields the items of virtual part ``j``; workers claim
+    parts from a shared cursor and buffer items per part.  reorder=True
+    yields strictly in part order (the part currently being drained may
+    always buffer, so the in-order drain never deadlocks the pool);
+    reorder=False yields in arrival order.  Worker exceptions re-raise in
+    the consumer; generator close stops the workers promptly.
+    """
+    cond = threading.Condition()
+    parts: dict[int, list] = {}
+    done: set = set()
+    state = {"next_claim": 0, "emit": 0, "buffered": 0, "stop": False,
+             "error": None}
+
+    def worker():
+        try:
+            while True:
+                with cond:
+                    if (state["stop"] or state["error"] is not None
+                            or state["next_claim"] >= num_virtual):
+                        return
+                    j = state["next_claim"]
+                    state["next_claim"] += 1
+                    parts.setdefault(j, [])
+                    cond.notify_all()
+                it = open_part(j)
+                try:
+                    for item in it:
+                        with cond:
+                            while not (state["stop"]
+                                       or state["error"] is not None
+                                       or state["buffered"] < max_buffered
+                                       or (reorder and j == state["emit"])):
+                                cond.wait()
+                            if state["stop"] or state["error"] is not None:
+                                return
+                            parts[j].append(item)
+                            state["buffered"] += 1
+                            cond.notify_all()
+                finally:
+                    it.close()
+                with cond:
+                    done.add(j)
+                    cond.notify_all()
+        except BaseException as e:
+            with cond:
+                if state["error"] is None:
+                    state["error"] = e
+                cond.notify_all()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(num_workers, 1))]
+    for t in threads:
+        t.start()
+    try:
+        while True:
+            with cond:
+                while True:
+                    if state["error"] is not None:
+                        raise state["error"]
+                    if reorder:
+                        j = state["emit"]
+                        if j >= num_virtual:
+                            return
+                        q = parts.get(j)
+                        if q:
+                            item = q.pop(0)
+                            state["buffered"] -= 1
+                            cond.notify_all()
+                            break
+                        if q is not None and j in done:
+                            del parts[j]
+                            done.discard(j)
+                            state["emit"] += 1
+                            continue
+                    else:
+                        got = next((j for j, q in parts.items() if q), None)
+                        if got is not None:
+                            item = parts[got].pop(0)
+                            state["buffered"] -= 1
+                            cond.notify_all()
+                            break
+                        for j in [j for j in parts if j in done]:
+                            del parts[j]
+                            done.discard(j)
+                        if state["next_claim"] >= num_virtual and not parts:
+                            return
+                    cond.wait()
+            yield item
+    finally:
+        with cond:
+            state["stop"] = True
+            cond.notify_all()
+        for t in threads:
+            t.join(timeout=10.0)
+
+
+def _pick_virtual_parts(total_bytes: int, num_parts: int,
+                        target_bytes: int = 8 << 20,
+                        lo: int = 8, hi: int = 1024) -> int:
+    """Virtual part count for a Python-level worker pool — same formula as
+    the native ShardedParser (a pure function of dataset size and
+    num_parts, NEVER of num_workers, so ranks with different worker counts
+    still cover the dataset exactly once)."""
+    per_part = total_bytes // max(num_parts, 1)
+    return int(min(max((per_part + target_bytes - 1) // target_bytes, lo), hi))
 
 
 def _replicated_sharding(sharding):
@@ -267,6 +386,11 @@ def _declare_batcher_sig():
         ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
+    L.DmlcTpuStagedBatcherCreateEx.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p)]
     L.DmlcTpuStagedBatcherNext.argtypes = [ctypes.c_void_p,
                                            ctypes.POINTER(_StagedBatchC)]
     L.DmlcTpuStagedBatcherNextOwned.argtypes = [
@@ -369,26 +493,46 @@ class RecordStagingIter:
     records_cap : max records per batch (offsets array length - 1).
     bytes_cap : byte-buffer capacity per batch (fixed device shape).
     sharding : optional jax sharding for the staged arrays.
+    num_workers : reader threads.  > 1 fans the read+pack over a pool of
+        per-virtual-part RecordBatcher cursors (Python-level analogue of
+        the native sharded parser).  Record ORDER is preserved when
+        reorder=True, but batch composition near virtual-part tails may
+        differ from the single-worker packing (each part pads its own tail
+        batch); consumers that need bit-identical batches across worker
+        counts should compare concatenated record streams.
+    reorder : yield parts in deterministic order (True) or arrival order.
+    prefetch_depth : host batches the pack stage keeps in flight
+        (``prefetch`` is the back-compat alias).
     """
 
     def __init__(self, uri: str, records_cap: int = 4096,
                  bytes_cap: int = 1 << 22, part: int = 0, num_parts: int = 1,
-                 sharding=None, prefetch: int = 2):
+                 sharding=None, prefetch: int = 2, num_workers: int = 1,
+                 reorder: bool = True, prefetch_depth: Optional[int] = None):
         self._lib = _declare_record_batcher_sig()
         self._handle = ctypes.c_void_p()
         check(self._lib.DmlcTpuRecordBatcherCreate(
             uri.encode(), part, num_parts, records_cap, bytes_cap,
             ctypes.byref(self._handle)))
+        self._uri = uri
+        self._part = part
+        self._num_parts = num_parts
         self._sharding = sharding
-        self._prefetch = max(prefetch, 1)
+        self._prefetch = max(prefetch_depth if prefetch_depth is not None
+                             else prefetch, 1)
         self._records_cap = records_cap
         self._bytes_cap = bytes_cap
+        self._num_workers = max(int(num_workers), 1)
+        self._reorder = reorder
+        self._virtual_parts = 0  # resolved lazily on the first parallel epoch
+        self._parallel_bytes = 0
         self._lock = threading.Lock()
         self.batches_staged = 0
 
     @property
     def bytes_read(self) -> int:
-        return self._lib.DmlcTpuRecordBatcherBytesRead(self._handle)
+        return (self._lib.DmlcTpuRecordBatcherBytesRead(self._handle)
+                + self._parallel_bytes)
 
     def close(self) -> None:
         # serialize with the producer thread: freeing the native batcher while
@@ -426,14 +570,13 @@ class RecordStagingIter:
             "num_records": int(c.num_records),
         }
 
-    def _stage(self, c: _RecordBatchC) -> RecordBatch:
+    def _stage(self, w: dict) -> RecordBatch:
         with jax.profiler.TraceAnnotation("dmlctpu.stage_records"):
             def put(arr):
                 if self._sharding is not None:
                     return jax.device_put(arr, self._sharding)
                 return jax.device_put(arr)
 
-            w = self._wrap_host(c)
             batch = RecordBatch(
                 bytes=put(w["bytes"]),
                 offsets=put(w["offsets"]),
@@ -443,6 +586,60 @@ class RecordStagingIter:
                 blocks=1)
             self.batches_staged += 1
             return batch
+
+    # ---- host-side record production ----------------------------------------
+    def _resolve_virtual_parts(self) -> int:
+        if self._virtual_parts == 0:
+            L = self._lib
+            h = ctypes.c_void_p()
+            check(L.DmlcTpuInputSplitCreate(
+                self._uri.encode(), b"", 0, 1, b"recordio", 0, 0, 0,
+                ctypes.byref(h)))
+            try:
+                total = L.DmlcTpuInputSplitTotalSize(h)
+            finally:
+                L.DmlcTpuInputSplitFree(h)
+            self._virtual_parts = _pick_virtual_parts(int(total),
+                                                      self._num_parts)
+        return self._virtual_parts
+
+    def _open_part(self, j: int):
+        """One virtual part's packed host batches, on a pool worker thread."""
+        L = self._lib
+        V = self._virtual_parts
+        h = ctypes.c_void_p()
+        check(L.DmlcTpuRecordBatcherCreate(
+            self._uri.encode(), self._part * V + j, self._num_parts * V,
+            self._records_cap, self._bytes_cap, ctypes.byref(h)))
+        try:
+            c = _RecordBatchC()
+            while check(L.DmlcTpuRecordBatcherNext(h, ctypes.byref(c))) == 1:
+                yield self._wrap_host(c)
+        finally:
+            self._parallel_bytes += L.DmlcTpuRecordBatcherBytesRead(h)
+            L.DmlcTpuRecordBatcherFree(h)
+
+    def _produce_host(self, emit) -> None:
+        """Drive the native read+pack, emitting host batch dicts."""
+        if self._num_workers > 1:
+            V = self._resolve_virtual_parts()
+            it = _parallel_parts_iter(
+                self._open_part, V, self._num_workers, self._reorder,
+                max_buffered=self._prefetch + self._num_workers)
+            try:
+                for w in it:
+                    if not emit(w):
+                        return
+            finally:
+                it.close()
+            return
+        with self._lock:
+            check(self._lib.DmlcTpuRecordBatcherBeforeFirst(self._handle))
+            c = _RecordBatchC()
+            while check(self._lib.DmlcTpuRecordBatcherNext(
+                    self._handle, ctypes.byref(c))) == 1:
+                if not emit(self._wrap_host(c)):
+                    return
 
     def _iter_multihost(self) -> Iterator[RecordBatch]:
         """Multi-host epoch: every process contributes one fixed
@@ -457,16 +654,7 @@ class RecordStagingIter:
                 f"bytes_cap={cap_b}; lower bytes_cap below "
                 f"{np.iinfo(np.int32).max // nprocs}")
 
-        def produce(emit):
-            with self._lock:
-                check(self._lib.DmlcTpuRecordBatcherBeforeFirst(self._handle))
-                c = _RecordBatchC()
-                while check(self._lib.DmlcTpuRecordBatcherNext(
-                        self._handle, ctypes.byref(c))) == 1:
-                    if not emit(self._wrap_host(c)):
-                        return
-
-        native = _staged_iter(produce, self._prefetch)
+        native = _staged_iter(self._produce_host, self._prefetch)
 
         def pack(local, out):
             out[0] = local["num_records"]
@@ -497,16 +685,19 @@ class RecordStagingIter:
             yield from self._iter_multihost()
             return
 
-        def produce(emit):
-            with self._lock:
-                check(self._lib.DmlcTpuRecordBatcherBeforeFirst(self._handle))
-                c = _RecordBatchC()
-                while check(self._lib.DmlcTpuRecordBatcherNext(
-                        self._handle, ctypes.byref(c))) == 1:
-                    if not emit(self._stage(c)):
-                        return
+        # two-stage: the read+pack stage fills a host queue; a dedicated
+        # stager thread drains it through a double-buffered device feed
+        host_iter = _staged_iter(self._produce_host, self._prefetch)
 
-        yield from _staged_iter(produce, self._prefetch)
+        def produce(emit):
+            try:
+                for w in host_iter:
+                    if not emit(self._stage(w)):
+                        return
+            finally:
+                host_iter.close()
+
+        yield from _staged_iter(produce, 2)
 
 
 class DeviceStagingIter:
@@ -525,24 +716,39 @@ class DeviceStagingIter:
     sharding : optional ``jax.sharding.Sharding`` for the staged arrays
         (e.g. NamedSharding(mesh, P('data')) on the leading axis).  Scalars
         and ``num_rows`` are replicated.
-    prefetch : staged batches the background thread keeps in flight.
+    prefetch : back-compat alias for ``prefetch_depth``.
+    prefetch_depth : host batches the parse+pack stage keeps queued ahead
+        of the stager thread (the device feed itself is double-buffered).
+    num_workers : native parse worker threads.  > 1 fans the parse over the
+        sharded pool (cpp/src/data/sharded_parser.h); with reorder=True the
+        staged batches are bit-identical to the single-worker stream for
+        ANY worker count (packing is a pure function of the row stream).
+    reorder : deterministic part-ordered re-emission (True, default) or
+        arrival order (False; order not reproducible across runs).
+    buffer_mb : cap on parsed-but-unconsumed bytes in the worker pool.
     """
 
     def __init__(self, uri: str, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
                  part: int = 0, num_parts: int = 1, format: str = "auto",  # noqa: A002
                  sharding=None, with_field: bool = False, prefetch: int = 2,
                  nnz_max: int = 0, log_every: int = 0,
-                 with_qid: bool = False):
+                 with_qid: bool = False, num_workers: int = 1,
+                 reorder: bool = True, buffer_mb: int = 64,
+                 prefetch_depth: Optional[int] = None):
         self._lib = _declare_batcher_sig()
         self._handle = ctypes.c_void_p()
-        check(self._lib.DmlcTpuStagedBatcherCreate(
+        check(self._lib.DmlcTpuStagedBatcherCreateEx(
             uri.encode(), part, num_parts, format.encode(),
             batch_size, nnz_bucket, nnz_max, int(with_field), int(with_qid),
+            int(num_workers), int(reorder), int(buffer_mb) << 20,
             ctypes.byref(self._handle)))
         self._batch_size = batch_size
         self._nnz_max = nnz_max
         self._sharding = sharding
-        self._prefetch = max(prefetch, 1)
+        self._prefetch = max(prefetch_depth if prefetch_depth is not None
+                             else prefetch, 1)
+        self._num_workers = max(int(num_workers), 1)
+        self._reorder = reorder
         self._with_field = with_field
         self._with_qid = with_qid
         self._max_index = -1
@@ -587,14 +793,23 @@ class DeviceStagingIter:
         except Exception:
             pass
 
+    @property
+    def counters(self) -> dict:
+        """Per-stage pipeline counters for the current/last epoch: the
+        ``profile`` breakdown plus pipeline configuration and totals."""
+        c = dict(self.profile or {})
+        c.update(num_workers=self._num_workers, reorder=self._reorder,
+                 prefetch_depth=self._prefetch, bytes_read=self.bytes_read,
+                 batches_staged=self.batches_staged)
+        return c
+
     # ---- staging ------------------------------------------------------------
-    def _stage(self, c: _StagedBatchOwnedC) -> PaddedBatch:
+    def _stage(self, w: dict) -> PaddedBatch:
         # visible as one span per staged batch in jax profiler / xplane traces
         with jax.profiler.TraceAnnotation("dmlctpu.stage_batch"):
-            return self._stage_inner(c)
+            return self._stage_inner(w)
 
-    def _stage_inner(self, c: _StagedBatchOwnedC) -> PaddedBatch:
-        w = self._wrap_owned(c)
+    def _stage_inner(self, w: dict) -> PaddedBatch:
         with_field = w["field"] is not None
         with_qid = w["qid"] is not None
         num_rows = np.int32(w["num_rows"])
@@ -780,19 +995,23 @@ class DeviceStagingIter:
             yield from self._iter_multihost()
             return
 
-        # per-epoch producer-side breakdown (seconds, cumulative):
-        #   native_s    blocking in the C++ parse+pack (NextOwned)
+        # per-epoch pipeline breakdown (seconds, cumulative):
+        #   native_s    blocking in the C++ parse+pack (NextOwned), on the
+        #               pack-driver thread; with num_workers > 1 this is
+        #               mostly reorder-queue waiting, not parse CPU
+        #   host_wait_s the stager thread starved for host batches (the
+        #               parse side is the limiter)
         #   stage_s     wrap + device_put dispatch (async; not transfer)
-        #   emit_wait_s blocked handing off (prefetch queue full = the
+        #   emit_wait_s blocked handing off (device queue full = the
         #               CONSUMER/device is the limiter, not this pipeline)
-        # Cheap enough to keep always on (3 clock reads per multi-MB
+        # Cheap enough to keep always on (a few clock reads per multi-MB
         # batch); bench.py folds it into the staging phase so a slow run
         # pins its own bottleneck instead of inviting guesses.
-        prof = {"native_s": 0.0, "stage_s": 0.0, "emit_wait_s": 0.0,
-                "batches": 0}
+        prof = {"native_s": 0.0, "host_wait_s": 0.0, "stage_s": 0.0,
+                "emit_wait_s": 0.0, "batches": 0}
         self.profile = prof
 
-        def produce(emit):
+        def produce_host(emit):
             with self._lock:
                 check(self._lib.DmlcTpuStagedBatcherBeforeFirst(self._handle))
                 c = _StagedBatchOwnedC()
@@ -800,11 +1019,29 @@ class DeviceStagingIter:
                     t0 = time.monotonic()
                     rc = check(self._lib.DmlcTpuStagedBatcherNextOwned(
                         self._handle, ctypes.byref(c)))
-                    t1 = time.monotonic()
-                    prof["native_s"] += t1 - t0
+                    prof["native_s"] += time.monotonic() - t0
                     if rc != 1:
                         return
-                    batch = self._stage(c)
+                    if not emit(self._wrap_owned(c)):
+                        return
+
+        # two-stage: the pack driver fills a host queue (depth
+        # prefetch_depth); a dedicated stager thread turns host batches
+        # into device arrays through a double-buffered feed, so the H2D
+        # copy of batch k+1 overlaps the consumer's work on batch k
+        host_iter = _staged_iter(produce_host, self._prefetch)
+
+        def produce_device(emit):
+            try:
+                it = iter(host_iter)
+                while True:
+                    t0 = time.monotonic()
+                    w = next(it, None)
+                    t1 = time.monotonic()
+                    prof["host_wait_s"] += t1 - t0
+                    if w is None:
+                        return
+                    batch = self._stage(w)
                     t2 = time.monotonic()
                     prof["stage_s"] += t2 - t1
                     ok = emit(batch)
@@ -812,5 +1049,7 @@ class DeviceStagingIter:
                     prof["batches"] += 1
                     if not ok:
                         return
+            finally:
+                host_iter.close()
 
-        yield from _staged_iter(produce, self._prefetch)
+        yield from _staged_iter(produce_device, 2)

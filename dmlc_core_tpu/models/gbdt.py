@@ -675,6 +675,25 @@ class GBDT:
             "trees_used": jnp.zeros((), jnp.int32),
         }
 
+    @staticmethod
+    def _collapse_dir_ties(gain: jax.Array) -> jax.Array:
+        """Deterministic default-direction tie-break on a
+        [nodes, F, B, n_dir] gain array.  When a (feature, bin) has no
+        missing mass the two directions' gains are mathematically equal,
+        but different accumulation orders (resident vs streamed histogram
+        sums, dense vs sparse builders) can leave them an ulp apart and
+        flip the argmax.  Where dir 0's gain sits within eps of the pair
+        max, lift dir 0 TO the pair max: the flat argmax (direction is the
+        fastest axis) then lands on dir 0 — missing-left, the XGBoost
+        default — identically on every path, while each (feature, bin)'s
+        best value, which cross-candidate selection sees, is unchanged."""
+        if gain.shape[3] < 2:
+            return gain
+        g0, g1 = gain[..., 0], gain[..., 1]
+        best = jnp.maximum(g0, g1)
+        prefer0 = g0 >= best - 1e-6 * (jnp.abs(best) + 1.0)
+        return jnp.stack([jnp.where(prefer0, best, g0), g1], axis=3)
+
     def _pick_splits(self, gain: jax.Array, col_mask: jax.Array):
         """Flat argmax over a [nodes, F, B, n_dir] gain array plus
         null-split encoding; shared by the dense and sparse builders.
@@ -1144,6 +1163,7 @@ class GBDT:
             if mono:
                 wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
                 gain = self._apply_monotone(gain, wl, wr, lo, hi)
+            gain = self._collapse_dir_ties(gain)
             node_mask = self._level_feature_mask(col_mask, col_key, depth,
                                                  active)
             split_f, split_b, split_d, split_g = self._pick_splits(gain,
@@ -1237,6 +1257,7 @@ class GBDT:
         if mono:
             wl, wr = self._dir_child_weights(dirs, g_tot, h_tot)
             gain = self._apply_monotone(gain, wl, wr, lo, hi)
+        gain = self._collapse_dir_ties(gain)
         node_mask = self._level_feature_mask(col_mask, col_key, depth,
                                              active)
         split_f, split_b, split_d, split_g = self._pick_splits(gain,
@@ -1508,17 +1529,22 @@ class GBDT:
             early_stopping_rounds=early_stopping_rounds)
 
     def fit_streamed(self, batches, binner: QuantileBinner,
-                     eval_set=None, early_stopping_rounds: int = 0) -> dict:
+                     eval_set=None, early_stopping_rounds: int = 0,
+                     staging_options: Optional[dict] = None) -> dict:
         """Out-of-core training — XGBoost's external-memory mode, the
         workload the reference's disk-cache layer exists to feed
         (`/root/reference/src/data/disk_row_iter.h:94-141` replays 64MB
         pages per epoch so hist boosters can train past RAM).
 
         ``batches``: a replayable source of staged ``PaddedBatch``es —
-        either a zero-arg callable returning a fresh iterator (e.g.
+        a dataset URI string (a fresh ``DeviceStagingIter`` is built per
+        replay from ``staging_options``, e.g.
+        ``staging_options=dict(batch_size=8192, num_workers=4)``; the
+        parallel sharded parse keeps replays bit-identical for any worker
+        count), a zero-arg callable returning a fresh iterator (e.g.
         ``lambda: DeviceStagingIter("data.libsvm#cache", ...)``, where the
-        chunk-level cache makes every replay a sequential local read) or a
-        materialized sequence.  Every replay must yield the same batches
+        chunk-level cache makes every replay a sequential local read), or
+        a materialized sequence.  Every replay must yield the same batches
         in the same order; the staging layer's determinism guarantees
         this for a fixed URI/config.
 
@@ -1529,9 +1555,15 @@ class GBDT:
         for the previous level rides the same pass as the next level's
         histogram accumulation, and per-batch entry bins are recomputed
         per pass (compute is cheap next to the IO it avoids holding).
-        Builds the IDENTICAL forest to ``fit_batch`` on the concatenated
-        data: histogram accumulation is associative and split finding is
-        shared (`_level_splits_from_hist`).
+        Builds the same forest as ``fit_batch`` on the concatenated data:
+        split finding is shared (`_level_splits_from_hist`) and histogram
+        accumulation is mathematically associative, though per-batch
+        accumulation reorders the float sums — gains can differ by an ulp
+        between the two paths.  The shared default-direction tie-break
+        absorbs the one place an ulp can change the FOREST (the dual-
+        direction argmax on a feature with no missing mass); a near-tie
+        between two different (feature, bin) candidates can still, in
+        principle, resolve differently.
 
         All objectives and training controls of ``fit_batch`` work here
         (rank:pairwise needs ``with_qid=True`` batches); ``eval_set`` is a
@@ -1540,7 +1572,15 @@ class GBDT:
         if not (self.missing_aware and binner.missing_aware):
             raise ValueError("fit_streamed requires missing_aware=True on "
                              "both the GBDT and the QuantileBinner")
-        replay = batches if callable(batches) else (lambda: iter(batches))
+        if isinstance(batches, str):
+            from ..data.staging import DeviceStagingIter
+            uri, opts = batches, dict(staging_options or {})
+            replay = lambda: iter(DeviceStagingIter(uri, **opts))  # noqa: E731
+        elif staging_options is not None:
+            raise ValueError("staging_options only applies when `batches` "
+                             "is a dataset URI string")
+        else:
+            replay = batches if callable(batches) else (lambda: iter(batches))
 
         # pass 0: resident row-level state + per-batch row offsets
         labels, weights, qids, offsets = [], [], [], [0]

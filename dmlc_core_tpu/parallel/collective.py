@@ -73,7 +73,7 @@ def allreduce_bench(mesh: Mesh, mib_per_device: float = 64.0, iters: int = 10) -
 # TOTAL data size of the op (allgather: n * sendcount; the others equal
 # the per-device buffer), bus factors allreduce 2(n-1)/n,
 # allgather/reducescatter (n-1)/n, ppermute 1 (pure point-to-point).
-def _kernels():
+def _kernels(n):
     def allreduce_fn(x):
         return jax.lax.psum(x, "data")
 
@@ -84,7 +84,8 @@ def _kernels():
         return jax.lax.psum_scatter(x, "data", tiled=True)
 
     def ppermute_fn(x):
-        n = jax.lax.axis_size("data")
+        # the permutation pairs must be static, so the axis size comes from
+        # the mesh (jax.lax.axis_size only exists in newer jax releases)
         return jax.lax.ppermute(x, "data",
                                 [(i, (i + 1) % n) for i in range(n)])
 
@@ -111,7 +112,7 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
     op: "allreduce" | "allgather" | "reducescatter" | "ppermute".
     Returns {devices, bytes, seconds_per_iter, algo_gbps, bus_gbps, op}.
     """
-    kernels = _kernels()
+    kernels = _kernels(mesh.devices.size)
     try:
         fn, out_spec, size_base, bus_factor = kernels[op]
     except KeyError:

@@ -54,6 +54,13 @@ def main() -> int:
     ap.add_argument("--bins", type=int, default=64)
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--batch-size", type=int, default=16384)
+    ap.add_argument("--num-workers", type=int, default=1,
+                    help="parallel parse workers for the staging iterator "
+                         "(deterministic: batches are identical for any "
+                         "worker count)")
+    ap.add_argument("--prefetch-depth", type=int, default=None,
+                    help="staged batches buffered ahead of the consumer "
+                         "(default: staging iterator's own default)")
     ap.add_argument("--shard", action="store_true",
                     help="row-shard over all local devices (data parallel)")
     ap.add_argument("--kernel-mesh", action="store_true",
@@ -84,6 +91,21 @@ def main() -> int:
     from dmlc_core_tpu.models import GBDT, QuantileBinner
     from dmlc_core_tpu.ops.sparse import csr_to_dense, csr_to_dense_missing
 
+    stage_kw = dict(num_workers=args.num_workers)
+    if args.prefetch_depth is not None:
+        stage_kw["prefetch_depth"] = args.prefetch_depth
+
+    def entry_mask(row_ptr, n_entries):
+        """Structural mask of REAL CSR entries: the slots covered by some
+        row's [row_ptr[r], row_ptr[r+1]) span.  Everything outside a span
+        is nnz-bucket lane padding — unlike ``value != 0`` this keeps
+        genuine zero-valued features and works on concatenated batches
+        whose padding lanes sit between the per-batch segments."""
+        edges = np.zeros(n_entries + 1, np.int64)
+        np.add.at(edges, row_ptr[:-1], 1)
+        np.add.at(edges, row_ptr[1:], -1)
+        return np.cumsum(edges[:-1]) > 0
+
     def concat_staged(uri, with_qid=False, sketch=None):
         """Drain ALL staged batches of a dataset into one host PaddedBatch
         (hist-GBDT needs the full dataset per level); None if no rows.
@@ -93,12 +115,12 @@ def main() -> int:
         fits in one sample (caller runs ``sketch.finalize()`` after)."""
         from dmlc_core_tpu.data.staging import PaddedBatch
         it = DeviceStagingIter(uri, batch_size=args.batch_size,
-                               with_qid=with_qid)
+                               with_qid=with_qid, **stage_kw)
         parts = []
         for b in it:
             idxs, vals = np.asarray(b.index), np.asarray(b.value)
             if sketch is not None:
-                m = vals != 0  # padding slots carry value 0
+                m = entry_mask(np.asarray(b.row_ptr), vals.shape[0])
                 sketch.partial_fit_sparse(idxs[m], vals[m], args.dim)
             parts.append((np.asarray(b.label), np.asarray(b.weight),
                           np.asarray(b.row_ptr), idxs, vals,
@@ -141,7 +163,8 @@ def main() -> int:
         if batch is None:
             print(f"error: no rows staged from {data_rank}", file=sys.stderr)
             return 1
-        mask = np.asarray(batch.value) != 0
+        mask = entry_mask(np.asarray(batch.row_ptr),
+                          int(batch.value.shape[0]))
         binner = QuantileBinner(num_bins=args.bins, missing_aware=True)
         binner.fit_sparse(np.asarray(batch.index)[mask],
                           np.asarray(batch.value)[mask],
@@ -201,7 +224,8 @@ def main() -> int:
             return 1
         t_stage = time.monotonic() - t0
         binner.finalize()
-        mask = np.asarray(batch.value) != 0
+        mask = entry_mask(np.asarray(batch.row_ptr),
+                          int(batch.value.shape[0]))
         n_real = int(np.asarray(batch.weight).sum())
         print(f"staged {n_real} rows ({int(mask.sum())} nnz) "
               f"in {t_stage:.2f}s (bin cuts streamed per batch)", flush=True)
@@ -225,7 +249,7 @@ def main() -> int:
 
     # stage sparse batches to device, densify each into [rows, dim]
     t0 = time.monotonic()
-    it = DeviceStagingIter(data, batch_size=args.batch_size)
+    it = DeviceStagingIter(data, batch_size=args.batch_size, **stage_kw)
     dense_parts, label_parts = [], []
     densify = jax.jit(csr_to_dense_missing if args.missing else csr_to_dense,
                       static_argnums=(3, 4))

@@ -31,6 +31,7 @@ PAGES = [
     (REPO / "doc" / "parameter.md", "parameter.html", "Parameters"),
     (REPO / "doc" / "io.md", "io.html", "IO & filesystems"),
     (REPO / "doc" / "data.md", "data.html", "Data & staging"),
+    (REPO / "doc" / "staging.md", "staging.html", "Staging pipeline"),
     (REPO / "doc" / "tracker.md", "tracker.html", "Tracker & launchers"),
     (REPO / "doc" / "models.md", "models.html", "Models"),
     (REPO / "doc" / "api" / "README.md", "api.html", "API reference"),

@@ -16,7 +16,8 @@ from dmlc_core_tpu.models import GBDT, QuantileBinner
 FEATURES = 10
 
 
-def _batch(rng, rows, pad_rows=2, nnz_pad=8, with_qid=False, n_class=0):
+def _batch(rng, rows, pad_rows=2, nnz_pad=8, with_qid=False, n_class=0,
+           qid_base=0):
     """One synthetic PaddedBatch with trailing padding rows + pad lanes."""
     counts = rng.integers(1, 6, rows)
     total = rows + pad_rows
@@ -37,7 +38,13 @@ def _batch(rng, rows, pad_rows=2, nnz_pad=8, with_qid=False, n_class=0):
     else:
         label = ((dense0 > 1.2) ^ (rng.uniform(size=rows) > 0.9)
                  ).astype(np.float32)
-    qid = rng.integers(0, 6, rows).astype(np.int32) if with_qid else None
+    # sorted: rank:pairwise requires each query's rows to be a contiguous
+    # run (the production staging path reads qid-sorted files; random ids
+    # would split one query into many runs and change the pair set).
+    # qid_base keeps different batches' query ids disjoint so the
+    # CONCATENATED stream stays contiguous too.
+    qid = (qid_base + np.sort(rng.integers(0, 6, rows)).astype(np.int32)
+           if with_qid else None)
     pad = np.zeros(nnz_pad, np.float32)
     return PaddedBatch(
         label=jnp.asarray(np.concatenate([label, np.zeros(pad_rows)])),
@@ -157,7 +164,8 @@ def test_streamed_softmax_identical(batches):
 @pytest.mark.slow
 def test_streamed_rank_identical():
     rng = np.random.default_rng(2)
-    ranked = [_batch(rng, rows=90, with_qid=True) for _ in range(3)]
+    ranked = [_batch(rng, rows=90, with_qid=True, qid_base=6 * i)
+              for i in range(3)]
     binner = _binner(ranked)
     kw = dict(objective="rank:pairwise")
     streamed = _model(**kw).fit_streamed(ranked, binner)

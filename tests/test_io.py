@@ -189,3 +189,65 @@ def test_seek_stream_random_access(tmp_path):
         assert not s.seekable()
         with pytest.raises(NativeError, match="not seekable"):
             s.seek(1)
+
+
+def test_viewfs_alias_dispatches_to_webhdfs(monkeypatch):
+    """viewfs:// federation URIs resolve through the SAME WebHDFS backend
+    as hdfs:// (hdfs_filesys.cc registers both schemes on one factory): a
+    mock namenode+datanode serves GETFILESTATUS / OPEN(noredirect) / the
+    datanode GET, and both path_info and a full read of a viewfs:// path
+    land on those endpoints."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from dmlc_core_tpu.io import open_stream, path_info
+
+    payload = b"viewfs routes through webhdfs\n" * 40
+    hits = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # keep pytest output clean
+            pass
+
+        def do_GET(self):
+            hits.append(self.path)
+            if "op=GETFILESTATUS" in self.path:
+                body = json.dumps({"FileStatus": {
+                    "length": len(payload), "type": "FILE"}}).encode()
+            elif "op=OPEN" in self.path:
+                off = 0
+                for part in self.path.split("?", 1)[1].split("&"):
+                    if part.startswith("offset="):
+                        off = int(part.split("=", 1)[1])
+                body = json.dumps({"Location": (
+                    f"http://127.0.0.1:{port}/datanode/data.txt"
+                    f"?offset={off}")}).encode()
+            elif self.path.startswith("/datanode/"):
+                off = int(self.path.split("offset=")[1])
+                body = payload[off:]
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv("DMLCTPU_WEBHDFS_ADDR", f"127.0.0.1:{port}")
+        info = path_info("viewfs://ns-federation/data.txt")
+        assert (info.size, info.is_dir) == (len(payload), False)
+        with open_stream("viewfs://ns-federation/data.txt") as s:
+            assert s.read() == payload
+        # the viewfs:// URI really went over the WebHDFS wire protocol
+        assert any("/webhdfs/v1/data.txt" in h
+                   and "op=GETFILESTATUS" in h for h in hits)
+        assert any("op=OPEN" in h and "noredirect=true" in h for h in hits)
+        assert any(h.startswith("/datanode/") for h in hits)
+    finally:
+        srv.shutdown()
+        srv.server_close()

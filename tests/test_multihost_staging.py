@@ -594,3 +594,75 @@ def test_two_process_ffm_field_lane_end_to_end(tmp_path):
     assert r0["num_rows"] == 64
     assert r0["loss"] < 0.3 * r0["loss0"], (r0["loss0"], r0["loss"])
     assert r0["acc"] > 0.95, r0["acc"]
+
+
+_PARALLEL_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, f0, f1 = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dmlc_core_tpu.data import DeviceStagingIter
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+
+@jax.jit
+def wsum(label, weight):
+    return jnp.sum(label * weight)
+
+@jax.jit
+def vsum(value):
+    return jnp.sum(value)
+
+def drain(nw):
+    it = DeviceStagingIter(f0 if pid == 0 else f1, batch_size=16,
+                           nnz_bucket=8, nnz_max=32, sharding=sharding,
+                           format="libsvm", num_workers=nw)
+    sig = []
+    for b in it:
+        sig.append((int(b.num_rows), round(float(wsum(b.label, b.weight)), 6),
+                    round(float(vsum(b.value)), 6),
+                    np.asarray(b.row_ptr).tolist()))
+    return sig
+
+ref = drain(1)
+par = drain(2)
+assert par == ref, "2-worker multi-host stream diverged from 1-worker"
+print("RESULT " + json.dumps({"pid": pid, "batches": len(ref),
+                              "label_sum": sum(s[1] for s in ref)}),
+      flush=True)
+"""
+
+
+def test_two_process_staging_parallel_workers_lockstep(tmp_path):
+    """Multi-host lockstep with the sharded worker pool: each process
+    stages its (uneven) shard with num_workers=2 and must observe the
+    SAME global batch stream as with num_workers=1 — the per-batch
+    allgather rounds stay aligned because the pool is deterministic and
+    the virtual-part count depends only on the dataset, never on the
+    worker count."""
+    files, sums = [], []
+    for p, n_rows in ((0, 60), (1, 25)):
+        f = tmp_path / f"wpart{p}.libsvm"
+        lines, s = [], 0
+        for j in range(n_rows):
+            label = p * 1000 + j
+            nnz = (j % 5) + 1
+            feats = " ".join(f"{(j * 7 + k) % 97}:{k + 1}" for k in range(nnz))
+            lines.append(f"{label} {feats}")
+            s += label
+        f.write_text("\n".join(lines) + "\n")
+        files.append(str(f))
+        sums.append(s)
+
+    results, _ = _run_two(_PARALLEL_CHILD, files[0], files[1],
+                          label="parallel staging process")
+    assert set(results) == {0, 1}
+    assert results[0]["batches"] == results[1]["batches"]
+    assert results[0]["label_sum"] == results[1]["label_sum"]
+    assert results[0]["label_sum"] == float(sums[0] + sums[1])

@@ -187,12 +187,14 @@ def test_histogram_gh_shardmap_psum_matches_global():
         h = histogram_gh(b, r, g, n_nodes, B, force="pallas")
         return jax.lax.psum(h, "data")
 
-    # check_vma=False: pallas_call's out_shape carries no varying-axes
-    # annotation in jax 0.9, so the static replication check cannot see
-    # through it; the psum makes the output replicated regardless
-    sharded = jax.jit(jax.shard_map(
-        local_hist, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
-        out_specs=P(), check_vma=False))
+    # replication check off: pallas_call's out_shape carries no varying-axes
+    # annotation, so the static replication check cannot see through it; the
+    # psum makes the output replicated regardless.  shard_map_compat spells
+    # the flag (check_vma/check_rep) for whichever jax is installed.
+    from dmlc_core_tpu.parallel.collective import shard_map_compat
+    sharded = jax.jit(shard_map_compat(
+        local_hist, mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P(), check_replication=False))
     rows_sh = NamedSharding(mesh, P("data"))
     got = sharded(jax.device_put(jnp.asarray(bins), rows_sh),
                   jax.device_put(jnp.asarray(rel), rows_sh),
