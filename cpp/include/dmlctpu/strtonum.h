@@ -14,6 +14,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <limits>
+#include <string>
 #include <type_traits>
 
 #include "./base.h"
@@ -155,11 +156,24 @@ DMLCTPU_ALWAYS_INLINE bool TryParseNumTokenImpl(const char** p, const char* end,
     (void)r;
     if (s == end || IsSpaceChar(*s)) return false;
     char buf[128];
-    size_t n = std::min<size_t>(static_cast<size_t>(end - s), sizeof(buf) - 1);
+    size_t avail = static_cast<size_t>(end - s);
+    size_t n = std::min<size_t>(avail, sizeof(buf) - 1);
     std::memcpy(buf, s, n);
     buf[n] = '\0';
     char* endp = nullptr;
     double v = std::strtod(buf, &endp);
+    if (endp == buf + n && n < avail) {
+      // strtod consumed the whole truncated copy, so the numeric token may
+      // continue past it — reparse from a full-length heap copy instead of
+      // silently splitting one token into two
+      std::string full(s, end);
+      endp = nullptr;
+      v = std::strtod(full.c_str(), &endp);
+      if (endp == full.c_str()) return false;
+      *out = static_cast<T>(v);
+      *p = s + (endp - full.c_str());
+      return true;
+    }
     if (endp == buf) return false;
     *out = static_cast<T>(v);
     *p = s + (endp - buf);

@@ -80,7 +80,9 @@ class ShardedParser : public Parser<IndexType, DType> {
     TCHECK(spec.uri != "stdin" && spec.uri != "-")
         << "sharded parsing needs a seekable byte-range source, not stdin";
     virtual_parts_ = PickVirtualParts(spec.uri, num_parts);
-    Start();
+    // the pool starts on BeforeFirst (or lazily on the first Next): starting
+    // here would let the canonical create-then-BeforeFirst sequence discard
+    // in-flight parse work whose bytes already hit bytes_read_
   }
 
   ~ShardedParser() override { Stop(); }
@@ -102,6 +104,7 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   bool Next() override {
+    if (workers_.empty()) Start();  // direct use without a BeforeFirst
     while (true) {
       while (blk_ptr_ < cur_blocks_.size()) {
         if (cur_blocks_[blk_ptr_].Size() == 0) {
@@ -209,11 +212,6 @@ class ShardedParser : public Parser<IndexType, DType> {
         num_parts_ * virtual_parts_, format_.c_str());
     auto* impl = dynamic_cast<ParserImpl<IndexType, DType>*>(parser.get());
     size_t last_bytes = 0;
-    auto note_bytes = [&] {
-      size_t nb = parser->BytesRead();
-      bytes_read_.fetch_add(nb - last_bytes, std::memory_order_relaxed);
-      last_bytes = nb;
-    };
     for (;;) {
       Blocks blocks;
       if (impl != nullptr) {
@@ -224,7 +222,9 @@ class ShardedParser : public Parser<IndexType, DType> {
         blocks.emplace_back();
         blocks.back().Push(parser->Value());
       }
-      note_bytes();
+      size_t nb = parser->BytesRead();
+      size_t delta = nb - last_bytes;
+      last_bytes = nb;
       size_t cost = 0;
       for (const auto& b : blocks) cost += b.MemCostBytes();
       {
@@ -236,10 +236,19 @@ class ShardedParser : public Parser<IndexType, DType> {
         if (stop_ || error_) return;
         parts_[j].q.emplace_back(std::move(blocks), cost);
         buffered_bytes_ += cost;
+        // count bytes only once their blocks are published, so work that a
+        // Stop/BeforeFirst discards never lands in BytesRead (bench derives
+        // throughput from its deltas)
+        bytes_read_.fetch_add(delta, std::memory_order_relaxed);
       }
       cv_consume_.notify_all();
     }
-    note_bytes();
+    // tail bytes past the last published chunk (EOF detection): still real
+    // reads of this part, but drop them if the epoch is being torn down
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_ || error_) return;
+    bytes_read_.fetch_add(parser->BytesRead() - last_bytes,
+                          std::memory_order_relaxed);
   }
 
   /*! \brief pull the next Blocks into cur_blocks_; false at end of epoch */
@@ -263,6 +272,10 @@ class ShardedParser : public Parser<IndexType, DType> {
           if (it->second.done) {
             parts_.erase(it);
             ++emit_part_;
+            // a producer blocked on the full buffer may have just become
+            // the emit part (its wait exemption turned true): wake it, or
+            // the pipeline wedges with everyone asleep
+            cv_produce_.notify_all();
             continue;
           }
         } else if (emit_part_ >= virtual_parts_) {

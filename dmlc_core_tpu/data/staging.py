@@ -200,6 +200,11 @@ def _parallel_parts_iter(open_part, num_virtual: int, num_workers: int,
                             del parts[j]
                             done.discard(j)
                             state["emit"] += 1
+                            # a worker parked on the full-buffer wait may
+                            # have just become the emit part (its wait
+                            # exemption turned true): wake it or the pool
+                            # wedges with producers and consumer all asleep
+                            cond.notify_all()
                             continue
                     else:
                         got = next((j for j, q in parts.items() if q), None)
@@ -208,9 +213,12 @@ def _parallel_parts_iter(open_part, num_virtual: int, num_workers: int,
                             state["buffered"] -= 1
                             cond.notify_all()
                             break
-                        for j in [j for j in parts if j in done]:
+                        drained = [j for j in parts if j in done]
+                        for j in drained:
                             del parts[j]
                             done.discard(j)
+                        if drained:
+                            cond.notify_all()
                         if state["next_claim"] >= num_virtual and not parts:
                             return
                     cond.wait()
@@ -526,6 +534,7 @@ class RecordStagingIter:
         self._reorder = reorder
         self._virtual_parts = 0  # resolved lazily on the first parallel epoch
         self._parallel_bytes = 0
+        self._bytes_lock = threading.Lock()  # _parallel_bytes += on workers
         self._lock = threading.Lock()
         self.batches_staged = 0
 
@@ -616,8 +625,10 @@ class RecordStagingIter:
             while check(L.DmlcTpuRecordBatcherNext(h, ctypes.byref(c))) == 1:
                 yield self._wrap_host(c)
         finally:
-            self._parallel_bytes += L.DmlcTpuRecordBatcherBytesRead(h)
+            nb = L.DmlcTpuRecordBatcherBytesRead(h)
             L.DmlcTpuRecordBatcherFree(h)
+            with self._bytes_lock:  # += is not atomic across pool workers
+                self._parallel_bytes += nb
 
     def _produce_host(self, emit) -> None:
         """Drive the native read+pack, emitting host batch dicts."""

@@ -338,3 +338,22 @@ def test_parallel_parts_pool_order_error_and_close():
     t0 = time.monotonic()
     it.close()  # workers blocked on a full buffer must unblock and join
     assert time.monotonic() - t0 < 10
+
+
+def test_parallel_parts_pool_full_buffer_part_boundary():
+    """Regression: with the buffer saturated across a part boundary, the
+    consumer's emit-part advance must wake producers whose full-buffer
+    exemption just became true, or the pool wedges with every thread
+    asleep.  max_buffered=1 makes a full buffer at every boundary the
+    common case rather than a scheduling fluke."""
+    from dmlc_core_tpu.data.staging import _parallel_parts_iter
+
+    def open_part(j):
+        yield from ((j, k) for k in range(7))
+
+    want = [(j, k) for j in range(16) for k in range(7)]
+    for _ in range(20):
+        for nw in (2, 4):
+            got = list(_parallel_parts_iter(open_part, 16, nw, True,
+                                            max_buffered=1))
+            assert got == want
