@@ -329,6 +329,28 @@ def run_parse(data: Path, fmt: str = "libsvm", repeats: int = 4) -> dict:
         rate = (nbytes / (1 << 20)) / secs
         if rate > best["mb_s"]:
             best = {"mb_s": rate, "rows": rows, "secs": secs}
+
+    # pool-scaling sweep: the persistent parse pool is judged on scaling,
+    # not just the headline rate, so land MB/s per nthread in BENCH_* too
+    sep = "&" if "?" in uri else "?"
+    sweep = {}
+    for nt in (1, 2, 4):
+        nt_rate = 0.0
+        for _ in range(2):
+            h = ctypes.c_void_p()
+            check(L.DmlcTpuParserCreate(f"{uri}{sep}nthread={nt}".encode(),
+                                        0, 1, ptype, ctypes.byref(h)))
+            check(L.DmlcTpuParserBeforeFirst(h))
+            c = RowBlockC()
+            t0 = time.monotonic()
+            while check(L.DmlcTpuParserNext(h, ctypes.byref(c))) == 1:
+                pass
+            secs = time.monotonic() - t0
+            nbytes = L.DmlcTpuParserBytesRead(h)
+            L.DmlcTpuParserFree(h)
+            nt_rate = max(nt_rate, (nbytes / (1 << 20)) / secs)
+        sweep[f"nthread{nt}"] = round(nt_rate, 2)
+    best["nthread_mb_s"] = sweep
     return best
 
 

@@ -42,6 +42,12 @@ int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
 int DmlcTpuParserCreateEx(const char* uri, unsigned part, unsigned num_parts,
                           const char* format, int num_workers, int reorder,
                           uint64_t buffer_bytes, DmlcTpuParserHandle* out);
+/*! \brief pin the default parse-thread pool size for parsers created WITHOUT
+ *  an explicit ?nthread= URI arg (an explicit value always wins).  0 restores
+ *  the per-parser heuristic max(cores/2 - 4, 1).  Takes effect for parsers
+ *  created after the call. */
+int DmlcTpuSetDefaultParseThreads(int nthread);
+int DmlcTpuGetDefaultParseThreads(int* out);
 int DmlcTpuParserNext(DmlcTpuParserHandle handle, DmlcTpuRowBlockC* out);
 int DmlcTpuParserBeforeFirst(DmlcTpuParserHandle handle);
 int64_t DmlcTpuParserBytesRead(DmlcTpuParserHandle handle);

@@ -131,6 +131,17 @@ class RowBlockIter : public DataIter<RowBlock<IndexType, DType>> {
   virtual size_t NumCol() const = 0;
 };
 
+namespace data {
+/*!
+ * \brief pin the process-wide default parse-thread pool size used by text
+ *        parsers created without an explicit ?nthread= URI arg.
+ *        0 (the initial value) restores the per-parser heuristic
+ *        max(cores/2 - 4, 1); an explicit ?nthread= always wins over both.
+ */
+void SetDefaultParseThreads(int nthread);
+int GetDefaultParseThreads();
+}  // namespace data
+
 /*! \brief registry entry for parser factories (plugin surface) */
 template <typename IndexType, typename DType = real_t>
 struct ParserFactoryReg

@@ -39,6 +39,17 @@ struct RowBlockContainer {
     max_field = 0;
     max_index = 0;
   }
+  /*!
+   * \brief pre-size the hot columns (parser recycling hint: the previous
+   *        chunk's shape predicts this one's, so steady-state parsing does
+   *        zero large allocations)
+   */
+  void Reserve(size_t rows, size_t nnz) {
+    offset.reserve(rows + 1);
+    label.reserve(rows);
+    index.reserve(nnz);
+    value.reserve(nnz);
+  }
   size_t MemCostBytes() const {
     return offset.size() * sizeof(size_t) + label.size() * sizeof(real_t) +
            weight.size() * sizeof(real_t) + qid.size() * sizeof(uint64_t) +

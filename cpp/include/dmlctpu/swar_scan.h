@@ -1,0 +1,138 @@
+// dmlctpu/swar_scan.h — word-at-a-time (SWAR) byte scanning for the text
+// parser hot path: line-terminator / field-separator search and ASCII digit
+// runs, 8 bytes per step instead of 1.
+//
+// Memory-safety contract: every 8-byte load stays strictly inside the
+// caller's [p, end) range (loads are guarded by `end - p >= 8`); tails
+// shorter than a word fall back to bytewise loops.  Chunk buffers only
+// guarantee a single dereferenceable sentinel byte past `end`
+// (split_base.cc writes '\0' there), so wider overreads are NOT allowed.
+//
+// First-match exactness: the classic haszero trick
+// (x - 0x01..01) & ~x & 0x80..80 can set spurious high bits only ABOVE the
+// first true match (borrow propagation runs low→high), and is exactly zero
+// when no byte matches — so ctz on the mask always finds the first match,
+// and a zero mask always means "advance a full word".
+#ifndef DMLCTPU_SWAR_SCAN_H_
+#define DMLCTPU_SWAR_SCAN_H_
+
+#include <cstdint>
+#include <cstring>
+
+#include "./base.h"
+
+#if defined(__BYTE_ORDER__) && (__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__) && \
+    (defined(__GNUC__) || defined(__clang__))
+#define DMLCTPU_SWAR_ENABLED 1
+#else
+#define DMLCTPU_SWAR_ENABLED 0
+#endif
+
+namespace dmlctpu {
+namespace swar {
+
+constexpr uint64_t kOnes = 0x0101010101010101ull;
+constexpr uint64_t kHigh = 0x8080808080808080ull;
+constexpr uint64_t kLow7 = 0x7F7F7F7F7F7F7F7Full;
+constexpr uint64_t kZeros = 0x3030303030303030ull;  // "00000000"
+
+DMLCTPU_ALWAYS_INLINE uint64_t LoadWord(const char* p) {
+  uint64_t w;
+  std::memcpy(&w, p, sizeof(w));  // alignment-safe; compiles to one mov
+  return w;
+}
+
+/*! \brief high bit set in every byte of w that is zero (first match exact) */
+DMLCTPU_ALWAYS_INLINE uint64_t ZeroByteMask(uint64_t w) {
+  return (w - kOnes) & ~w & kHigh;
+}
+
+/*! \brief high bit set in every byte of w equal to c (first match exact) */
+DMLCTPU_ALWAYS_INLINE uint64_t MatchByteMask(uint64_t w, char c) {
+  return ZeroByteMask(w ^ (kOnes * static_cast<uint8_t>(c)));
+}
+
+#if DMLCTPU_SWAR_ENABLED
+/*! \brief byte index (0-7) of the lowest-address set high bit in a mask */
+DMLCTPU_ALWAYS_INLINE int FirstMatchIndex(uint64_t mask) {
+  return __builtin_ctzll(mask) >> 3;
+}
+#endif
+
+/*!
+ * \brief mask of bytes that are NOT ASCII digits.  Exact per byte (no borrow
+ *        propagation: the adds below stay within each byte), so both the
+ *        first non-digit position and the all-digits case are reliable.
+ */
+DMLCTPU_ALWAYS_INLINE uint64_t NonDigitMask(uint64_t w) {
+  const uint64_t x = w ^ kZeros;  // digit bytes become 0x00..0x09
+  // bit7(t) per byte = (low7 >= 10) || (byte >= 0x80)  → not a digit
+  const uint64_t t = ((x & kLow7) + (kOnes * 0x76)) | x;  // 0x76 = 0x7F - 9
+  return t & kHigh;
+}
+
+#if DMLCTPU_SWAR_ENABLED
+/*! \brief number of consecutive ASCII digit bytes at the start of w (0..8) */
+DMLCTPU_ALWAYS_INLINE int DigitPrefixLen(uint64_t w) {
+  const uint64_t nd = NonDigitMask(w);
+  return nd == 0 ? 8 : FirstMatchIndex(nd);
+}
+
+/*!
+ * \brief convert a word of exactly eight ASCII digits (first digit in the
+ *        lowest byte) to its numeric value — three multiplies, no loop.
+ */
+DMLCTPU_ALWAYS_INLINE uint32_t ParseEightDigits(uint64_t w) {
+  const uint64_t mask = 0x000000FF000000FFull;
+  const uint64_t mul1 = 0x000F424000000064ull;  // 100 + (1000000 << 32)
+  const uint64_t mul2 = 0x0000271000000001ull;  // 1 + (10000 << 32)
+  w -= kZeros;
+  w = (w * 10) + (w >> 8);  // adjacent digit pairs → 0..99 per 16-bit lane
+  return static_cast<uint32_t>(
+      (((w & mask) * mul1) + (((w >> 16) & mask) * mul2)) >> 32);
+}
+
+/*!
+ * \brief value of the first n (1..8) digit bytes of w: left-pad the number
+ *        with ASCII zeros by shifting it to the high bytes, then convert as
+ *        eight digits.
+ */
+DMLCTPU_ALWAYS_INLINE uint32_t ParseDigitPrefix(uint64_t w, int n) {
+  if (n < 8) w = (w << ((8 - n) * 8)) | (kZeros >> (n * 8));
+  return ParseEightDigits(w);
+}
+#endif  // DMLCTPU_SWAR_ENABLED
+
+/*! \brief first '\n', '\r', or NUL in [p, end), or end */
+inline const char* FindLineEnd(const char* p, const char* end) {
+#if DMLCTPU_SWAR_ENABLED
+  while (end - p >= 8) {
+    const uint64_t w = LoadWord(p);
+    const uint64_t m =
+        ZeroByteMask(w) | MatchByteMask(w, '\n') | MatchByteMask(w, '\r');
+    if (m != 0) return p + FirstMatchIndex(m);
+    p += 8;
+  }
+#endif
+  while (p != end && *p != '\n' && *p != '\r' && *p != '\0') ++p;
+  return p;
+}
+
+/*! \brief first delim, '\n', '\r', or NUL in [p, end), or end */
+inline const char* FindCellEnd(const char* p, const char* end, char delim) {
+#if DMLCTPU_SWAR_ENABLED
+  while (end - p >= 8) {
+    const uint64_t w = LoadWord(p);
+    const uint64_t m = ZeroByteMask(w) | MatchByteMask(w, '\n') |
+                       MatchByteMask(w, '\r') | MatchByteMask(w, delim);
+    if (m != 0) return p + FirstMatchIndex(m);
+    p += 8;
+  }
+#endif
+  while (p != end && *p != delim && *p != '\n' && *p != '\r' && *p != '\0') ++p;
+  return p;
+}
+
+}  // namespace swar
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SWAR_SCAN_H_

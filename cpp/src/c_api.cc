@@ -242,6 +242,20 @@ int DmlcTpuParserCreateEx(const char* uri, unsigned part, unsigned num_parts,
   });
 }
 
+int DmlcTpuSetDefaultParseThreads(int nthread) {
+  return Guard([&] {
+    dmlctpu::data::SetDefaultParseThreads(nthread);
+    return 0;
+  });
+}
+
+int DmlcTpuGetDefaultParseThreads(int* out) {
+  return Guard([&] {
+    *out = dmlctpu::data::GetDefaultParseThreads();
+    return 0;
+  });
+}
+
 int DmlcTpuParserNext(DmlcTpuParserHandle handle, DmlcTpuRowBlockC* out) {
   return Guard([&] {
     auto* ctx = static_cast<ParserCtx*>(handle);

@@ -16,6 +16,7 @@
 #include "./text_parser.h"
 #include "dmlctpu/parameter.h"
 #include "dmlctpu/strtonum.h"
+#include "dmlctpu/swar_scan.h"
 
 namespace dmlctpu {
 namespace data {
@@ -74,8 +75,12 @@ class CSVParser : public TextParserBase<IndexType, DType> {
         while ((*p == ' ' || *p == '\t') && *p != delim_) ++p;
         DType v{};
         bool has_value = TryParseNumTokenUnsafe(&p, end, &v);
-        // advance to the cell boundary (tolerates trailing junk in the cell)
-        while (*p != delim_ && *p != '\n' && *p != '\r' && *p != '\0') ++p;
+        // advance to the cell boundary (tolerates trailing junk in the
+        // cell); a parsed token usually lands exactly on it, so test once
+        // bytewise before the word-at-a-time scan
+        if (*p != delim_ && *p != '\n' && *p != '\r' && *p != '\0') {
+          p = swar::FindCellEnd(p + 1, end, delim_);
+        }
         if (column == param_.label_column) {
           if (has_value) label = v;
         } else if (std::is_same_v<DType, real_t> && column == param_.weight_column) {

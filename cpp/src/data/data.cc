@@ -5,6 +5,7 @@
 // registrations:230-256, explicit instantiations:114-221).
 #include "dmlctpu/data.h"
 
+#include <atomic>
 #include <cstring>
 #include <memory>
 #include <string>
@@ -31,13 +32,25 @@ DMLCTPU_REGISTRY_ENABLE(ParserFactoryReg<uint64_t, int64_t>);
 
 namespace data {
 
+// process-wide default parse-pool size; 0 = per-parser heuristic.  Set via
+// the C API DmlcTpuSetDefaultParseThreads to pin the pool without threading
+// ?nthread= through every URI.
+static std::atomic<int> g_default_parse_threads{0};
+void SetDefaultParseThreads(int nthread) {
+  g_default_parse_threads.store(nthread, std::memory_order_relaxed);
+}
+int GetDefaultParseThreads() {
+  return g_default_parse_threads.load(std::memory_order_relaxed);
+}
+
 template <template <typename, typename> class ParserCls, typename IndexType, typename DType>
 Parser<IndexType, DType>* CreateTextParser(const std::string& path,
                                            const std::map<std::string, std::string>& args,
                                            unsigned part, unsigned num_parts) {
   auto source = InputSplit::Create(path.c_str(), part, num_parts, "text");
-  // parse threads from the ?nthread= URI arg; default 2 like the reference
-  int nthread = 2;
+  // parse threads from the ?nthread= URI arg; 0 = resolve the default in
+  // TextParserBase (pinned pool size, else the cores/2-4 heuristic)
+  int nthread = 0;
   auto it = args.find("nthread");
   std::map<std::string, std::string> parser_args = args;
   if (it != args.end()) {

@@ -11,6 +11,7 @@
 #include <utility>
 
 #include "./parser_impl.h"
+#include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/stream.h"
 #include "dmlctpu/threaded_iter.h"
@@ -25,6 +26,9 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
   using Container = RowBlockContainer<IndexType, DType>;
   static constexpr size_t kPageBytes = 64u << 20u;
   static constexpr uint64_t kCacheMagic = 0x74707564726f7769ull;  // "tpudrowi"
+  /*! \brief payload-bytes sentinel written before the pass; a cache whose
+   *  header still carries it was cut short mid-build */
+  static constexpr uint64_t kPayloadUnknown = ~0ull;
 
   DiskRowIter(std::unique_ptr<Parser<IndexType, DType>> parser, const char* cache_file,
               bool reuse_cache)
@@ -49,8 +53,23 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
   bool TryLoadCache() {
     auto fi = SeekStream::CreateForRead(cache_file_.c_str(), /*allow_null=*/true);
     if (fi == nullptr) return false;
-    uint64_t magic, ncol;
-    if (!fi->ReadObj(&magic) || magic != kCacheMagic || !fi->ReadObj(&ncol)) return false;
+    uint64_t magic, ncol, payload;
+    if (!fi->ReadObj(&magic) || magic != kCacheMagic || !fi->ReadObj(&ncol) ||
+        !fi->ReadObj(&payload)) {
+      return false;
+    }
+    // validate the recorded payload size against the file on disk: a build
+    // cut short (crash, ENOSPC) or a truncated copy must fall back to
+    // rebuilding instead of crashing mid-page-Load during the epoch
+    size_t header_end = fi->Tell();
+    io::URI uri(cache_file_.c_str());
+    size_t actual = io::FileSystem::GetInstance(uri)->GetPathInfo(uri).size;
+    if (payload == kPayloadUnknown || header_end + payload != actual) {
+      TLOG(Warning) << "cache " << cache_file_ << " is truncated or stale ("
+                    << actual << " bytes on disk, header promises "
+                    << header_end << "+" << payload << "); rebuilding";
+      return false;
+    }
     num_col_ = ncol;
     fi_ = std::move(fi);
     data_begin_ = fi_->Tell();
@@ -65,9 +84,10 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
 
   void BuildCache(Parser<IndexType, DType>* parser) {
     auto fo = Stream::Create(cache_file_.c_str(), "w");
-    uint64_t magic = kCacheMagic, ncol = 0;
+    uint64_t magic = kCacheMagic, ncol = 0, payload = kPayloadUnknown;
     fo->WriteObj(magic);
-    fo->WriteObj(ncol);  // patched after the pass
+    fo->WriteObj(ncol);     // patched after the pass
+    fo->WriteObj(payload);  // sentinel until the pass lands intact
     Container page;
     Stopwatch watch;
     size_t rows = 0;
@@ -87,14 +107,18 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
     }
     fo->Close();  // surface a failed cache write here, not in ~Stream
     fo.reset();
-    // patch the column count in the header
+    // patch the column count and the payload size in the header; the
+    // payload write is LAST so any earlier failure leaves the sentinel in
+    // place and the next open rebuilds
     {
       std::FILE* fp = std::fopen(cache_file_.c_str(), "r+b");
       TCHECK(fp != nullptr);
+      std::fseek(fp, 0, SEEK_END);
+      uint64_t patched[2] = {ncol, static_cast<uint64_t>(std::ftell(fp)) -
+                                       3 * sizeof(uint64_t)};
+      if (kIONeedsByteSwap) ByteSwap(patched, sizeof(patched[0]), 2);
       std::fseek(fp, sizeof(uint64_t), SEEK_SET);
-      uint64_t ncol_le = ncol;
-      if (kIONeedsByteSwap) ByteSwap(&ncol_le, sizeof(ncol_le), 1);
-      std::fwrite(&ncol_le, sizeof(ncol_le), 1, fp);
+      std::fwrite(patched, sizeof(patched[0]), 2, fp);
       std::fclose(fp);
     }
     double elapsed = watch.Elapsed();

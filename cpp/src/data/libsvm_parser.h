@@ -79,8 +79,18 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
         }
         out->weight.push_back(weight);
       }
-      // ---- optional qid:n, then features idx[:val] until end of line
-      bool at_qid_slot = true;
+      // ---- optional qid:n — only the first slot can hold it, so the check
+      // lives here, not on every token of the feature loop
+      while (*p == ' ' || *p == '\t') ++p;  // sentinel-terminated scan
+      if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
+        p += 4;
+        uint64_t qid = ParseNum<uint64_t>(&p, end);
+        if (out->qid.size() + 1 < out->label.size()) {
+          out->qid.resize(out->label.size() - 1, 0);
+        }
+        out->qid.push_back(qid);
+      }
+      // ---- features idx[:val] until end of line
       while (true) {
         // sentinel-terminated scans (chunk buffers end with '\0')
         while (*p == ' ' || *p == '\t') ++p;
@@ -88,18 +98,6 @@ class LibSVMParser : public TextParserBase<IndexType, DType> {
         if (*p == '#') {  // trailing comment: discard rest of line
           DiscardLine(&p, end);
           break;
-        }
-        if (at_qid_slot) {
-          at_qid_slot = false;
-          if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
-            p += 4;
-            uint64_t qid = ParseNum<uint64_t>(&p, end);
-            if (out->qid.size() + 1 < out->label.size()) {
-              out->qid.resize(out->label.size() - 1, 0);
-            }
-            out->qid.push_back(qid);
-            continue;
-          }
         }
         IndexType idx;
         DType val;

@@ -27,8 +27,10 @@ class ParserImpl : public Parser<IndexType, DType> {
 
   void BeforeFirst() override {
     at_head_ = true;
-    blk_ptr_ = 0;
-    data_.clear();
+    // keep data_'s containers: their heap storage is recycled by the next
+    // epoch's ParseNext (vector capacity survives Clear/resize), so epoch
+    // restarts do not re-pay the steady-state allocations
+    blk_ptr_ = data_.size();
   }
   bool Next() override {
     while (true) {

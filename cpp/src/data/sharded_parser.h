@@ -98,7 +98,10 @@ class ShardedParser : public Parser<IndexType, DType> {
       error_ = nullptr;
       stop_ = false;
     }
-    cur_blocks_.clear();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      RecycleCurBlocks();
+    }
     blk_ptr_ = 0;
     Start();
   }
@@ -215,6 +218,15 @@ class ShardedParser : public Parser<IndexType, DType> {
     for (;;) {
       Blocks blocks;
       if (impl != nullptr) {
+        // recycle consumed containers: their heap storage (vector capacity)
+        // survives the round trip, so steady-state parsing allocates nothing
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (!free_pool_.empty()) {
+            blocks = std::move(free_pool_.back());
+            free_pool_.pop_back();
+          }
+        }
         if (!impl->CallParseNext(&blocks)) break;
       } else {
         // fallback for parser types that hide their impl: copy block views
@@ -302,11 +314,23 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   void TakeFront(PartQueue* pq) {
+    RecycleCurBlocks();
     cur_blocks_ = std::move(pq->q.front().first);
     buffered_bytes_ -= pq->q.front().second;
     pq->q.pop_front();
     blk_ptr_ = 0;
     cv_produce_.notify_all();
+  }
+
+  /*! \brief hand the drained cur_blocks_ storage back to the producers
+   *  (caller holds mu_); Clear() keeps each container's capacity */
+  void RecycleCurBlocks() {
+    if (cur_blocks_.empty()) return;
+    if (free_pool_.size() < static_cast<size_t>(2 * num_workers_)) {
+      for (auto& b : cur_blocks_) b.Clear();
+      free_pool_.push_back(std::move(cur_blocks_));
+    }
+    cur_blocks_.clear();
   }
 
   const std::string uri_;
@@ -328,6 +352,7 @@ class ShardedParser : public Parser<IndexType, DType> {
   bool stop_ = false;
   std::exception_ptr error_;
   std::vector<std::thread> workers_;
+  std::vector<Blocks> free_pool_;  // consumed containers awaiting reuse (mu_)
   std::atomic<size_t> bytes_read_{0};
 
   Blocks cur_blocks_;

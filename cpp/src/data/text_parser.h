@@ -1,18 +1,25 @@
-// text_parser.h — chunk → N worker threads, each parsing a newline-aligned
-// byte range into its own RowBlockContainer, with exception relay.
-// Parity: reference src/data/text_parser.h (FillData:110-146, nthread
-// heuristic:33-34, UTF-8 BOM skip:81).
+// text_parser.h — chunk → persistent worker pool, each worker parsing a
+// newline-aligned byte range into its own RowBlockContainer, with exception
+// relay.  Parity: reference src/data/text_parser.h (FillData:110-146, nthread
+// heuristic:33-34, UTF-8 BOM skip:81); the pool replaces the reference's
+// per-chunk std::thread spawn/join, which charges N thread creations to
+// every chunk and caps small-chunk throughput.
 #ifndef DMLCTPU_SRC_DATA_TEXT_PARSER_H_
 #define DMLCTPU_SRC_DATA_TEXT_PARSER_H_
 
 #include <algorithm>
+#include <condition_variable>
 #include <memory>
+#include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
 
 #include "./parser_impl.h"
 #include "dmlctpu/common.h"
 #include "dmlctpu/input_split.h"
+#include "dmlctpu/swar_scan.h"
+#include "dmlctpu/thread_group.h"
 
 namespace dmlctpu {
 namespace data {
@@ -24,14 +31,28 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
 
   TextParserBase(std::unique_ptr<InputSplit> source, int nthread)
       : source_(std::move(source)) {
-    unsigned cores = std::thread::hardware_concurrency();
-    int cap = std::max(static_cast<int>(cores) / 2 - 4, 1);
-    nthread_ = std::max(std::min(nthread, cap), 1);
+    if (nthread > 0) {
+      // an explicit caller value wins uncapped: the old unconditional
+      // max(cores/2-4, 1) cap silently forced nthread=1 on <=9-core hosts
+      // even when the caller asked for more
+      nthread_ = nthread;
+    } else {
+      int pinned = GetDefaultParseThreads();
+      nthread_ = pinned > 0 ? pinned : HeuristicThreads();
+    }
   }
+
+  ~TextParserBase() override { StopPool(); }
 
   void BeforeFirst() override {
     ParserImpl<IndexType, DType>::BeforeFirst();
     source_->BeforeFirst();
+  }
+
+  /*! \brief heuristic default: leave cores for decode/stage/compute */
+  static int HeuristicThreads() {
+    unsigned cores = std::thread::hardware_concurrency();
+    return std::max(static_cast<int>(cores) / 2 - 4, 1);
   }
 
  protected:
@@ -50,43 +71,60 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
     const int nthread = nthread_;
     data->resize(nthread);
     if (nthread == 1) {
+      (*data)[0].Reserve(hint_rows_, hint_nnz_);
       ParseBlock(head, tail, &(*data)[0]);
+      UpdateHints(*data);
       return true;
     }
-    // newline-aligned sub-ranges, one worker thread each
-    std::vector<std::thread> workers;
-    ExceptionRelay relay;
+    // newline-aligned sub-ranges: range 0 for the coordinator, the rest for
+    // the parked pool workers
+    EnsurePool();
     size_t total = static_cast<size_t>(tail - head);
     size_t step = (total + nthread - 1) / nthread;
     const char* range_begin = head;
-    for (int t = 0; t < nthread; ++t) {
-      const char* range_end =
-          (t + 1 == nthread) ? tail : BackFindLineEnd(head + std::min((t + 1) * step, total),
-                                                      range_begin, tail);
-      auto* out = &(*data)[t];
-      const char* b = range_begin;
-      const char* e = range_end;
-      workers.emplace_back([this, b, e, out, &relay] {
-        relay.Run([&] { this->ParseBlock(b, e, out); });
-      });
-      range_begin = range_end;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      for (int t = 0; t < nthread; ++t) {
+        const char* range_end =
+            (t + 1 == nthread)
+                ? tail
+                : BackFindLineEnd(head + std::min((t + 1) * step, total),
+                                  range_begin, tail);
+        (*data)[t].Reserve(hint_rows_, hint_nnz_);
+        jobs_[t] = Job{range_begin, range_end, &(*data)[t]};
+        range_begin = range_end;
+      }
+      pending_ = nthread - 1;
+      ++generation_;
     }
-    for (auto& w : workers) w.join();
-    relay.Rethrow();
+    pool_cv_.notify_all();
+    // the coordinator is worker 0: it parses its own range instead of
+    // sleeping through the dispatch (workers never touch slot 0)
+    relay_.Run([&] { ParseBlock(jobs_[0].begin, jobs_[0].end, jobs_[0].out); });
+    {
+      std::unique_lock<std::mutex> lk(pool_mu_);
+      done_cv_.wait(lk, [this] { return pending_ == 0; });
+    }
+    relay_.Rethrow();
+    UpdateHints(*data);
     return true;
   }
 
   /*! \brief advance to the current line's terminator ('\n', bare '\r', or NUL) */
   static void DiscardLine(const char** p, const char* end) {
-    while (*p != end && **p != '\n' && **p != '\r' && **p != '\0') ++*p;
+    *p = swar::FindLineEnd(*p, end);
   }
 
-  /*! \brief step backward/forward to a line boundary so ranges do not split lines */
+  /*! \brief step forward to a line boundary so ranges do not split lines */
   static const char* BackFindLineEnd(const char* p, const char* begin, const char* end) {
     if (p >= end) return end;
     // advance to just past the next newline (forward search keeps ranges
     // non-overlapping when lines are long)
-    while (p != end && *p != '\n' && *p != '\r') ++p;
+    for (;;) {
+      p = swar::FindLineEnd(p, end);
+      if (p == end || *p != '\0') break;
+      ++p;  // an embedded NUL is data here, not a line terminator
+    }
     while (p != end && (*p == '\n' || *p == '\r')) ++p;
     (void)begin;
     return p;
@@ -100,6 +138,81 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
 
   std::unique_ptr<InputSplit> source_;
   int nthread_;
+
+ private:
+  struct Job {
+    const char* begin = nullptr;
+    const char* end = nullptr;
+    RowBlockContainer<IndexType, DType>* out = nullptr;
+  };
+
+  /*! \brief lazily start the nthread_-1 parked workers (slots 1..nthread_-1) */
+  void EnsurePool() {
+    if (!pool_.empty()) return;
+    jobs_.resize(nthread_);
+    pool_.reserve(nthread_ - 1);
+    for (int w = 1; w < nthread_; ++w) {
+      pool_.push_back(group_.Create(
+          "parse-worker-" + std::to_string(w),
+          [this, w](ThreadGroup::Thread& self) { WorkerLoop(w, self); }));
+    }
+  }
+
+  void WorkerLoop(int slot, ThreadGroup::Thread& self) {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(pool_mu_);
+    while (!self.stop_requested()) {
+      pool_cv_.wait(lk, [&] { return pool_stop_ || generation_ != seen; });
+      if (pool_stop_) return;
+      seen = generation_;
+      Job job = jobs_[slot];
+      lk.unlock();
+      relay_.Run([&] { ParseBlock(job.begin, job.end, job.out); });
+      lk.lock();
+      if (--pending_ == 0) done_cv_.notify_one();
+    }
+  }
+
+  /*! \brief wake and join all pool workers; safe when the pool never started.
+   *  No job is in flight here: ParseNext always drains pending_ before
+   *  returning, and the dtor runs with no concurrent ParseNext. */
+  void StopPool() {
+    if (pool_.empty()) return;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      pool_stop_ = true;
+    }
+    pool_cv_.notify_all();
+    group_.JoinAll();
+    pool_.clear();
+  }
+
+  /*! \brief carry per-range size hints to the next chunk so recycled (or
+   *  fresh) containers start at steady-state capacity */
+  void UpdateHints(const Blocks& data) {
+    size_t rows = 0, nnz = 0;
+    for (const auto& b : data) {
+      rows = std::max(rows, b.label.size());
+      nnz = std::max(nnz, b.index.size());
+    }
+    hint_rows_ = rows;
+    hint_nnz_ = nnz;
+  }
+
+  // pool state: jobs are published under pool_mu_ with a generation bump;
+  // each worker runs its slot once per generation and parks again
+  ThreadGroup group_;
+  std::vector<std::shared_ptr<ThreadGroup::Thread>> pool_;
+  std::vector<Job> jobs_;
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;
+  std::condition_variable done_cv_;
+  uint64_t generation_ = 0;
+  int pending_ = 0;
+  bool pool_stop_ = false;
+  ExceptionRelay relay_;
+  size_t hint_rows_ = 0;
+  size_t hint_nnz_ = 0;
 };
 
 }  // namespace data

@@ -9,9 +9,11 @@
 #include <string>
 #include <vector>
 
+#include "../src/data/libsvm_parser.h"
 #include "../src/data/record_batcher.h"
 #include "../src/data/staged_batcher.h"
 #include "dmlctpu/data.h"
+#include "dmlctpu/input_split.h"
 #include "dmlctpu/row_block.h"
 #include "dmlctpu/stream.h"
 #include "dmlctpu/temp_dir.h"
@@ -230,6 +232,123 @@ TESTCASE(csv_no_label_column_noeol) {
   EXPECT_EQV(all.label[0], 0.0f);  // no label column → default 0
 }
 
+// ---- CSV edge-case fixtures -----------------------------------------------
+// The expected arrays below were captured from the parser BEFORE the SWAR
+// tokenizer rewrite; they pin the output contract byte-for-byte so the
+// word-at-a-time scanner cannot silently change tokenization.
+
+TESTCASE(csv_edge_trailing_crlf) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/crlf.csv";
+  WriteFile(f, "5,6.5\r\n7,8\r\n");
+  auto parser = Parser<uint32_t>::Create((f + "?format=csv").c_str(), 0, 1, "auto");
+  auto all = DrainParser(parser.get());
+  EXPECT_EQV(all.Size(), 2u);
+  const std::vector<size_t> want_offset{0, 2, 4};
+  const std::vector<uint32_t> want_index{0, 1, 0, 1};
+  const std::vector<float> want_value{5.0f, 6.5f, 7.0f, 8.0f};
+  EXPECT_TRUE(all.offset == want_offset);
+  EXPECT_TRUE(all.index == want_index);
+  EXPECT_TRUE(all.value == want_value);
+  EXPECT_EQV(all.max_index, 1u);
+}
+
+TESTCASE(csv_edge_empty_trailing_fields) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/empties.csv";
+  // a trailing delimiter makes an empty last cell; an all-empty line still
+  // counts as a row with zero nonzeros
+  WriteFile(f, "1,2,\n3,,\n,,\n");
+  auto parser = Parser<uint32_t>::Create((f + "?format=csv").c_str(), 0, 1, "auto");
+  auto all = DrainParser(parser.get());
+  EXPECT_EQV(all.Size(), 3u);
+  const std::vector<size_t> want_offset{0, 2, 3, 3};
+  const std::vector<uint32_t> want_index{0, 1, 0};
+  const std::vector<float> want_value{1.0f, 2.0f, 3.0f};
+  EXPECT_TRUE(all.offset == want_offset);
+  EXPECT_TRUE(all.index == want_index);
+  EXPECT_TRUE(all.value == want_value);
+}
+
+TESTCASE(csv_edge_final_line_no_terminator) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/noeol.csv";
+  WriteFile(f, "9,10\n11,12");  // final line ends at EOF, no '\n'
+  auto parser = Parser<uint32_t>::Create((f + "?format=csv").c_str(), 0, 1, "auto");
+  auto all = DrainParser(parser.get());
+  EXPECT_EQV(all.Size(), 2u);
+  const std::vector<size_t> want_offset{0, 2, 4};
+  const std::vector<float> want_value{9.0f, 10.0f, 11.0f, 12.0f};
+  EXPECT_TRUE(all.offset == want_offset);
+  EXPECT_TRUE(all.value == want_value);
+}
+
+TESTCASE(csv_edge_utf8_bom_chunk) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/bom.csv";
+  WriteFile(f, "\xEF\xBB\xBF" "1.5,2\n3.5,4\n");
+  std::string uri = f + "?format=csv&label_column=0";
+  auto parser = Parser<uint32_t>::Create(uri.c_str(), 0, 1, "auto");
+  auto all = DrainParser(parser.get());
+  EXPECT_EQV(all.Size(), 2u);
+  EXPECT_TRUE(std::abs(all.label[0] - 1.5f) < kEps);  // BOM skipped, not glued to "1.5"
+  EXPECT_TRUE(std::abs(all.label[1] - 3.5f) < kEps);
+  const std::vector<uint32_t> want_index{0, 0};
+  const std::vector<float> want_value{2.0f, 4.0f};
+  EXPECT_TRUE(all.index == want_index);
+  EXPECT_TRUE(all.value == want_value);
+}
+
+// ---- multi-thread determinism ---------------------------------------------
+
+namespace {
+template <typename I, typename D>
+bool SameContent(const data::RowBlockContainer<I, D>& a,
+                 const data::RowBlockContainer<I, D>& b) {
+  return a.offset == b.offset && a.label == b.label && a.weight == b.weight &&
+         a.qid == b.qid && a.field == b.field && a.index == b.index &&
+         a.value == b.value && a.max_field == b.max_field &&
+         a.max_index == b.max_index;
+}
+}  // namespace
+
+TESTCASE(parser_bitwise_identical_across_nthread) {
+  TemporaryDirectory tmp;
+  std::string svm = tmp.path + "/det.libsvm";
+  std::string csv = tmp.path + "/det.csv";
+  std::string svm_content, csv_content;
+  for (int i = 0; i < 400; ++i) {
+    svm_content += std::to_string(i % 3) + " " + std::to_string(i % 91) + ":" +
+                   std::to_string(i) + "." + std::to_string(i % 10) + " " +
+                   std::to_string(100 + i % 17) + ":1\n";
+    csv_content += std::to_string(i) + "," + std::to_string(i % 7) + ".5," +
+                   (i % 5 == 0 ? "" : std::to_string(i % 11)) + "\n";
+  }
+  WriteFile(svm, svm_content);
+  WriteFile(csv, csv_content);
+  auto ref_svm = DrainParser(
+      Parser<uint32_t>::Create((svm + "?nthread=1").c_str(), 0, 1, "libsvm").get());
+  auto ref_csv = DrainParser(
+      Parser<uint32_t>::Create((csv + "?format=csv&label_column=0&nthread=1").c_str(),
+                               0, 1, "auto").get());
+  EXPECT_EQV(ref_svm.Size(), 400u);
+  EXPECT_EQV(ref_csv.Size(), 400u);
+  for (int nt : {2, 4}) {
+    std::string svm_uri = svm + "?nthread=" + std::to_string(nt);
+    std::string csv_uri =
+        csv + "?format=csv&label_column=0&nthread=" + std::to_string(nt);
+    // two epochs each: the second BeforeFirst re-runs the (persistent) pool
+    auto ps = Parser<uint32_t>::Create(svm_uri.c_str(), 0, 1, "libsvm");
+    auto pc = Parser<uint32_t>::Create(csv_uri.c_str(), 0, 1, "auto");
+    for (int epoch = 0; epoch < 2; ++epoch) {
+      auto got_svm = DrainParser(ps.get());
+      auto got_csv = DrainParser(pc.get());
+      EXPECT_TRUE(SameContent(ref_svm, got_svm));
+      EXPECT_TRUE(SameContent(ref_csv, got_csv));
+    }
+  }
+}
+
 TESTCASE(libfm_triples) {
   TemporaryDirectory tmp;
   std::string f = tmp.path + "/a.libfm";
@@ -332,6 +451,103 @@ TESTCASE(rowblock_iter_basic_and_disk_cache) {
     while (iter2->Next()) rows += iter2->Value().size;
     EXPECT_EQV(rows, 512u);
   }
+}
+
+namespace {
+data::RowBlockContainer<uint32_t, real_t> DrainIter(RowBlockIter<uint32_t>* it) {
+  data::RowBlockContainer<uint32_t, real_t> all;
+  it->BeforeFirst();
+  while (it->Next()) all.Push(it->Value());
+  return all;
+}
+}  // namespace
+
+TESTCASE(disk_cache_replay_and_corruption_fallback) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/cache.libsvm";
+  std::string content;
+  for (int i = 0; i < 300; ++i) {
+    content += "1 " + std::to_string(i % 53) + ":0.5 60:2\n";
+  }
+  WriteFile(f, content);
+  std::string cache = tmp.path + "/rowcache";
+  std::string uri = f + "#" + cache;
+  // reference: fresh in-memory parse (no cache involved)
+  auto fresh = RowBlockIter<uint32_t>::Create(f.c_str(), 0, 1, "libsvm");
+  auto ref = DrainIter(fresh.get());
+  EXPECT_EQV(ref.Size(), 300u);
+  {  // first pass builds the cache
+    auto it = RowBlockIter<uint32_t>::Create(uri.c_str(), 0, 1, "libsvm");
+    EXPECT_TRUE(SameContent(ref, DrainIter(it.get())));
+  }
+  {  // second pass replays the cache: must be bit-identical to a fresh parse
+    auto it = RowBlockIter<uint32_t>::Create(uri.c_str(), 0, 1, "libsvm");
+    EXPECT_TRUE(SameContent(ref, DrainIter(it.get())));
+  }
+  // truncated cache (build cut short / partial copy): the header's payload
+  // size no longer matches the file, so the iter must rebuild — neither
+  // crashing mid-Load nor silently replaying fewer rows
+  {
+    std::FILE* fp = std::fopen(cache.c_str(), "rb");
+    EXPECT_TRUE(fp != nullptr);
+    std::fseek(fp, 0, SEEK_END);
+    long size = std::ftell(fp);
+    std::fseek(fp, 0, SEEK_SET);
+    std::string half(static_cast<size_t>(size) / 2, '\0');
+    EXPECT_EQV(std::fread(half.data(), 1, half.size(), fp), half.size());
+    std::fclose(fp);
+    WriteFile(cache, half);
+    auto it = RowBlockIter<uint32_t>::Create(uri.c_str(), 0, 1, "libsvm");
+    EXPECT_TRUE(SameContent(ref, DrainIter(it.get())));
+  }
+  {  // garbage header: rebuild, don't crash
+    WriteFile(cache, "definitely not a rowblock cache");
+    auto it = RowBlockIter<uint32_t>::Create(uri.c_str(), 0, 1, "libsvm");
+    EXPECT_TRUE(SameContent(ref, DrainIter(it.get())));
+  }
+}
+
+// ---- persistent parse pool -------------------------------------------------
+
+namespace {
+// expose the resolved thread count (TextParserBase::nthread_ is protected)
+struct NThreadProbe : public data::LibSVMParser<uint32_t, real_t> {
+  NThreadProbe(std::unique_ptr<InputSplit> src, int nt)
+      : data::LibSVMParser<uint32_t, real_t>(std::move(src), {}, nt) {}
+  int nthread() const { return this->nthread_; }
+};
+}  // namespace
+
+TESTCASE(explicit_nthread_wins_over_heuristic) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/nt.libsvm";
+  WriteFile(f, "1 0:1\n");
+  auto split = [&] { return InputSplit::Create(f.c_str(), 0, 1, "text"); };
+  // explicit caller value wins uncapped (the old heuristic forced 1 on
+  // small hosts even when 8 was requested)
+  EXPECT_EQV(NThreadProbe(split(), 8).nthread(), 8);
+  // default resolves to the heuristic…
+  int heuristic = data::TextParserBase<uint32_t, real_t>::HeuristicThreads();
+  EXPECT_EQV(NThreadProbe(split(), 0).nthread(), heuristic);
+  // …unless the process-wide pool size is pinned
+  data::SetDefaultParseThreads(3);
+  EXPECT_EQV(NThreadProbe(split(), 0).nthread(), 3);
+  EXPECT_EQV(data::GetDefaultParseThreads(), 3);
+  EXPECT_EQV(NThreadProbe(split(), 2).nthread(), 2);  // explicit still wins
+  data::SetDefaultParseThreads(0);
+  EXPECT_EQV(NThreadProbe(split(), 0).nthread(), heuristic);
+}
+
+TESTCASE(parser_pool_relays_worker_exceptions) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/bad.libsvm";
+  std::string content;
+  for (int i = 0; i < 100; ++i) content += "1 2:3\n";
+  content += "1 qid:x 2:3\n";  // ParseNum("x") throws inside a pool worker
+  for (int i = 0; i < 100; ++i) content += "0 4:5\n";
+  WriteFile(f, content);
+  auto p = Parser<uint32_t>::Create((f + "?nthread=4").c_str(), 0, 1, "libsvm");
+  EXPECT_THROWS(while (p->Next()) {});
 }
 
 TESTCASE(rowblock_slice_and_sdot) {

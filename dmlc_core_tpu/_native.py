@@ -133,6 +133,8 @@ _LIB.DmlcTpuParserCreateEx.argtypes = [
     ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
     ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
     ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuSetDefaultParseThreads.argtypes = [ctypes.c_int]
+_LIB.DmlcTpuGetDefaultParseThreads.argtypes = [ctypes.POINTER(ctypes.c_int)]
 _LIB.DmlcTpuParserNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(RowBlockC)]
 _LIB.DmlcTpuParserBeforeFirst.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuParserBytesRead.argtypes = [ctypes.c_void_p]
@@ -198,3 +200,15 @@ def lib() -> ctypes.CDLL:
 
 def version() -> str:
     return _LIB.DmlcTpuVersion().decode()
+
+
+def set_default_parse_threads(nthread: int) -> None:
+    """Pin the parse-thread pool size for parsers created without an
+    explicit ``?nthread=`` URI arg; 0 restores the per-parser heuristic."""
+    check(_LIB.DmlcTpuSetDefaultParseThreads(int(nthread)))
+
+
+def get_default_parse_threads() -> int:
+    out = ctypes.c_int()
+    check(_LIB.DmlcTpuGetDefaultParseThreads(ctypes.byref(out)))
+    return out.value

@@ -38,6 +38,32 @@ for t in test_core test_runtime test_data test_endian test_input_split test_remo
     exit 1
   fi
 done
+
+# ThreadSanitizer tier: test_data is the parser/staging suite, so this gives
+# the persistent parse pool (text_parser.h) and the sharded staging pool
+# (sharded_parser.h) a TSan pass on every check.  cmake configures
+# DMLCTPU_ENABLE_SANITIZER=ON; containers without cmake/ninja fall back to a
+# direct g++ TSan build (mirrors _native.py's _build_direct fallback).
+mkdir -p build/tsan
+if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+  cmake -S . -B build/tsan -G Ninja -DDMLCTPU_ENABLE_SANITIZER=ON \
+        -DDMLCTPU_SANITIZER=thread >/dev/null
+  ninja -C build/tsan test_data >/dev/null
+  tsan_bin=build/tsan/test_data
+else
+  tsan_bin=build/tsan/test_data
+  g++ -O1 -g -std=c++20 -fsanitize=thread -fno-omit-frame-pointer -pthread \
+      -I cpp/include -I cpp cpp/tests/test_data.cc cpp/src/*.cc \
+      cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$tsan_bin"
+fi
+if ! "$tsan_bin" >/tmp/dmlctpu_check_tsan_test_data.log 2>&1; then
+  echo "check.sh: TSAN SUITE FAILED: test_data (log: /tmp/dmlctpu_check_tsan_test_data.log)" >&2
+  exit 1
+fi
+if grep -q "WARNING: ThreadSanitizer" /tmp/dmlctpu_check_tsan_test_data.log; then
+  echo "check.sh: TSAN RACE REPORTED (log: /tmp/dmlctpu_check_tsan_test_data.log)" >&2
+  exit 1
+fi
 flock -u 9
 
 if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
@@ -50,4 +76,4 @@ fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
 py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier")
-echo "check.sh: green (6 native suites + $py)"
+echo "check.sh: green (6 native suites + TSan parser/staging + $py)"

@@ -239,6 +239,55 @@ def test_parallel_workers_bitwise_deterministic(libsvm_file):
         assert got == ref, f"num_workers={nw} diverged from single-worker"
 
 
+def _parser_rows(uri):
+    """Flattened per-row stream of a native parser (block boundaries differ
+    across nthread, so rows — not blocks — are the unit of comparison)."""
+    import ctypes
+
+    from dmlc_core_tpu import _native
+    L = _native.lib()
+    h = ctypes.c_void_p()
+    _native.check(L.DmlcTpuParserCreate(uri.encode(), 0, 1, b"libsvm",
+                                        ctypes.byref(h)))
+    blk = _native.RowBlockC()
+    rows = []
+    while _native.check(L.DmlcTpuParserNext(h, ctypes.byref(blk))) == 1:
+        n = int(blk.size)
+        off = np.ctypeslib.as_array(blk.offset, shape=(n + 1,))
+        lab = np.ctypeslib.as_array(blk.label, shape=(n,))
+        idx = np.ctypeslib.as_array(blk.index, shape=(int(off[n]),))
+        val = np.ctypeslib.as_array(blk.value, shape=(int(off[n]),))
+        for i in range(n):
+            s, e = int(off[i]), int(off[i + 1])
+            rows.append((lab[i].tobytes(), idx[s:e].tobytes(),
+                         val[s:e].tobytes()))
+    L.DmlcTpuParserFree(h)
+    return rows
+
+
+def test_parse_pool_nthread_bitwise_deterministic(libsvm_file):
+    """The persistent parse pool must not change the row stream: splitting a
+    chunk over 2 or 4 pool workers yields bit-identical rows to nthread=1."""
+    ref = _parser_rows(f"{libsvm_file}?nthread=1")
+    assert len(ref) == 1000
+    for nt in (2, 4):
+        got = _parser_rows(f"{libsvm_file}?nthread={nt}")
+        assert got == ref, f"nthread={nt} diverged from nthread=1"
+
+
+def test_parse_pool_under_sharded_staging_deterministic(libsvm_file):
+    """nthread x num_workers grid: staged batches stay bit-identical when the
+    parse pool and the sharded worker pool are combined."""
+    ref = _drain_bits(dt.DeviceStagingIter(libsvm_file, batch_size=128,
+                                           nnz_bucket=512))
+    for nt in (2, 4):
+        for nw in (1, 4):
+            got = _drain_bits(dt.DeviceStagingIter(
+                f"{libsvm_file}?nthread={nt}", batch_size=128,
+                nnz_bucket=512, num_workers=nw))
+            assert got == ref, f"nthread={nt} num_workers={nw}"
+
+
 def test_parallel_workers_counters_and_completion_order(libsvm_file):
     """counters exposes the per-stage pipeline breakdown; reorder=False
     still covers every row exactly once (order unspecified)."""
