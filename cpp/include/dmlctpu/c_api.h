@@ -225,6 +225,45 @@ int DmlcTpuFsListDirectory(const char* uri, int recursive, const char** out);
 /* single-path stat into the same format (one line) */
 int DmlcTpuFsPathInfo(const char* uri, const char** out);
 
+/* ---- telemetry (dmlctpu/telemetry.h) ------------------------------------- */
+/* *out = 1 when telemetry was compiled in (DMLCTPU_TELEMETRY=1), else 0.
+ * With it compiled out every call below degrades to a cheap no-op:
+ * snapshots report {"enabled":false}, counters read 0, traces are empty. */
+int DmlcTpuTelemetryEnabled(int* out);
+/* JSON snapshot of every registered counter/gauge/histogram; pointer valid
+ * until the next telemetry call on the same thread. */
+int DmlcTpuTelemetrySnapshotJson(const char** out);
+/* zero every registered metric (objects stay registered). */
+int DmlcTpuTelemetryReset(void);
+/* add delta to the named process-wide counter (creates it on first use) —
+ * how the Python staging loop publishes H2D feed occupancy. */
+int DmlcTpuTelemetryCounterAdd(const char* name, int64_t delta);
+/* read the named counter into *out (creates it as 0 on first use). */
+int DmlcTpuTelemetryCounterGet(const char* name, int64_t* out);
+/* start/stop buffering trace spans (start clears prior spans). */
+int DmlcTpuTelemetryTraceStart(void);
+int DmlcTpuTelemetryTraceStop(void);
+/* Chrome trace-event JSON of the buffered spans; pointer valid until the
+ * next telemetry call on the same thread. */
+int DmlcTpuTelemetryTraceDumpJson(const char** out);
+/* record one complete span (steady-clock microseconds, e.g. from Python's
+ * time.monotonic_ns()//1000) into the active trace. */
+int DmlcTpuTelemetryRecordSpan(const char* name, int64_t ts_us,
+                               int64_t dur_us);
+
+/* ---- logging ------------------------------------------------------------- */
+/* severity: 0=DEBUG 1=INFO 2=WARNING 3=ERROR 4=FATAL.  `where` is
+ * "file:line".  The strings are only valid for the duration of the call. */
+typedef void (*DmlcTpuLogCallback)(int severity, const char* where,
+                                   const char* message);
+/* install (or clear, with NULL) the process-wide log sink; replaces the
+ * default stderr sink.  Thread-safe against concurrent logging. */
+int DmlcTpuLogSetCallback(DmlcTpuLogCallback callback);
+/* emit one message through the logging pipeline (honors the min-level env
+ * config; severity is clamped to ERROR — FATAL raises natively and cannot
+ * cross the C boundary).  Lets bindings and tests exercise the sink. */
+int DmlcTpuLogEmit(int severity, const char* message);
+
 /* ---- misc ---------------------------------------------------------------- */
 /*! \brief library version string */
 const char* DmlcTpuVersion(void);

@@ -32,10 +32,13 @@ namespace log {
 /*! \brief pluggable sink: receives (severity, "file:line", message). */
 using Sink = std::function<void(LogSeverity, const char*, const std::string&)>;
 
-inline Sink& CustomSink() {
-  static Sink sink;  // empty => default stderr sink
-  return sink;
-}
+/*! \brief install (or, with an empty Sink, remove) the custom sink.
+ *  Thread-safe against concurrent Emit from worker threads: the active
+ *  sink is copied under a mutex before each call, so a sink being replaced
+ *  is never destroyed mid-invocation.  The C API exposes this as
+ *  DmlcTpuLogSetCallback; the Python binding forwards into a callable so
+ *  tests/trackers capture WARNING/ERROR lines instead of scraping stderr. */
+void SetSink(Sink sink);
 
 /*! \brief minimum severity that gets emitted (default INFO; DEBUG if DMLC_LOG_DEBUG=1). */
 inline int& MinLevel() {

@@ -16,6 +16,7 @@
 #include "dmlctpu/logging.h"
 #include "dmlctpu/recordio.h"
 #include "dmlctpu/stream.h"
+#include "dmlctpu/telemetry.h"
 
 namespace {
 
@@ -89,6 +90,115 @@ extern "C" {
 
 const char* DmlcTpuGetLastError(void) { return last_error.c_str(); }
 const char* DmlcTpuVersion(void) { return "0.1.0"; }
+
+/* ---- telemetry ----------------------------------------------------------- */
+
+namespace {
+// returned pointers stay valid until the next telemetry call on the same
+// thread (same contract as fs_listing above)
+thread_local std::string telemetry_json;
+}  // namespace
+
+int DmlcTpuTelemetryEnabled(int* out) {
+  return Guard([&] {
+    *out = dmlctpu::telemetry::Enabled() ? 1 : 0;
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetrySnapshotJson(const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::telemetry::Registry::Get()->SnapshotJson();
+    *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryReset(void) {
+  return Guard([&] {
+    dmlctpu::telemetry::Registry::Get()->ResetAll();
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryCounterAdd(const char* name, int64_t delta) {
+  return Guard([&] {
+    if (delta > 0) {
+      dmlctpu::telemetry::Registry::Get()->counter(name).Add(
+          static_cast<uint64_t>(delta));
+    }
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryCounterGet(const char* name, int64_t* out) {
+  return Guard([&] {
+    *out = static_cast<int64_t>(
+        dmlctpu::telemetry::Registry::Get()->counter(name).Value());
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryTraceStart(void) {
+  return Guard([&] {
+    dmlctpu::telemetry::TraceStart();
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryTraceStop(void) {
+  return Guard([&] {
+    dmlctpu::telemetry::TraceStop();
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryTraceDumpJson(const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::telemetry::TraceDumpJson();
+    *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryRecordSpan(const char* name, int64_t ts_us,
+                               int64_t dur_us) {
+  return Guard([&] {
+    if (dmlctpu::telemetry::TraceActive()) {
+      dmlctpu::telemetry::RecordSpanOwned(name, ts_us, dur_us);
+    }
+    return 0;
+  });
+}
+
+/* ---- logging ------------------------------------------------------------- */
+
+int DmlcTpuLogSetCallback(DmlcTpuLogCallback callback) {
+  return Guard([&] {
+    if (callback == nullptr) {
+      dmlctpu::log::SetSink(dmlctpu::log::Sink());
+    } else {
+      dmlctpu::log::SetSink([callback](dmlctpu::LogSeverity sev,
+                                       const char* where,
+                                       const std::string& msg) {
+        callback(static_cast<int>(sev), where, msg.c_str());
+      });
+    }
+    return 0;
+  });
+}
+
+int DmlcTpuLogEmit(int severity, const char* message) {
+  return Guard([&] {
+    // clamp: FATAL throws natively and must not originate at the C boundary
+    int sev = severity < 0 ? 0 : (severity > 3 ? 3 : severity);
+    if (sev >= dmlctpu::log::MinLevel()) {
+      dmlctpu::log::Emit(static_cast<dmlctpu::LogSeverity>(sev), "c_api", 0,
+                         message == nullptr ? "" : message);
+    }
+    return 0;
+  });
+}
 
 int DmlcTpuStreamCreate(const char* uri, const char* mode,
                         DmlcTpuStreamHandle* out) {

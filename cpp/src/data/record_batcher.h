@@ -24,6 +24,7 @@
 #include "dmlctpu/input_split.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/recordio.h"
+#include "dmlctpu/telemetry.h"
 #include "dmlctpu/threaded_iter.h"
 
 namespace dmlctpu {
@@ -91,6 +92,10 @@ class RecordBatcher {
           break;
         }
         bytes_read_.fetch_add(chunk_.size, std::memory_order_relaxed);
+        // same counting site feeds the process-wide telemetry counter, so
+        // the per-instance BytesRead and telemetry "record.bytes" can never
+        // drift (the unified tally RecordStagingIter.bytes_read reads)
+        telemetry::stage::RecordBytes().Add(chunk_.size);
         reader_ = std::make_unique<RecordIOChunkReader>(RecordIOChunkReader::Blob{
             static_cast<char*>(chunk_.dptr), chunk_.size});
         continue;
@@ -113,6 +118,7 @@ class RecordBatcher {
     std::memset(out->bytes.data() + used, 0, bytes_cap_ - used);
     out->num_records = static_cast<uint32_t>(nrec);
     out->bytes_used = used;
+    telemetry::stage::RecordBatches().Add(1);
     return true;
   }
 

@@ -47,6 +47,7 @@
 #include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/row_block.h"
+#include "dmlctpu/telemetry.h"
 
 namespace dmlctpu {
 namespace data {
@@ -206,6 +207,9 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   void ParseOnePart(unsigned j) {
+    telemetry::ScopedSpan span("shard.part");
+    telemetry::ScopedAccum part_timer(telemetry::stage::ShardPartUs());
+    telemetry::stage::ShardParts().Add(1);
     // nthread=1: worker threads ARE the parse parallelism; parseahead=0
     // skips the inner parse-ahead thread so CallParseNext hands back owned
     // containers with zero copies
@@ -241,13 +245,23 @@ class ShardedParser : public Parser<IndexType, DType> {
       for (const auto& b : blocks) cost += b.MemCostBytes();
       {
         std::unique_lock<std::mutex> lk(mu_);
-        cv_produce_.wait(lk, [&] {
-          return stop_ || error_ || buffered_bytes_ < buffer_bytes_ ||
-                 (reorder_ && j == emit_part_);
-        });
+        {
+          // producer stall: blocked because the reorder buffer is full —
+          // the downstream (pack/H2D) is the slow side
+          telemetry::ScopedAccum wait(
+              telemetry::stage::ShardProducerWaitUs());
+          cv_produce_.wait(lk, [&] {
+            return stop_ || error_ || buffered_bytes_ < buffer_bytes_ ||
+                   (reorder_ && j == emit_part_);
+          });
+        }
         if (stop_ || error_) return;
         parts_[j].q.emplace_back(std::move(blocks), cost);
         buffered_bytes_ += cost;
+        telemetry::stage::ShardBufferedBytes().Set(
+            static_cast<int64_t>(buffered_bytes_));
+        telemetry::stage::ShardChunks().Add(1);
+        telemetry::stage::ShardBytes().Add(delta);
         // count bytes only once their blocks are published, so work that a
         // Stop/BeforeFirst discards never lands in BytesRead (bench derives
         // throughput from its deltas)
@@ -259,6 +273,7 @@ class ShardedParser : public Parser<IndexType, DType> {
     // reads of this part, but drop them if the epoch is being torn down
     std::lock_guard<std::mutex> lk(mu_);
     if (stop_ || error_) return;
+    telemetry::stage::ShardBytes().Add(parser->BytesRead() - last_bytes);
     bytes_read_.fetch_add(parser->BytesRead() - last_bytes,
                           std::memory_order_relaxed);
   }
@@ -309,7 +324,12 @@ class ShardedParser : public Parser<IndexType, DType> {
         }
         if (next_claim_ >= virtual_parts_ && parts_.empty()) return false;
       }
-      cv_consume_.wait(lk);
+      {
+        // consumer stall: nothing parsed and buffered for the emit part —
+        // the parse side is the slow side
+        telemetry::ScopedAccum wait(telemetry::stage::ShardConsumerWaitUs());
+        cv_consume_.wait(lk);
+      }
     }
   }
 
@@ -317,6 +337,8 @@ class ShardedParser : public Parser<IndexType, DType> {
     RecycleCurBlocks();
     cur_blocks_ = std::move(pq->q.front().first);
     buffered_bytes_ -= pq->q.front().second;
+    telemetry::stage::ShardBufferedBytes().Set(
+        static_cast<int64_t>(buffered_bytes_));
     pq->q.pop_front();
     blk_ptr_ = 0;
     cv_produce_.notify_all();

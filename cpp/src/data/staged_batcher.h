@@ -31,6 +31,7 @@
 
 #include "dmlctpu/data.h"
 #include "dmlctpu/logging.h"
+#include "dmlctpu/telemetry.h"
 #include "dmlctpu/threaded_iter.h"
 
 namespace dmlctpu {
@@ -223,6 +224,9 @@ class StagedBatcherT {
   // cursor tracks partial consumption of the current block across batch
   // boundaries; the view stays valid until the next parser_->Next().
   bool Produce(Slot** cell) {
+    telemetry::ScopedSpan span("pack.batch");
+    const int64_t pack_t0 = telemetry::NowUs();
+    int64_t wait_us = 0;
     if (*cell == nullptr) *cell = new Slot();
     Slot* slot = *cell;
     if (slot->arena == nullptr) {
@@ -236,7 +240,10 @@ class StagedBatcherT {
     size_t nnz = 0;
     while (rows < B) {
       if (!have_block_) {
-        if (source_end_ || !parser_->Next()) {
+        const int64_t wait_t0 = telemetry::NowUs();
+        const bool got = !source_end_ && parser_->Next();
+        wait_us += telemetry::NowUs() - wait_t0;
+        if (!got) {
           source_end_ = true;
           break;
         }
@@ -279,9 +286,24 @@ class StagedBatcherT {
       cur_row_ += take;
       if (cur_row_ == block_.size) have_block_ = false;
     }
-    if (rows == 0) return false;
+    if (rows == 0) {
+      telemetry::stage::PackInputWaitUs().Add(
+          static_cast<uint64_t>(wait_us));
+      return false;
+    }
     last_nnz_ = nnz;
     Finalize(slot, rows, nnz);
+    if constexpr (telemetry::Enabled()) {
+      namespace ts = telemetry::stage;
+      const int64_t total = telemetry::NowUs() - pack_t0;
+      ts::PackInputWaitUs().Add(static_cast<uint64_t>(wait_us));
+      if (total > wait_us) {
+        ts::PackBusyUs().Add(static_cast<uint64_t>(total - wait_us));
+      }
+      ts::PackBatches().Add(1);
+      ts::PackRows().Add(rows);
+      ts::PackBatchUs().Observe(static_cast<uint64_t>(total));
+    }
     return true;
   }
 

@@ -19,6 +19,7 @@
 #include "dmlctpu/common.h"
 #include "dmlctpu/input_split.h"
 #include "dmlctpu/swar_scan.h"
+#include "dmlctpu/telemetry.h"
 #include "dmlctpu/thread_group.h"
 
 namespace dmlctpu {
@@ -61,8 +62,16 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
                           RowBlockContainer<IndexType, DType>* out) = 0;
 
   bool ParseNext(Blocks* data) override {
+    telemetry::ScopedSpan span("parse.chunk");
     InputSplit::Blob chunk;
-    if (!source_->NextChunk(&chunk)) return false;
+    telemetry::StallTimer input_wait(telemetry::stage::ParseInputWaitUs());
+    input_wait.Start();
+    if (!source_->NextChunk(&chunk)) {
+      input_wait.Stop();
+      return false;
+    }
+    input_wait.Stop();
+    const int64_t chunk_t0 = telemetry::NowUs();
     this->bytes_read_ += chunk.size;
     const char* head = static_cast<const char*>(chunk.dptr);
     const char* tail = head + chunk.size;
@@ -72,8 +81,9 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
     data->resize(nthread);
     if (nthread == 1) {
       (*data)[0].Reserve(hint_rows_, hint_nnz_);
-      ParseBlock(head, tail, &(*data)[0]);
+      TimedParseBlock(head, tail, &(*data)[0]);
       UpdateHints(*data);
+      FinishChunkStats(*data, chunk.size, chunk_t0);
       return true;
     }
     // newline-aligned sub-ranges: range 0 for the coordinator, the rest for
@@ -100,13 +110,15 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
     pool_cv_.notify_all();
     // the coordinator is worker 0: it parses its own range instead of
     // sleeping through the dispatch (workers never touch slot 0)
-    relay_.Run([&] { ParseBlock(jobs_[0].begin, jobs_[0].end, jobs_[0].out); });
+    relay_.Run(
+        [&] { TimedParseBlock(jobs_[0].begin, jobs_[0].end, jobs_[0].out); });
     {
       std::unique_lock<std::mutex> lk(pool_mu_);
       done_cv_.wait(lk, [this] { return pending_ == 0; });
     }
     relay_.Rethrow();
     UpdateHints(*data);
+    FinishChunkStats(*data, chunk.size, chunk_t0);
     return true;
   }
 
@@ -146,6 +158,33 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
     RowBlockContainer<IndexType, DType>* out = nullptr;
   };
 
+  /*! \brief ParseBlock plus per-worker busy accounting and a trace span.
+   *  Compiles down to a plain ParseBlock call under DMLCTPU_TELEMETRY=0. */
+  void TimedParseBlock(const char* begin, const char* end,
+                       RowBlockContainer<IndexType, DType>* out) {
+    telemetry::ScopedSpan span("parse.block");
+    telemetry::StallTimer busy(telemetry::stage::ParseBusyUs());
+    busy.Start();
+    ParseBlock(begin, end, out);
+    busy.Stop();
+  }
+
+  /*! \brief publish per-chunk totals (rows/nnz sums, chunk latency). */
+  void FinishChunkStats(const Blocks& data, size_t bytes, int64_t t0) {
+    if constexpr (!telemetry::Enabled()) return;
+    namespace ts = telemetry::stage;
+    size_t rows = 0, nnz = 0;
+    for (const auto& b : data) {
+      rows += b.label.size();
+      nnz += b.index.size();
+    }
+    ts::ParseChunks().Add(1);
+    ts::ParseBytes().Add(bytes);
+    ts::ParseRows().Add(rows);
+    ts::ParseNnz().Add(nnz);
+    ts::ParseChunkUs().Observe(static_cast<uint64_t>(telemetry::NowUs() - t0));
+  }
+
   /*! \brief lazily start the nthread_-1 parked workers (slots 1..nthread_-1) */
   void EnsurePool() {
     if (!pool_.empty()) return;
@@ -167,7 +206,7 @@ class TextParserBase : public ParserImpl<IndexType, DType> {
       seen = generation_;
       Job job = jobs_[slot];
       lk.unlock();
-      relay_.Run([&] { ParseBlock(job.begin, job.end, job.out); });
+      relay_.Run([&] { TimedParseBlock(job.begin, job.end, job.out); });
       lk.lock();
       if (--pending_ == 0) done_cv_.notify_one();
     }

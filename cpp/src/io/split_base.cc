@@ -9,6 +9,7 @@
 #include <regex>
 
 #include "dmlctpu/logging.h"
+#include "dmlctpu/telemetry.h"
 
 namespace dmlctpu {
 namespace io {
@@ -224,6 +225,8 @@ bool SplitterBase::Chunk::Load(SplitterBase* split, size_t units) {
     if (size == 0) {
       data.resize(data.size() * 2);  // tail bigger than buffer: grow and retry
     } else {
+      telemetry::stage::SplitChunks().Add(1);
+      telemetry::stage::SplitBytes().Add(size);
       begin = reinterpret_cast<char*>(data.data());
       end = begin + size;
       *end = '\0';  // sentinel: parsers run terminator-less digit loops
@@ -242,6 +245,8 @@ bool SplitterBase::Chunk::Append(SplitterBase* split, size_t units) {
     if (size == 0) {
       data.resize(data.size() * 2);  // carried tail larger than free space
     } else {
+      telemetry::stage::SplitChunks().Add(1);
+      telemetry::stage::SplitBytes().Add(size);
       begin = reinterpret_cast<char*>(data.data());
       end = begin + prev + size;
       *end = '\0';  // sentinel: parsers run terminator-less digit loops

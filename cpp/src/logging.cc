@@ -13,9 +13,30 @@
 #include <cstdio>
 #include <ctime>
 #include <memory>
+#include <mutex>
 
 namespace dmlctpu {
 namespace log {
+
+namespace {
+// Guards the installed sink.  Emit copies the sink out under the lock and
+// invokes the copy unlocked, so a concurrent SetSink never destroys a
+// std::function that another thread is executing — and a sink that logs
+// (directly or via a Python callback) cannot self-deadlock.
+std::mutex& SinkMutex() {
+  static std::mutex* mu = new std::mutex();  // leaked: usable during exit
+  return *mu;
+}
+Sink& InstalledSink() {
+  static Sink* sink = new Sink();  // empty => default stderr sink
+  return *sink;
+}
+}  // namespace
+
+void SetSink(Sink sink) {
+  std::lock_guard<std::mutex> lk(SinkMutex());
+  InstalledSink() = std::move(sink);
+}
 
 #ifndef DMLCTPU_HAS_BACKTRACE
 std::string StackTrace(int) { return ""; }  // musl/non-glibc: no backtrace()
@@ -56,7 +77,11 @@ std::string StackTrace(int skip) {
 #endif  // DMLCTPU_HAS_BACKTRACE
 
 void Emit(LogSeverity severity, const char* file, int line, const std::string& msg) {
-  Sink& sink = CustomSink();
+  Sink sink;
+  {
+    std::lock_guard<std::mutex> lk(SinkMutex());
+    sink = InstalledSink();
+  }
   if (sink) {
     std::string where = std::string(file) + ":" + std::to_string(line);
     sink(severity, where.c_str(), msg);

@@ -1,0 +1,236 @@
+// telemetry.cc — registry singleton, JSON snapshot rendering, and the
+// per-thread trace-span buffers behind dmlctpu/telemetry.h.
+#include <dmlctpu/telemetry.h>
+
+#if DMLCTPU_TELEMETRY
+
+#include <algorithm>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <vector>
+
+namespace dmlctpu {
+namespace telemetry {
+namespace {
+
+// Minimal string escape for JSON keys/names (metric names are plain
+// identifiers in practice; this keeps arbitrary C-API names safe anyway).
+void AppendEscaped(std::string* out, const std::string& s) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      case '\r': *out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+// ---- trace state ------------------------------------------------------------
+
+struct TraceEvent {
+  const char* lit_name;    // string literal, or nullptr when owned
+  std::string owned_name;  // used by RecordSpanOwned (C API / Python spans)
+  uint32_t tid;
+  int64_t ts_us;
+  int64_t dur_us;
+};
+
+// Per-thread buffer.  The shared_ptr in the global list keeps it alive past
+// thread exit so TraceDumpJson can still read events from finished workers.
+struct ThreadTraceBuf {
+  std::mutex mu;
+  std::vector<TraceEvent> events;
+  uint32_t tid = 0;
+  uint64_t dropped = 0;
+};
+
+constexpr size_t kMaxEventsPerThread = 1 << 18;  // ~16MB/thread worst case
+
+std::atomic<bool> g_trace_active{false};
+
+struct TraceGlobal {
+  std::mutex mu;
+  std::vector<std::shared_ptr<ThreadTraceBuf>> bufs;
+  uint32_t next_tid = 1;
+};
+
+TraceGlobal& Trace() {
+  static TraceGlobal* g = new TraceGlobal();  // leaked: outlive thread dtors
+  return *g;
+}
+
+ThreadTraceBuf& LocalBuf() {
+  thread_local std::shared_ptr<ThreadTraceBuf> buf = [] {
+    auto b = std::make_shared<ThreadTraceBuf>();
+    TraceGlobal& g = Trace();
+    std::lock_guard<std::mutex> lk(g.mu);
+    b->tid = g.next_tid++;
+    g.bufs.push_back(b);
+    return b;
+  }();
+  return *buf;
+}
+
+void PushEvent(TraceEvent&& ev) {
+  ThreadTraceBuf& b = LocalBuf();
+  ev.tid = b.tid;
+  std::lock_guard<std::mutex> lk(b.mu);
+  if (b.events.size() >= kMaxEventsPerThread) {
+    ++b.dropped;
+    return;
+  }
+  b.events.push_back(std::move(ev));
+}
+
+}  // namespace
+
+// ---- Registry ---------------------------------------------------------------
+
+struct Registry::Impl {
+  mutable std::mutex mu;
+  // std::map: stable node addresses (references survive later insertions)
+  // and deterministic (sorted) snapshot order.
+  std::map<std::string, Counter> counters;
+  std::map<std::string, Gauge> gauges;
+  std::map<std::string, Histogram> histograms;
+};
+
+Registry* Registry::Get() {
+  static Registry* r = [] {
+    auto* reg = new Registry();   // leaked: process-lifetime singleton so
+    reg->impl_ = new Impl();      // worker threads may log at exit safely
+    return reg;
+  }();
+  return r;
+}
+
+Counter& Registry::counter(const std::string& name) {
+  std::lock_guard<std::mutex> lk(impl_->mu);
+  return impl_->counters[name];
+}
+
+Gauge& Registry::gauge(const std::string& name) {
+  std::lock_guard<std::mutex> lk(impl_->mu);
+  return impl_->gauges[name];
+}
+
+Histogram& Registry::histogram(const std::string& name) {
+  std::lock_guard<std::mutex> lk(impl_->mu);
+  return impl_->histograms[name];
+}
+
+std::string Registry::SnapshotJson() const {
+  std::lock_guard<std::mutex> lk(impl_->mu);
+  std::string out = "{\"enabled\":true,\"counters\":{";
+  bool first = true;
+  for (const auto& [name, c] : impl_->counters) {
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    AppendEscaped(&out, name);
+    out += "\":" + std::to_string(c.Value());
+  }
+  out += "},\"gauges\":{";
+  first = true;
+  for (const auto& [name, g] : impl_->gauges) {
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    AppendEscaped(&out, name);
+    out += "\":" + std::to_string(g.Value());
+  }
+  out += "},\"histograms\":{";
+  first = true;
+  for (const auto& [name, h] : impl_->histograms) {
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    AppendEscaped(&out, name);
+    out += "\":{\"count\":" + std::to_string(h.Count()) +
+           ",\"sum\":" + std::to_string(h.Sum()) + ",\"buckets\":[";
+    for (int i = 0; i < Histogram::kBuckets; ++i) {
+      if (i) out += ',';
+      out += std::to_string(h.Bucket(i));
+    }
+    out += "]}";
+  }
+  out += "}}";
+  return out;
+}
+
+void Registry::ResetAll() {
+  std::lock_guard<std::mutex> lk(impl_->mu);
+  for (auto& [name, c] : impl_->counters) c.Reset();
+  for (auto& [name, g] : impl_->gauges) g.Reset();
+  for (auto& [name, h] : impl_->histograms) h.Reset();
+}
+
+// ---- trace API --------------------------------------------------------------
+
+bool TraceActive() { return g_trace_active.load(std::memory_order_relaxed); }
+
+void TraceStart() {
+  TraceGlobal& g = Trace();
+  std::lock_guard<std::mutex> lk(g.mu);
+  for (auto& b : g.bufs) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    b->events.clear();
+    b->dropped = 0;
+  }
+  g_trace_active.store(true, std::memory_order_release);
+}
+
+void TraceStop() { g_trace_active.store(false, std::memory_order_release); }
+
+void RecordSpan(const char* name, int64_t ts_us, int64_t dur_us) {
+  PushEvent(TraceEvent{name, std::string(), 0, ts_us, dur_us});
+}
+
+void RecordSpanOwned(const std::string& name, int64_t ts_us, int64_t dur_us) {
+  PushEvent(TraceEvent{nullptr, name, 0, ts_us, dur_us});
+}
+
+std::string TraceDumpJson() {
+  TraceGlobal& g = Trace();
+  std::lock_guard<std::mutex> lk(g.mu);
+  std::string out = "{\"displayTimeUnit\":\"ms\",\"traceEvents\":[";
+  bool first = true;
+  uint64_t dropped = 0;
+  for (auto& b : g.bufs) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    dropped += b->dropped;
+    for (const TraceEvent& ev : b->events) {
+      if (!first) out += ',';
+      first = false;
+      out += "{\"name\":\"";
+      if (ev.lit_name != nullptr) {
+        AppendEscaped(&out, ev.lit_name);
+      } else {
+        AppendEscaped(&out, ev.owned_name);
+      }
+      out += "\",\"cat\":\"dmlctpu\",\"ph\":\"X\",\"pid\":1,\"tid\":" +
+             std::to_string(ev.tid) + ",\"ts\":" + std::to_string(ev.ts_us) +
+             ",\"dur\":" + std::to_string(ev.dur_us) + "}";
+    }
+  }
+  out += "],\"otherData\":{\"dropped_events\":" + std::to_string(dropped) + "}}";
+  return out;
+}
+
+}  // namespace telemetry
+}  // namespace dmlctpu
+
+#endif  // DMLCTPU_TELEMETRY

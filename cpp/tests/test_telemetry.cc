@@ -1,0 +1,420 @@
+// Telemetry tests: counter/gauge/histogram correctness, registry snapshots
+// (including under a live multi-threaded parse), Chrome trace-event JSON
+// well-formedness, the bit-identity guard (instrumentation must not change
+// parse output), and the C-API/log-sink surface.  The whole suite also runs
+// in the DMLCTPU_TELEMETRY=0 tier of scripts/check.sh, where every
+// Enabled()-gated assertion flips to the stubbed-out expectations.
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dmlctpu/c_api.h"
+#include "dmlctpu/data.h"
+#include "dmlctpu/row_block.h"
+#include "dmlctpu/json.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/stream.h"
+#include "dmlctpu/telemetry.h"
+#include "dmlctpu/temp_dir.h"
+#include "testing.h"
+
+using namespace dmlctpu;  // NOLINT
+
+namespace {
+
+void WriteFile(const std::string& path, const std::string& content) {
+  auto fo = Stream::Create(path.c_str(), "w");
+  fo->Write(content.data(), content.size());
+}
+
+std::string MakeLibsvm(const std::string& dir, int rows) {
+  std::string f = dir + "/telemetry.libsvm";
+  std::ostringstream os;
+  for (int i = 0; i < rows; ++i) {
+    os << (i % 2) << " 1:" << i << ".5 7:2.0 11:" << (i % 13) << "\n";
+  }
+  WriteFile(f, os.str());
+  return f;
+}
+
+/*! \brief walk an arbitrary JSON document; throws (via TCHECK) when
+ *  malformed.  Returns the number of values visited. */
+size_t WalkJson(const std::string& text) {
+  std::istringstream is(text);
+  JSONReader reader(&is);
+  // SkipValue() recurses over any value type, so one call covers the doc
+  reader.SkipValue();
+  return 1;
+}
+
+/*! \brief parse the snapshot JSON into (counters, gauges) maps. */
+void ParseSnapshot(const std::string& text, bool* enabled,
+                   std::map<std::string, int64_t>* counters,
+                   std::map<std::string, int64_t>* gauges) {
+  std::istringstream is(text);
+  JSONReader reader(&is);
+  reader.BeginObject();
+  std::string key;
+  while (reader.NextObjectItem(&key)) {
+    if (key == "enabled") {
+      reader.ReadNumber(enabled);
+    } else if (key == "counters" || key == "gauges") {
+      auto* out = key == "counters" ? counters : gauges;
+      reader.BeginObject();
+      std::string name;
+      while (reader.NextObjectItem(&name)) {
+        int64_t v = 0;
+        reader.ReadNumber(&v);
+        (*out)[name] = v;
+      }
+    } else {
+      reader.SkipValue();
+    }
+  }
+}
+
+struct TraceEventLite {
+  std::string name, ph;
+  int64_t ts = -1, dur = -1, tid = -1;
+};
+
+/*! \brief parse Chrome trace JSON, asserting the envelope shape. */
+std::vector<TraceEventLite> ParseTrace(const std::string& text) {
+  std::vector<TraceEventLite> events;
+  std::istringstream is(text);
+  JSONReader reader(&is);
+  reader.BeginObject();
+  std::string key;
+  bool saw_events = false;
+  while (reader.NextObjectItem(&key)) {
+    if (key != "traceEvents") {
+      reader.SkipValue();
+      continue;
+    }
+    saw_events = true;
+    reader.BeginArray();
+    while (reader.NextArrayItem()) {
+      reader.BeginObject();
+      TraceEventLite ev;
+      std::string k;
+      while (reader.NextObjectItem(&k)) {
+        if (k == "name") {
+          reader.ReadString(&ev.name);
+        } else if (k == "ph") {
+          reader.ReadString(&ev.ph);
+        } else if (k == "ts") {
+          reader.ReadNumber(&ev.ts);
+        } else if (k == "dur") {
+          reader.ReadNumber(&ev.dur);
+        } else if (k == "tid") {
+          reader.ReadNumber(&ev.tid);
+        } else {
+          reader.SkipValue();
+        }
+      }
+      events.push_back(ev);
+    }
+  }
+  EXPECT_TRUE(saw_events);
+  return events;
+}
+
+}  // namespace
+
+TESTCASE(counter_gauge_basics) {
+  auto* reg = telemetry::Registry::Get();
+  telemetry::Counter& c = reg->counter("test.counter_basics");
+  telemetry::Counter& c2 = reg->counter("test.counter_basics");
+  EXPECT_TRUE(&c == &c2);  // stable object identity per name
+  c.Reset();
+  c.Add();
+  c.Add(41);
+  telemetry::Gauge& g = reg->gauge("test.gauge_basics");
+  g.Set(7);
+  g.Add(-3);
+  if (telemetry::Enabled()) {
+    EXPECT_EQV(c.Value(), 42u);
+    EXPECT_EQV(g.Value(), int64_t{4});
+    c.Reset();
+    EXPECT_EQV(c.Value(), 0u);
+  } else {
+    EXPECT_EQV(c.Value(), 0u);
+    EXPECT_EQV(g.Value(), int64_t{0});
+  }
+}
+
+TESTCASE(counter_concurrent_adds) {
+  telemetry::Counter& c =
+      telemetry::Registry::Get()->counter("test.counter_mt");
+  c.Reset();
+  constexpr int kThreads = 4, kAdds = 10000;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&c] {
+      for (int i = 0; i < kAdds; ++i) c.Add(1);
+    });
+  }
+  for (auto& t : ts) t.join();
+  EXPECT_EQV(c.Value(),
+             telemetry::Enabled() ? uint64_t{kThreads * kAdds} : 0u);
+}
+
+TESTCASE(histogram_power_of_two_buckets) {
+  telemetry::Histogram& h =
+      telemetry::Registry::Get()->histogram("test.histogram");
+  h.Reset();
+  // bucket i (i < last) has upper bound 2^i: 0,1 -> bucket 0; 2 -> 1;
+  // 3,4 -> 2; 5..8 -> 3; huge values land in the +inf overflow bucket
+  h.Observe(0);
+  h.Observe(1);
+  h.Observe(2);
+  h.Observe(3);
+  h.Observe(4);
+  h.Observe(5);
+  h.Observe(~uint64_t{0});
+  if (!telemetry::Enabled()) {
+    EXPECT_EQV(h.Count(), 0u);
+    return;
+  }
+  EXPECT_EQV(h.Count(), 7u);
+  EXPECT_EQV(h.Sum(), 15u + ~uint64_t{0});
+  EXPECT_EQV(h.Bucket(0), 2u);
+  EXPECT_EQV(h.Bucket(1), 1u);
+  EXPECT_EQV(h.Bucket(2), 2u);
+  EXPECT_EQV(h.Bucket(3), 1u);
+  EXPECT_EQV(h.Bucket(telemetry::Histogram::kBuckets - 1), 1u);
+  uint64_t total = 0;
+  for (int i = 0; i < telemetry::Histogram::kBuckets; ++i) total += h.Bucket(i);
+  EXPECT_EQV(total, h.Count());
+}
+
+TESTCASE(snapshot_json_wellformed) {
+  auto* reg = telemetry::Registry::Get();
+  reg->counter("test.snapshot\"quoted\\name").Add(3);
+  reg->gauge("test.snapshot_gauge").Set(-5);
+  reg->histogram("test.snapshot_hist").Observe(100);
+  std::string js = reg->SnapshotJson();
+  WalkJson(js);  // throws on malformed JSON (escaping included)
+  bool enabled = false;
+  std::map<std::string, int64_t> counters, gauges;
+  ParseSnapshot(js, &enabled, &counters, &gauges);
+  EXPECT_EQV(enabled, telemetry::Enabled());
+  if (telemetry::Enabled()) {
+    EXPECT_TRUE(counters.count("test.snapshot\"quoted\\name") == 1);
+    EXPECT_TRUE(counters.at("test.snapshot\"quoted\\name") >= 3);
+    EXPECT_EQV(gauges.at("test.snapshot_gauge"), int64_t{-5});
+  }
+}
+
+TESTCASE(trace_json_wellformed_multithreaded) {
+  telemetry::TraceStart();
+  {
+    telemetry::ScopedSpan outer("test.outer");
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 3; ++t) {
+      ts.emplace_back([] {
+        for (int i = 0; i < 50; ++i) {
+          telemetry::ScopedSpan s("test.worker_span");
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+    telemetry::RecordSpanOwned("test.owned \"name\"", telemetry::NowUs(), 5);
+  }
+  telemetry::TraceStop();
+  std::string js = telemetry::TraceDumpJson();
+  WalkJson(js);
+  auto events = ParseTrace(js);
+  if (!telemetry::Enabled()) {
+    EXPECT_EQV(events.size(), 0u);
+    return;
+  }
+  size_t workers = 0, owned = 0, outers = 0;
+  std::set<int64_t> worker_tids;
+  for (const auto& ev : events) {
+    EXPECT_EQV(ev.ph, std::string("X"));
+    EXPECT_TRUE(ev.ts >= 0 && ev.dur >= 0 && ev.tid >= 1);
+    if (ev.name == "test.worker_span") {
+      ++workers;
+      worker_tids.insert(ev.tid);
+    }
+    if (ev.name == "test.owned \"name\"") ++owned;
+    if (ev.name == "test.outer") ++outers;
+  }
+  EXPECT_EQV(workers, 150u);
+  EXPECT_TRUE(worker_tids.size() == 3);  // one trace lane per thread
+  EXPECT_EQV(owned, 1u);
+  EXPECT_EQV(outers, 1u);
+  // a fresh TraceStart clears the buffered spans
+  telemetry::TraceStart();
+  telemetry::TraceStop();
+  EXPECT_EQV(ParseTrace(telemetry::TraceDumpJson()).size(), 0u);
+}
+
+TESTCASE(spans_not_recorded_while_inactive) {
+  telemetry::TraceStart();
+  telemetry::TraceStop();
+  { telemetry::ScopedSpan s("test.after_stop"); }
+  for (const auto& ev : ParseTrace(telemetry::TraceDumpJson())) {
+    EXPECT_TRUE(ev.name != "test.after_stop");
+  }
+}
+
+TESTCASE(snapshot_during_active_pipeline) {
+  TemporaryDirectory tmp;
+  std::string f = MakeLibsvm(tmp.path, 20000);
+  auto* reg = telemetry::Registry::Get();
+  bool before_enabled = false;
+  std::map<std::string, int64_t> before_c, before_g;
+  ParseSnapshot(reg->SnapshotJson(), &before_enabled, &before_c, &before_g);
+
+  telemetry::TraceStart();
+  std::atomic<bool> done{false};
+  std::atomic<size_t> rows{0};
+  std::thread consumer([&] {
+    std::string uri = f + "?nthread=2";
+    auto parser = Parser<uint32_t>::Create(uri.c_str(), 0, 1, "libsvm");
+    size_t n = 0;
+    while (parser->Next()) n += parser->Value().size;
+    rows.store(n);
+    done.store(true);
+  });
+  // hammer snapshots + a counter while the parse pool runs: the registry
+  // must stay readable and every snapshot must stay well-formed JSON
+  size_t snapshots = 0;
+  while (!done.load()) {
+    WalkJson(reg->SnapshotJson());
+    reg->counter("test.during_pipeline").Add(1);
+    ++snapshots;
+  }
+  consumer.join();
+  telemetry::TraceStop();
+  EXPECT_TRUE(snapshots > 0);
+  EXPECT_EQV(rows.load(), 20000u);
+
+  std::map<std::string, int64_t> after_c, after_g;
+  ParseSnapshot(reg->SnapshotJson(), &before_enabled, &after_c, &after_g);
+  if (telemetry::Enabled()) {
+    EXPECT_TRUE(after_c["parse.rows"] - before_c["parse.rows"] == 20000);
+    EXPECT_TRUE(after_c["parse.nnz"] - before_c["parse.nnz"] == 60000);
+    EXPECT_TRUE(after_c["parse.busy_us"] >= before_c["parse.busy_us"]);
+    EXPECT_TRUE(after_c["split.bytes"] > before_c["split.bytes"]);
+    WalkJson(telemetry::TraceDumpJson());
+  }
+}
+
+TESTCASE(instrumentation_bit_identity) {
+  // tracing on vs off must not change parse output (same-build half of the
+  // guard; the DMLCTPU_TELEMETRY=0 check.sh tier re-runs this whole suite
+  // plus test_data against the stubbed build for the cross-build half)
+  TemporaryDirectory tmp;
+  std::string f = MakeLibsvm(tmp.path, 5000);
+  auto drain = [&] {
+    auto parser = Parser<uint32_t>::Create(f.c_str(), 0, 1, "libsvm");
+    data::RowBlockContainer<uint32_t> all;
+    while (parser->Next()) all.Push(parser->Value());
+    return all;
+  };
+  auto plain = drain();
+  telemetry::TraceStart();
+  auto traced = drain();
+  telemetry::TraceStop();
+  EXPECT_EQV(plain.Size(), traced.Size());
+  EXPECT_TRUE(plain.offset == traced.offset);
+  EXPECT_TRUE(plain.label == traced.label);
+  EXPECT_TRUE(plain.index == traced.index);
+  EXPECT_TRUE(std::memcmp(plain.value.data(), traced.value.data(),
+                          plain.value.size() * sizeof(float)) == 0);
+}
+
+TESTCASE(c_api_telemetry_surface) {
+  int enabled = -1;
+  EXPECT_EQV(DmlcTpuTelemetryEnabled(&enabled), 0);
+  EXPECT_EQV(enabled, telemetry::Enabled() ? 1 : 0);
+
+  EXPECT_EQV(DmlcTpuTelemetryCounterAdd("test.c_api_counter", 17), 0);
+  int64_t v = -1;
+  EXPECT_EQV(DmlcTpuTelemetryCounterGet("test.c_api_counter", &v), 0);
+  if (telemetry::Enabled()) EXPECT_TRUE(v >= 17);
+
+  const char* js = nullptr;
+  EXPECT_EQV(DmlcTpuTelemetrySnapshotJson(&js), 0);
+  EXPECT_TRUE(js != nullptr);
+  WalkJson(js);
+
+  EXPECT_EQV(DmlcTpuTelemetryTraceStart(), 0);
+  EXPECT_EQV(DmlcTpuTelemetryRecordSpan("test.c_api_span", 1000, 20), 0);
+  EXPECT_EQV(DmlcTpuTelemetryTraceStop(), 0);
+  EXPECT_EQV(DmlcTpuTelemetryTraceDumpJson(&js), 0);
+  auto events = ParseTrace(js);
+  if (telemetry::Enabled()) {
+    EXPECT_EQV(events.size(), 1u);
+    EXPECT_EQV(events[0].name, std::string("test.c_api_span"));
+    EXPECT_EQV(events[0].ts, int64_t{1000});
+    EXPECT_EQV(events[0].dur, int64_t{20});
+  }
+}
+
+namespace {
+std::vector<std::pair<int, std::string>>& CapturedLogs() {
+  static std::vector<std::pair<int, std::string>> logs;
+  return logs;
+}
+extern "C" void TestLogCallback(int severity, const char* where,
+                                const char* message) {
+  (void)where;
+  CapturedLogs().emplace_back(severity, message);
+}
+}  // namespace
+
+TESTCASE(log_callback_capture) {
+  CapturedLogs().clear();
+  EXPECT_EQV(DmlcTpuLogSetCallback(&TestLogCallback), 0);
+  TLOG(Warning) << "captured warning";
+  EXPECT_EQV(DmlcTpuLogEmit(3, "captured error"), 0);
+  EXPECT_EQV(DmlcTpuLogEmit(99, "clamped to error"), 0);  // never FATAL
+  EXPECT_EQV(DmlcTpuLogSetCallback(nullptr), 0);  // restore stderr sink
+  TLOG(Info) << "not captured (sink removed)";
+
+  EXPECT_EQV(CapturedLogs().size(), 3u);
+  EXPECT_EQV(CapturedLogs()[0].first, 2);
+  EXPECT_EQV(CapturedLogs()[0].second, std::string("captured warning"));
+  EXPECT_EQV(CapturedLogs()[1].first, 3);
+  EXPECT_EQV(CapturedLogs()[1].second, std::string("captured error"));
+  EXPECT_EQV(CapturedLogs()[2].first, 3);
+}
+
+TESTCASE(log_sink_swap_under_concurrent_emits) {
+  // SetSink copies the sink under a mutex before invoking: swapping sinks
+  // while worker threads log must neither crash nor deadlock
+  std::atomic<bool> stop{false};
+  std::atomic<int> seen{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&] {
+      while (!stop.load()) TLOG(Warning) << "spin";
+    });
+  }
+  for (int i = 0; i < 200; ++i) {
+    log::SetSink([&seen](LogSeverity, const char*, const std::string&) {
+      seen.fetch_add(1);
+    });
+    log::SetSink([](LogSeverity, const char*, const std::string&) {});
+  }
+  log::SetSink([&seen](LogSeverity, const char*, const std::string&) {
+    seen.fetch_add(1);
+  });
+  // let the workers hit the final sink at least once before stopping
+  while (seen.load() == 0) std::this_thread::yield();
+  stop.store(true);
+  for (auto& t : ts) t.join();
+  log::SetSink(log::Sink());
+  EXPECT_TRUE(seen.load() > 0);
+}
+
+TESTMAIN()

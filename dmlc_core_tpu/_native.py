@@ -182,6 +182,23 @@ _LIB.DmlcTpuFsListDirectory.argtypes = [
 _LIB.DmlcTpuFsPathInfo.argtypes = [
     ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
 
+_LIB.DmlcTpuTelemetryEnabled.argtypes = [ctypes.POINTER(ctypes.c_int)]
+_LIB.DmlcTpuTelemetrySnapshotJson.argtypes = [ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuTelemetryReset.argtypes = []
+_LIB.DmlcTpuTelemetryCounterAdd.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+_LIB.DmlcTpuTelemetryCounterGet.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+_LIB.DmlcTpuTelemetryTraceStart.argtypes = []
+_LIB.DmlcTpuTelemetryTraceStop.argtypes = []
+_LIB.DmlcTpuTelemetryTraceDumpJson.argtypes = [ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuTelemetryRecordSpan.argtypes = [
+    ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64]
+
+LOG_CALLBACK_TYPE = ctypes.CFUNCTYPE(
+    None, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)
+_LIB.DmlcTpuLogSetCallback.argtypes = [LOG_CALLBACK_TYPE]
+_LIB.DmlcTpuLogEmit.argtypes = [ctypes.c_int, ctypes.c_char_p]
+
 
 class NativeError(RuntimeError):
     """Error raised by the native dmlctpu runtime."""
@@ -212,3 +229,41 @@ def get_default_parse_threads() -> int:
     out = ctypes.c_int()
     check(_LIB.DmlcTpuGetDefaultParseThreads(ctypes.byref(out)))
     return out.value
+
+
+# Keeps the installed ctypes callback alive: native worker threads call it
+# long after set_log_callback returns, and GC'ing the CFUNCTYPE wrapper while
+# the native side holds its address is a use-after-free.
+_log_callback_keepalive = None
+
+
+def set_log_callback(fn) -> None:
+    """Install ``fn(severity:int, where:str, message:str)`` as the process-wide
+    log sink (replacing the default stderr sink), or restore stderr with
+    ``None``.  Called from arbitrary native threads; ctypes acquires the GIL
+    around the callback, so ``fn`` must not block on locks a logging thread
+    might hold."""
+    global _log_callback_keepalive
+    if fn is None:
+        null_cb = ctypes.cast(None, LOG_CALLBACK_TYPE)
+        check(_LIB.DmlcTpuLogSetCallback(null_cb))
+        _log_callback_keepalive = None
+        return
+
+    def _trampoline(severity, where, message):
+        try:
+            fn(int(severity),
+               (where or b"").decode(errors="replace"),
+               (message or b"").decode(errors="replace"))
+        except Exception:
+            pass  # a raising sink must never take down a native worker
+
+    cb = LOG_CALLBACK_TYPE(_trampoline)
+    check(_LIB.DmlcTpuLogSetCallback(cb))
+    _log_callback_keepalive = cb  # replace AFTER install: old cb may be live
+
+
+def log_emit(severity: int, message: str) -> None:
+    """Send one message through the native logging pipeline (0=DEBUG 1=INFO
+    2=WARNING 3=ERROR; honors DMLCTPU_LOG_LEVEL)."""
+    check(_LIB.DmlcTpuLogEmit(int(severity), str(message).encode()))

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from .._native import check, lib
+from .. import telemetry
 from .rowblock import Parser  # noqa: F401  (re-exported convenience)
 
 LOGGER = logging.getLogger("dmlc_core_tpu.staging")
@@ -533,15 +534,30 @@ class RecordStagingIter:
         self._num_workers = max(int(num_workers), 1)
         self._reorder = reorder
         self._virtual_parts = 0  # resolved lazily on the first parallel epoch
-        self._parallel_bytes = 0
-        self._bytes_lock = threading.Lock()  # _parallel_bytes += on workers
+        # Unified byte accounting: every native RecordBatcher — the main
+        # handle AND each per-virtual-part parallel cursor — publishes chunk
+        # bytes into the process-wide "record.bytes" telemetry counter at
+        # the single native counting site (record_batcher.h), so the single
+        # and parallel paths share one tally instead of the old hand-rolled
+        # _parallel_bytes sum.  The baseline makes bytes_read a
+        # per-iterator delta.
+        self._telemetry_bytes = telemetry.enabled()
+        self._bytes_base = (telemetry.counter_get("record.bytes")
+                            if self._telemetry_bytes else 0)
         self._lock = threading.Lock()
         self.batches_staged = 0
 
     @property
     def bytes_read(self) -> int:
-        return (self._lib.DmlcTpuRecordBatcherBytesRead(self._handle)
-                + self._parallel_bytes)
+        """Wire bytes consumed since construction (throughput metric).
+
+        Counted process-wide via telemetry, so concurrent RecordStagingIters
+        in one process see each other's reads.  With telemetry compiled out
+        (DMLCTPU_TELEMETRY=0) this falls back to the main handle's count and
+        parallel-worker bytes are not attributed."""
+        if self._telemetry_bytes:
+            return telemetry.counter_get("record.bytes") - self._bytes_base
+        return self._lib.DmlcTpuRecordBatcherBytesRead(self._handle)
 
     def close(self) -> None:
         # serialize with the producer thread: freeing the native batcher while
@@ -580,7 +596,8 @@ class RecordStagingIter:
         }
 
     def _stage(self, w: dict) -> RecordBatch:
-        with jax.profiler.TraceAnnotation("dmlctpu.stage_records"):
+        with telemetry.span("h2d.stage_records"), \
+                jax.profiler.TraceAnnotation("dmlctpu.stage_records"):
             def put(arr):
                 if self._sharding is not None:
                     return jax.device_put(arr, self._sharding)
@@ -625,10 +642,9 @@ class RecordStagingIter:
             while check(L.DmlcTpuRecordBatcherNext(h, ctypes.byref(c))) == 1:
                 yield self._wrap_host(c)
         finally:
-            nb = L.DmlcTpuRecordBatcherBytesRead(h)
+            # bytes flow through the shared "record.bytes" telemetry counter
+            # as the cursor reads; nothing to tally here
             L.DmlcTpuRecordBatcherFree(h)
-            with self._bytes_lock:  # += is not atomic across pool workers
-                self._parallel_bytes += nb
 
     def _produce_host(self, emit) -> None:
         """Drive the native read+pack, emitting host batch dicts."""
@@ -702,8 +718,20 @@ class RecordStagingIter:
 
         def produce(emit):
             try:
-                for w in host_iter:
-                    if not emit(self._stage(w)):
+                it = iter(host_iter)
+                while True:
+                    t0 = time.monotonic()
+                    w = next(it, None)
+                    t1 = time.monotonic()
+                    if w is None:
+                        return
+                    batch = self._stage(w)
+                    t2 = time.monotonic()
+                    ok = emit(batch)
+                    telemetry.counter_add("h2d.wait_us", int((t1 - t0) * 1e6))
+                    telemetry.counter_add("h2d.busy_us", int((t2 - t1) * 1e6))
+                    telemetry.counter_add("h2d.batches", 1)
+                    if not ok:
                         return
             finally:
                 host_iter.close()
@@ -816,8 +844,11 @@ class DeviceStagingIter:
 
     # ---- staging ------------------------------------------------------------
     def _stage(self, w: dict) -> PaddedBatch:
-        # visible as one span per staged batch in jax profiler / xplane traces
-        with jax.profiler.TraceAnnotation("dmlctpu.stage_batch"):
+        # visible as one span per staged batch in jax profiler / xplane
+        # traces AND in the dmlctpu telemetry trace (shared steady-clock
+        # epoch with the native parse/pack spans)
+        with telemetry.span("h2d.stage_batch"), \
+                jax.profiler.TraceAnnotation("dmlctpu.stage_batch"):
             return self._stage_inner(w)
 
     def _stage_inner(self, w: dict) -> PaddedBatch:
@@ -1056,8 +1087,17 @@ class DeviceStagingIter:
                     t2 = time.monotonic()
                     prof["stage_s"] += t2 - t1
                     ok = emit(batch)
-                    prof["emit_wait_s"] += time.monotonic() - t2
+                    t3 = time.monotonic()
+                    prof["emit_wait_s"] += t3 - t2
                     prof["batches"] += 1
+                    # publish H2D feed occupancy into the process-wide
+                    # telemetry registry (same us units as the native
+                    # stages, so stall_attribution sees the whole pipeline)
+                    telemetry.counter_add("h2d.wait_us", int((t1 - t0) * 1e6))
+                    telemetry.counter_add("h2d.busy_us", int((t2 - t1) * 1e6))
+                    telemetry.counter_add("h2d.emit_wait_us",
+                                          int((t3 - t2) * 1e6))
+                    telemetry.counter_add("h2d.batches", 1)
                     if not ok:
                         return
             finally:

@@ -1,0 +1,213 @@
+"""Pipeline telemetry: counters, trace spans, and stall attribution.
+
+Python face of ``dmlctpu/telemetry.h``.  The native runtime keeps one
+process-wide registry of relaxed-atomic counters/gauges/histograms that
+every pipeline stage (InputSplit readers, the text-parse pool, the
+ShardedParser worker pool, the StagedBatcher, and — via this module — the
+H2D device feed) updates as it runs.  This module reads snapshots, drives
+trace recording, and turns two snapshots plus a wall-clock interval into a
+stall-attribution table ("parse-bound 71%, h2d-bound 22%").
+
+Everything degrades to cheap no-ops when the native library was compiled
+with ``DMLCTPU_TELEMETRY=0``: :func:`enabled` returns ``False``, snapshots
+report ``{"enabled": False}``, counters read 0, and traces are empty.
+
+See ``doc/observability.md`` for the metric name contract and how to read
+the attribution table.
+"""
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import json
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import _native
+
+__all__ = [
+    "enabled", "snapshot", "reset", "counter_add", "counter_get",
+    "counters_delta", "trace_start", "trace_stop", "trace_dump_json",
+    "trace_dump", "record_span", "span", "stall_attribution",
+    "format_stall_table", "capture_logs",
+]
+
+
+def enabled() -> bool:
+    """True when the native library was built with telemetry compiled in."""
+    out = ctypes.c_int()
+    _native.check(_native.lib().DmlcTpuTelemetryEnabled(ctypes.byref(out)))
+    return bool(out.value)
+
+
+def snapshot() -> dict:
+    """Parsed JSON snapshot: ``{"enabled", "counters", "gauges",
+    "histograms"}`` (the latter three absent when telemetry is compiled
+    out)."""
+    out = ctypes.c_char_p()
+    _native.check(
+        _native.lib().DmlcTpuTelemetrySnapshotJson(ctypes.byref(out)))
+    return json.loads((out.value or b"{}").decode())
+
+
+def reset() -> None:
+    """Zero every registered metric (they stay registered)."""
+    _native.check(_native.lib().DmlcTpuTelemetryReset())
+
+
+def counter_add(name: str, delta: int) -> None:
+    """Add ``delta`` (>=0) to the named process-wide counter, creating it on
+    first use.  This is how the staging loop publishes H2D occupancy."""
+    _native.check(
+        _native.lib().DmlcTpuTelemetryCounterAdd(name.encode(), int(delta)))
+
+
+def counter_get(name: str) -> int:
+    out = ctypes.c_int64()
+    _native.check(
+        _native.lib().DmlcTpuTelemetryCounterGet(name.encode(),
+                                                 ctypes.byref(out)))
+    return int(out.value)
+
+
+def counters_delta(before: dict, after: dict) -> Dict[str, int]:
+    """Per-counter difference between two :func:`snapshot` results (counters
+    are monotonic, so this is the activity in the interval)."""
+    b = before.get("counters", {})
+    return {k: v - b.get(k, 0) for k, v in after.get("counters", {}).items()}
+
+
+# ---- traces -----------------------------------------------------------------
+
+def trace_start() -> None:
+    """Start buffering spans (clears spans from any previous trace)."""
+    _native.check(_native.lib().DmlcTpuTelemetryTraceStart())
+
+
+def trace_stop() -> None:
+    _native.check(_native.lib().DmlcTpuTelemetryTraceStop())
+
+
+def trace_dump_json() -> str:
+    """Chrome trace-event JSON (load in chrome://tracing or Perfetto)."""
+    out = ctypes.c_char_p()
+    _native.check(
+        _native.lib().DmlcTpuTelemetryTraceDumpJson(ctypes.byref(out)))
+    return (out.value or b"{}").decode()
+
+
+def trace_dump() -> dict:
+    return json.loads(trace_dump_json())
+
+
+def record_span(name: str, ts_us: int, dur_us: int) -> None:
+    """Record one complete span into the active trace.  Timestamps are
+    steady-clock microseconds — ``time.monotonic_ns() // 1000`` on Linux
+    shares an epoch with the native spans, so Python and C++ spans line up
+    on one timeline."""
+    _native.check(
+        _native.lib().DmlcTpuTelemetryRecordSpan(name.encode(), int(ts_us),
+                                                 int(dur_us)))
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Context manager recording its body as a span when tracing is on."""
+    t0 = time.monotonic_ns() // 1000
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.monotonic_ns() // 1000 - t0)
+
+
+# ---- stall attribution ------------------------------------------------------
+
+# (stage, busy counter, wait counter) — the contract with the native
+# instrumentation; see doc/observability.md for what each pair means.
+_STAGES: Tuple[Tuple[str, str, str], ...] = (
+    ("parse", "parse.busy_us", "parse.input_wait_us"),
+    ("shard", "shard.part_us", "shard.producer_wait_us"),
+    ("pack", "pack.busy_us", "pack.input_wait_us"),
+    ("h2d", "h2d.busy_us", "h2d.wait_us"),
+)
+
+
+def stall_attribution(before: dict, after: dict,
+                      wall_s: Optional[float] = None) -> dict:
+    """Derive per-stage busy/wait seconds and a bottleneck ranking from two
+    snapshots.
+
+    Returns ``{"stages": {name: {"busy_s", "wait_s"}}, "bound": {...},
+    "bound_stage": str|None, "table": str, "wall_s": float|None}``.
+
+    ``bound`` shares are each candidate stage's busy seconds over the busy
+    total.  ``parse`` is excluded from the candidates whenever the sharded
+    pool ran (its workers' parse time is already inside ``shard`` busy);
+    ``shard`` busy is part wall time minus producer stalls.
+    """
+    d = counters_delta(before, after)
+    us = lambda k: d.get(k, 0) / 1e6  # noqa: E731
+
+    stages: Dict[str, Dict[str, float]] = {}
+    for name, busy_key, wait_key in _STAGES:
+        busy, wait = us(busy_key), us(wait_key)
+        if name == "shard":
+            busy = max(busy - wait, 0.0)
+        stages[name] = {"busy_s": round(busy, 6), "wait_s": round(wait, 6)}
+
+    sharded = d.get("shard.parts", 0) > 0
+    candidates = [n for n in stages if not (sharded and n == "parse")]
+    total_busy = sum(stages[n]["busy_s"] for n in candidates)
+    bound = {
+        n: round(100.0 * stages[n]["busy_s"] / total_busy, 1)
+        for n in candidates
+    } if total_busy > 0 else {}
+    bound_stage = max(bound, key=bound.get) if bound else None
+    table = ", ".join(f"{n}-bound {bound[n]:.0f}%"
+                      for n in sorted(bound, key=bound.get, reverse=True)
+                      if bound[n] >= 0.5)
+    return {
+        "stages": stages,
+        "bound": bound,
+        "bound_stage": bound_stage,
+        "table": table,
+        "wall_s": None if wall_s is None else round(wall_s, 6),
+    }
+
+
+def format_stall_table(attr: dict) -> str:
+    """Render a :func:`stall_attribution` result as an aligned text table."""
+    lines = ["stage     busy_s    wait_s   bound%"]
+    for name, st in attr["stages"].items():
+        pct = attr["bound"].get(name)
+        lines.append(f"{name:<8}{st['busy_s']:>9.3f}{st['wait_s']:>10.3f}"
+                     f"{'' if pct is None else f'{pct:>8.1f}'}")
+    if attr["table"]:
+        lines.append(attr["table"])
+    return "\n".join(lines)
+
+
+# ---- log capture ------------------------------------------------------------
+
+@contextlib.contextmanager
+def capture_logs(min_severity: int = 2,
+                 forward: Optional[Callable[[int, str, str], None]] = None,
+                 ) -> Iterator[List[Tuple[int, str, str]]]:
+    """Capture native log lines at or above ``min_severity`` (0=DEBUG 1=INFO
+    2=WARNING 3=ERROR) as ``(severity, where, message)`` tuples instead of
+    letting them hit stderr.  Restores the stderr sink on exit.  The sink is
+    process-wide: nesting or concurrent captures see whichever was installed
+    last."""
+    records: List[Tuple[int, str, str]] = []
+
+    def sink(severity: int, where: str, message: str) -> None:
+        if severity >= min_severity:
+            records.append((severity, where, message))
+        if forward is not None:
+            forward(severity, where, message)
+
+    _native.set_log_callback(sink)
+    try:
+        yield records
+    finally:
+        _native.set_log_callback(None)

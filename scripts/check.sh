@@ -32,7 +32,7 @@ ninja -C build >/dev/null
 # or mixed old/new binaries.  MUST release before pytest — _native.py's
 # loader takes a shared lock on this file from child processes, which
 # would deadlock against our held exclusive one.
-for t in test_core test_runtime test_data test_endian test_input_split test_remote_fs; do
+for t in test_core test_runtime test_data test_endian test_input_split test_remote_fs test_telemetry; do
   if ! ./build/"$t" >/tmp/dmlctpu_check_$t.log 2>&1; then
     echo "check.sh: NATIVE SUITE FAILED: $t (log: /tmp/dmlctpu_check_$t.log)" >&2
     exit 1
@@ -41,29 +41,55 @@ done
 
 # ThreadSanitizer tier: test_data is the parser/staging suite, so this gives
 # the persistent parse pool (text_parser.h) and the sharded staging pool
-# (sharded_parser.h) a TSan pass on every check.  cmake configures
+# (sharded_parser.h) a TSan pass on every check; test_telemetry adds the
+# registry/trace-buffer/log-sink concurrency (snapshot during an active
+# pipeline, sink swap under concurrent emits).  cmake configures
 # DMLCTPU_ENABLE_SANITIZER=ON; containers without cmake/ninja fall back to a
 # direct g++ TSan build (mirrors _native.py's _build_direct fallback).
 mkdir -p build/tsan
-if command -v cmake >/dev/null && command -v ninja >/dev/null; then
-  cmake -S . -B build/tsan -G Ninja -DDMLCTPU_ENABLE_SANITIZER=ON \
-        -DDMLCTPU_SANITIZER=thread >/dev/null
-  ninja -C build/tsan test_data >/dev/null
-  tsan_bin=build/tsan/test_data
-else
-  tsan_bin=build/tsan/test_data
-  g++ -O1 -g -std=c++20 -fsanitize=thread -fno-omit-frame-pointer -pthread \
-      -I cpp/include -I cpp cpp/tests/test_data.cc cpp/src/*.cc \
-      cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$tsan_bin"
-fi
-if ! "$tsan_bin" >/tmp/dmlctpu_check_tsan_test_data.log 2>&1; then
-  echo "check.sh: TSAN SUITE FAILED: test_data (log: /tmp/dmlctpu_check_tsan_test_data.log)" >&2
-  exit 1
-fi
-if grep -q "WARNING: ThreadSanitizer" /tmp/dmlctpu_check_tsan_test_data.log; then
-  echo "check.sh: TSAN RACE REPORTED (log: /tmp/dmlctpu_check_tsan_test_data.log)" >&2
-  exit 1
-fi
+for t in test_data test_telemetry; do
+  tsan_bin=build/tsan/$t
+  if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+    cmake -S . -B build/tsan -G Ninja -DDMLCTPU_ENABLE_SANITIZER=ON \
+          -DDMLCTPU_SANITIZER=thread >/dev/null
+    ninja -C build/tsan "$t" >/dev/null
+  else
+    g++ -O1 -g -std=c++20 -fsanitize=thread -fno-omit-frame-pointer -pthread \
+        -I cpp/include -I cpp cpp/tests/"$t".cc cpp/src/*.cc \
+        cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$tsan_bin"
+  fi
+  if ! "$tsan_bin" >/tmp/dmlctpu_check_tsan_$t.log 2>&1; then
+    echo "check.sh: TSAN SUITE FAILED: $t (log: /tmp/dmlctpu_check_tsan_$t.log)" >&2
+    exit 1
+  fi
+  if grep -q "WARNING: ThreadSanitizer" /tmp/dmlctpu_check_tsan_$t.log; then
+    echo "check.sh: TSAN RACE REPORTED (log: /tmp/dmlctpu_check_tsan_$t.log)" >&2
+    exit 1
+  fi
+done
+
+# Telemetry-opt-out tier: the instrumentation contract says every call site
+# compiles to nothing under -DDMLCTPU_TELEMETRY=0.  Build the parser/staging
+# suite and the telemetry suite against the stubbed header and run both —
+# test_telemetry's assertions flip to the stubbed expectations, and
+# test_data passing proves the pipeline is bit-identical without telemetry.
+mkdir -p build/notelemetry
+for t in test_data test_telemetry; do
+  nt_bin=build/notelemetry/$t
+  if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+    cmake -S . -B build/notelemetry -G Ninja -DCMAKE_BUILD_TYPE=Release \
+          -DDMLCTPU_TELEMETRY=OFF >/dev/null
+    ninja -C build/notelemetry "$t" >/dev/null
+  else
+    g++ -O1 -g -std=c++20 -DDMLCTPU_TELEMETRY=0 -pthread \
+        -I cpp/include -I cpp cpp/tests/"$t".cc cpp/src/*.cc \
+        cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$nt_bin"
+  fi
+  if ! "$nt_bin" >/tmp/dmlctpu_check_notelemetry_$t.log 2>&1; then
+    echo "check.sh: NOTELEMETRY SUITE FAILED: $t (log: /tmp/dmlctpu_check_notelemetry_$t.log)" >&2
+    exit 1
+  fi
+done
 flock -u 9
 
 if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
@@ -76,4 +102,4 @@ fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
 py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier")
-echo "check.sh: green (6 native suites + TSan parser/staging + $py)"
+echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + $py)"
