@@ -841,6 +841,23 @@ def run_staging(data: Path, fmt: str = "auto", num_workers: int = 4) -> dict:
         "cpu_count": os.cpu_count(),
         "stall_attribution": attr,
     }
+    # Job-table view of the pooled epoch: push this process's snapshot
+    # through the REAL tracker aggregation channel (loopback aggregator +
+    # one wire push) and record the rendered per-host table — the bench
+    # artifact shows exactly what a job operator sees from the tracker,
+    # and exercises the push/merge/format path on every bench run.
+    try:
+        from dmlc_core_tpu.tracker.metrics import MetricsAggregator, push_once
+        agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+        try:
+            push_once("127.0.0.1", agg.port, rank=0)
+            job = agg.job_snapshot()
+            result["parallel"]["job_table"] = agg.format_job_table()
+            result["parallel"]["job_num_hosts"] = job["num_hosts"]
+        finally:
+            agg.close()
+    except Exception as e:  # observability must never sink the bench round
+        result["parallel"]["job_table"] = ("error: " + str(e))[-200:]
     return result
 
 
@@ -1219,6 +1236,7 @@ def main() -> None:
         "pallas_segment": phases.get("pallas_segment"),
         "stall_attribution": staging.get("parallel", {}).get(
             "stall_attribution"),
+        "staging_job_table": staging.get("parallel", {}).get("job_table"),
         "telemetry_overhead": overhead,
         "tpu_probe": probe_summary,
         "data_mb": data.stat().st_size >> 20,

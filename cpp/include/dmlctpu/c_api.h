@@ -250,6 +250,31 @@ int DmlcTpuTelemetryTraceDumpJson(const char** out);
  * time.monotonic_ns()//1000) into the active trace. */
 int DmlcTpuTelemetryRecordSpan(const char* name, int64_t ts_us,
                                int64_t dur_us);
+/* set/adjust/read the named process-wide gauge (created on first use) —
+ * how the Python staging loop publishes H2D queue depth for the flight
+ * recorder. */
+int DmlcTpuTelemetryGaugeSet(const char* name, int64_t value);
+int DmlcTpuTelemetryGaugeAdd(const char* name, int64_t delta);
+int DmlcTpuTelemetryGaugeGet(const char* name, int64_t* out);
+
+/* ---- stall watchdog + flight recorder (dmlctpu/watchdog.h) ---------------- */
+/* (Re)arm the watchdog: fire when NO pipeline progress counter moves for
+ * deadline_ms.  poll_ms=0 derives the sampling period from the deadline.
+ * abort_on_stall=0 logs an ERROR and re-arms; nonzero dumps then aborts the
+ * process.  dump_path NULL/"" writes the flight record to the log sink only.
+ * All of this degrades to a no-op when telemetry is compiled out. */
+int DmlcTpuWatchdogStart(int64_t deadline_ms, int64_t poll_ms,
+                         int abort_on_stall, const char* dump_path);
+int DmlcTpuWatchdogStop(void);
+int DmlcTpuWatchdogRunning(int* out);
+/* stalls detected since process start (survives arm/disarm cycles). */
+int DmlcTpuWatchdogStallCount(int64_t* out);
+/* Build a flight record now (stalled stage, per-stage progress ages, full
+ * registry snapshot, trace dump); pointer valid until the next telemetry
+ * call on the same thread. */
+int DmlcTpuFlightRecordJson(const char* reason, const char** out);
+/* the record dumped by the most recent watchdog stall ("" when none). */
+int DmlcTpuWatchdogLastRecordJson(const char** out);
 
 /* ---- logging ------------------------------------------------------------- */
 /* severity: 0=DEBUG 1=INFO 2=WARNING 3=ERROR 4=FATAL.  `where` is

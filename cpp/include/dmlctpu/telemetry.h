@@ -26,6 +26,7 @@
 #endif
 
 #include <cstdint>
+#include <map>
 #include <string>
 
 #if DMLCTPU_TELEMETRY
@@ -105,6 +106,31 @@ class Histogram {
   std::atomic<uint64_t> sum_{0};
 };
 
+/*! \brief point-in-time copy of a registry's values — the unit the tracker
+ *  aggregates across processes.  Counters and histogram buckets merge by
+ *  addition (exact: both are event tallies); gauges merge by addition too,
+ *  so a merged level gauge reads as the job-wide total (e.g. fleet buffered
+ *  bytes).  Merged histogram quantiles stay CONSERVATIVE: every bucket keeps
+ *  its upper bound, so a quantile read off the merged buckets never
+ *  understates the true per-event quantile of the union. */
+struct Snapshot {
+  struct Hist {
+    uint64_t count = 0;
+    uint64_t sum = 0;
+    uint64_t buckets[Histogram::kBuckets] = {};
+  };
+  std::map<std::string, uint64_t> counters;
+  std::map<std::string, int64_t> gauges;
+  std::map<std::string, Hist> histograms;
+
+  /*! \brief copy the process registry's current values */
+  static Snapshot Capture();
+  /*! \brief fold another snapshot into this one (see merge rules above) */
+  void Merge(const Snapshot& other);
+  /*! \brief same JSON shape as Registry::SnapshotJson() */
+  std::string ToJson() const;
+};
+
 /*! \brief process-wide named registry.  Lookup takes a mutex; returned
  *  references are stable forever, so cache them in a local static:
  *    static Counter& c = Registry::Get()->counter("parse.rows");
@@ -122,6 +148,7 @@ class Registry {
   void ResetAll();
 
  private:
+  friend struct Snapshot;  // Capture() walks impl_ under its mutex
   Registry() = default;
   struct Impl;
   Impl* impl_ = nullptr;  // owned, never freed (process-lifetime singleton)
@@ -229,6 +256,22 @@ class Histogram {
   void Reset() {}
 };
 
+struct Snapshot {
+  struct Hist {
+    uint64_t count = 0;
+    uint64_t sum = 0;
+    uint64_t buckets[Histogram::kBuckets] = {};
+  };
+  // same surface as the real Snapshot so callers compile unchanged;
+  // Capture() always returns empty maps in the stubbed build
+  std::map<std::string, uint64_t> counters;
+  std::map<std::string, int64_t> gauges;
+  std::map<std::string, Hist> histograms;
+  static Snapshot Capture() { return Snapshot(); }
+  void Merge(const Snapshot&) {}
+  std::string ToJson() const { return "{\"enabled\":false}"; }
+};
+
 class Registry {
  public:
   static Registry* Get() {
@@ -323,6 +366,10 @@ DMLCTPU_STAGE_COUNTER(ShardPartUs, "shard.part_us")
 DMLCTPU_STAGE_COUNTER(ShardProducerWaitUs, "shard.producer_wait_us")
 DMLCTPU_STAGE_COUNTER(ShardConsumerWaitUs, "shard.consumer_wait_us")
 DMLCTPU_STAGE_GAUGE(ShardBufferedBytes, "shard.buffered_bytes")
+// Pool position (flight-recorder state): how many virtual parts have been
+// claimed by workers vs drained by the consumer.
+DMLCTPU_STAGE_GAUGE(ShardNextPart, "shard.next_part")
+DMLCTPU_STAGE_GAUGE(ShardEmitPart, "shard.emit_part")
 // StagedBatcher: arena pack/pad.  busy_us excludes time blocked in the
 // upstream parser's Next() (that is input_wait_us), so the pair cleanly
 // splits "packing is slow" from "packing is starved".
@@ -331,6 +378,10 @@ DMLCTPU_STAGE_COUNTER(PackRows, "pack.rows")
 DMLCTPU_STAGE_COUNTER(PackBusyUs, "pack.busy_us")
 DMLCTPU_STAGE_COUNTER(PackInputWaitUs, "pack.input_wait_us")
 DMLCTPU_STAGE_HISTOGRAM(PackBatchUs, "pack.batch_us")
+// Packed-but-unconsumed batches across the process's StagedBatchers
+// (flight-recorder occupancy: >0 during a stall means the consumer side
+// wedged, 0 means packing starved).
+DMLCTPU_STAGE_GAUGE(PackQueued, "pack.queued")
 // RecordBatcher: unified byte accounting (every native batcher publishes
 // chunk bytes here; RecordStagingIter.bytes_read reads the delta).
 DMLCTPU_STAGE_COUNTER(RecordBatches, "record.batches")

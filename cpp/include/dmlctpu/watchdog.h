@@ -1,0 +1,78 @@
+// dmlctpu/watchdog.h — stall watchdog + flight recorder.
+//
+// A single background thread samples the pipeline's progress counters
+// (split.bytes, parse.rows, shard.chunks, pack.batches, record.batches,
+// h2d.batches).  When NO counter moves for a configurable deadline the
+// pipeline has wedged: the watchdog dumps a flight record — per-thread
+// trace-span buffers, every gauge (sharded pool part cursors, StagedBatcher
+// occupancy, H2D feed state), and each stage's progress age, naming the
+// stage that stopped first — to a JSON file and the log sink, then either
+// warns (default) or aborts the process per policy.  See
+// doc/observability.md ("Stall watchdog and flight records").
+//
+// Progress-counter sampling is read-only on the relaxed atomics the stages
+// already publish, so an armed watchdog costs the pipeline nothing.  With
+// -DDMLCTPU_TELEMETRY=0 everything here is an inline no-op.
+#ifndef DMLCTPU_WATCHDOG_H_
+#define DMLCTPU_WATCHDOG_H_
+
+#include <cstdint>
+#include <string>
+
+#include "dmlctpu/telemetry.h"
+
+namespace dmlctpu {
+namespace telemetry {
+
+struct WatchdogOptions {
+  /*! \brief no-forward-progress window before a stall fires */
+  int64_t deadline_ms = 30000;
+  /*! \brief sampling period; 0 derives deadline_ms/4 clamped to [50,1000] */
+  int64_t poll_ms = 0;
+  /*! \brief policy: false = ERROR-log and keep running (re-armed), true =
+   *  dump then std::abort() — for jobs where a wedged input pipeline must
+   *  fail fast instead of burning accelerator reservations */
+  bool abort_on_stall = false;
+  /*! \brief flight-record file path ("" = log sink only) */
+  std::string dump_path;
+};
+
+#if DMLCTPU_TELEMETRY
+
+/*! \brief (re)arm the watchdog thread with these options.  Idempotent in
+ *  the sense that a second Start replaces the configuration; pair every
+ *  Start with a Stop (the Python binding refcounts for you). */
+void WatchdogStart(const WatchdogOptions& opts);
+/*! \brief stop and join the watchdog thread (no-op when not running). */
+void WatchdogStop();
+/*! \brief true while the watchdog thread is armed. */
+bool WatchdogRunning();
+/*! \brief stalls detected since process start (across arm/disarm cycles). */
+uint64_t WatchdogStallCount();
+
+/*! \brief build a flight record right now (same JSON the watchdog dumps):
+ *  {"enabled","reason","now_us","stall_count","deadline_ms","stalled_stage",
+ *   "stages":[{stage,counter,value,progressed,age_us}...],
+ *   "registry":<SnapshotJson>,"trace":<TraceDumpJson>}.
+ *  Progress ages come from the armed watchdog's samples; unarmed, ages are
+ *  -1 and stalled_stage is "". */
+std::string FlightRecordJson(const std::string& reason);
+/*! \brief the record from the most recent stall ("" when none fired). */
+std::string LastFlightRecordJson();
+
+#else  // DMLCTPU_TELEMETRY == 0
+
+inline void WatchdogStart(const WatchdogOptions&) {}
+inline void WatchdogStop() {}
+inline bool WatchdogRunning() { return false; }
+inline uint64_t WatchdogStallCount() { return 0; }
+inline std::string FlightRecordJson(const std::string&) {
+  return "{\"enabled\":false}";
+}
+inline std::string LastFlightRecordJson() { return std::string(); }
+
+#endif  // DMLCTPU_TELEMETRY
+
+}  // namespace telemetry
+}  // namespace dmlctpu
+#endif  // DMLCTPU_WATCHDOG_H_

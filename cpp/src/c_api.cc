@@ -17,6 +17,7 @@
 #include "dmlctpu/recordio.h"
 #include "dmlctpu/stream.h"
 #include "dmlctpu/telemetry.h"
+#include "dmlctpu/watchdog.h"
 
 namespace {
 
@@ -167,6 +168,80 @@ int DmlcTpuTelemetryRecordSpan(const char* name, int64_t ts_us,
     if (dmlctpu::telemetry::TraceActive()) {
       dmlctpu::telemetry::RecordSpanOwned(name, ts_us, dur_us);
     }
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryGaugeSet(const char* name, int64_t value) {
+  return Guard([&] {
+    dmlctpu::telemetry::Registry::Get()->gauge(name).Set(value);
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryGaugeAdd(const char* name, int64_t delta) {
+  return Guard([&] {
+    dmlctpu::telemetry::Registry::Get()->gauge(name).Add(delta);
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryGaugeGet(const char* name, int64_t* out) {
+  return Guard([&] {
+    *out = dmlctpu::telemetry::Registry::Get()->gauge(name).Value();
+    return 0;
+  });
+}
+
+/* ---- watchdog / flight recorder ------------------------------------------- */
+
+int DmlcTpuWatchdogStart(int64_t deadline_ms, int64_t poll_ms,
+                         int abort_on_stall, const char* dump_path) {
+  return Guard([&] {
+    dmlctpu::telemetry::WatchdogOptions opts;
+    opts.deadline_ms = deadline_ms;
+    opts.poll_ms = poll_ms;
+    opts.abort_on_stall = abort_on_stall != 0;
+    opts.dump_path = dump_path == nullptr ? "" : dump_path;
+    dmlctpu::telemetry::WatchdogStart(opts);
+    return 0;
+  });
+}
+
+int DmlcTpuWatchdogStop(void) {
+  return Guard([&] {
+    dmlctpu::telemetry::WatchdogStop();
+    return 0;
+  });
+}
+
+int DmlcTpuWatchdogRunning(int* out) {
+  return Guard([&] {
+    *out = dmlctpu::telemetry::WatchdogRunning() ? 1 : 0;
+    return 0;
+  });
+}
+
+int DmlcTpuWatchdogStallCount(int64_t* out) {
+  return Guard([&] {
+    *out = static_cast<int64_t>(dmlctpu::telemetry::WatchdogStallCount());
+    return 0;
+  });
+}
+
+int DmlcTpuFlightRecordJson(const char* reason, const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::telemetry::FlightRecordJson(
+        reason == nullptr ? "" : reason);
+    *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuWatchdogLastRecordJson(const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::telemetry::LastFlightRecordJson();
+    *out = telemetry_json.c_str();
     return 0;
   });
 }

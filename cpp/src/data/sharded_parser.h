@@ -98,6 +98,8 @@ class ShardedParser : public Parser<IndexType, DType> {
       buffered_bytes_ = 0;
       error_ = nullptr;
       stop_ = false;
+      telemetry::stage::ShardNextPart().Set(0);
+      telemetry::stage::ShardEmitPart().Set(0);
     }
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -186,6 +188,7 @@ class ShardedParser : public Parser<IndexType, DType> {
           std::lock_guard<std::mutex> lk(mu_);
           if (stop_ || error_ || next_claim_ >= virtual_parts_) return;
           j = next_claim_++;
+          telemetry::stage::ShardNextPart().Set(next_claim_);
           parts_[j];  // publish the (empty) queue so the consumer can see it
         }
         cv_consume_.notify_all();  // consumer may be waiting on parts_[j]
@@ -299,6 +302,7 @@ class ShardedParser : public Parser<IndexType, DType> {
           if (it->second.done) {
             parts_.erase(it);
             ++emit_part_;
+            telemetry::stage::ShardEmitPart().Set(emit_part_);
             // a producer blocked on the full buffer may have just become
             // the emit part (its wait exemption turned true): wake it, or
             // the pipeline wedges with everyone asleep

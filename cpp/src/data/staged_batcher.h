@@ -202,6 +202,7 @@ class StagedBatcherT {
     out->pool = pool_;
     out->arena = std::move(s->arena);
     iter_.Recycle(&s);
+    telemetry::stage::PackQueued().Add(-1);
     return true;
   }
   void BeforeFirst() { iter_.BeforeFirst(); }
@@ -303,6 +304,7 @@ class StagedBatcherT {
       ts::PackBatches().Add(1);
       ts::PackRows().Add(rows);
       ts::PackBatchUs().Observe(static_cast<uint64_t>(total));
+      ts::PackQueued().Add(1);
     }
     return true;
   }

@@ -178,6 +178,72 @@ void Registry::ResetAll() {
   for (auto& [name, h] : impl_->histograms) h.Reset();
 }
 
+// ---- Snapshot ---------------------------------------------------------------
+
+Snapshot Snapshot::Capture() {
+  Registry::Impl* impl = Registry::Get()->impl_;
+  std::lock_guard<std::mutex> lk(impl->mu);
+  Snapshot s;
+  for (const auto& [name, c] : impl->counters) s.counters[name] = c.Value();
+  for (const auto& [name, g] : impl->gauges) s.gauges[name] = g.Value();
+  for (const auto& [name, h] : impl->histograms) {
+    Hist& hd = s.histograms[name];
+    hd.count = h.Count();
+    hd.sum = h.Sum();
+    for (int i = 0; i < Histogram::kBuckets; ++i) hd.buckets[i] = h.Bucket(i);
+  }
+  return s;
+}
+
+void Snapshot::Merge(const Snapshot& other) {
+  for (const auto& [name, v] : other.counters) counters[name] += v;
+  for (const auto& [name, v] : other.gauges) gauges[name] += v;
+  for (const auto& [name, oh] : other.histograms) {
+    Hist& h = histograms[name];
+    h.count += oh.count;
+    h.sum += oh.sum;
+    for (int i = 0; i < Histogram::kBuckets; ++i) h.buckets[i] += oh.buckets[i];
+  }
+}
+
+std::string Snapshot::ToJson() const {
+  std::string out = "{\"enabled\":true,\"counters\":{";
+  bool first = true;
+  for (const auto& [name, v] : counters) {
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    AppendEscaped(&out, name);
+    out += "\":" + std::to_string(v);
+  }
+  out += "},\"gauges\":{";
+  first = true;
+  for (const auto& [name, v] : gauges) {
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    AppendEscaped(&out, name);
+    out += "\":" + std::to_string(v);
+  }
+  out += "},\"histograms\":{";
+  first = true;
+  for (const auto& [name, h] : histograms) {
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    AppendEscaped(&out, name);
+    out += "\":{\"count\":" + std::to_string(h.count) +
+           ",\"sum\":" + std::to_string(h.sum) + ",\"buckets\":[";
+    for (int i = 0; i < Histogram::kBuckets; ++i) {
+      if (i) out += ',';
+      out += std::to_string(h.buckets[i]);
+    }
+    out += "]}";
+  }
+  out += "}}";
+  return out;
+}
+
 // ---- trace API --------------------------------------------------------------
 
 bool TraceActive() { return g_trace_active.load(std::memory_order_relaxed); }

@@ -1,0 +1,284 @@
+// watchdog.cc — the stall-watchdog thread and flight recorder behind
+// dmlctpu/watchdog.h.
+#include <dmlctpu/watchdog.h>
+
+#if DMLCTPU_TELEMETRY
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <mutex>
+#include <thread>
+
+#include <dmlctpu/logging.h>
+
+namespace dmlctpu {
+namespace telemetry {
+namespace {
+
+void AppendEscaped(std::string* out, const std::string& s) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      case '\r': *out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+// One progress counter per pipeline stage.  A stage that never moves in an
+// interval is either not part of this pipeline (counter still at its armed
+// baseline and never progressed) or the one that wedged.
+struct StageCounter {
+  const char* stage;
+  const char* counter;
+};
+constexpr StageCounter kStages[] = {
+    {"split", "split.bytes"},   {"parse", "parse.rows"},
+    {"shard", "shard.chunks"},  {"pack", "pack.batches"},
+    {"record", "record.batches"}, {"h2d", "h2d.batches"},
+};
+constexpr int kNumStages = sizeof(kStages) / sizeof(kStages[0]);
+
+class Watchdog {
+ public:
+  static Watchdog& Get() {
+    static Watchdog* w = new Watchdog();  // leaked: process-lifetime
+    return *w;
+  }
+
+  void Start(const WatchdogOptions& opts) {
+    Stop();  // replace-restart: latest options win
+    std::lock_guard<std::mutex> lk(mu_);
+    opts_ = opts;
+    if (opts_.deadline_ms < 1) opts_.deadline_ms = 1;
+    stop_.store(false, std::memory_order_release);
+    const int64_t now = NowUs();
+    for (int i = 0; i < kNumStages; ++i) {
+      tracks_[i].value = Registry::Get()->counter(kStages[i].counter).Value();
+      tracks_[i].last_change_us = now;
+      tracks_[i].progressed = false;
+    }
+    running_ = true;
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  void Stop() {
+    std::thread joinme;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!running_) return;
+      stop_.store(true, std::memory_order_release);
+      running_ = false;
+      joinme = std::move(thread_);
+    }
+    if (joinme.joinable()) joinme.join();
+  }
+
+  bool Running() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return running_;
+  }
+
+  uint64_t StallCount() {
+    return stall_count_.load(std::memory_order_relaxed);
+  }
+
+  std::string BuildRecord(const std::string& reason) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (running_) SampleLocked(NowUs());
+    return BuildRecordLocked(reason);
+  }
+
+  std::string LastRecord() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return last_record_;
+  }
+
+ private:
+  struct Track {
+    uint64_t value = 0;
+    int64_t last_change_us = 0;
+    bool progressed = false;
+  };
+
+  /*! \brief refresh counter samples; caller holds mu_ */
+  void SampleLocked(int64_t now) {
+    for (int i = 0; i < kNumStages; ++i) {
+      uint64_t v = Registry::Get()->counter(kStages[i].counter).Value();
+      if (v != tracks_[i].value) {
+        tracks_[i].value = v;
+        tracks_[i].last_change_us = now;
+        tracks_[i].progressed = true;
+      }
+    }
+  }
+
+  /*! \brief name the stage the pipeline wedged at.  Caller holds mu_.
+   *
+   *  Two rules, in order:
+   *  1. Staged batches sitting READY in the device feed queue
+   *     (h2d.queue_depth gauge > 0) while nothing progresses means the
+   *     consumer stopped taking them — the stall is at the h2d handoff.
+   *     Without this rule a paused consumer gets misattributed upstream:
+   *     bounded buffers make parse/shard wedge (buffers full) BEFORE the
+   *     consumer's pause is visible in any progress counter.
+   *  2. Otherwise the culprit is the stage that stopped first: oldest
+   *     last-progress among stages that moved at least once since arming
+   *     (downstream stages drain their buffers AFTER a wedged producer
+   *     stops, so "oldest" names the culprit, not the victims). */
+  const char* StalledStageLocked() const {
+    if (Registry::Get()->gauge("h2d.queue_depth").Value() > 0) return "h2d";
+    const char* stalled = "";
+    int64_t oldest = 0;
+    for (int i = 0; i < kNumStages; ++i) {
+      if (!tracks_[i].progressed) continue;
+      if (stalled[0] == '\0' || tracks_[i].last_change_us < oldest) {
+        stalled = kStages[i].stage;
+        oldest = tracks_[i].last_change_us;
+      }
+    }
+    return stalled[0] == '\0' ? "unknown" : stalled;
+  }
+
+  std::string BuildRecordLocked(const std::string& reason) const {
+    const int64_t now = NowUs();
+    std::string out = "{\"enabled\":true,\"reason\":\"";
+    AppendEscaped(&out, reason);
+    out += "\",\"now_us\":" + std::to_string(now);
+    out += ",\"stall_count\":" +
+           std::to_string(stall_count_.load(std::memory_order_relaxed));
+    out += ",\"deadline_ms\":" +
+           std::to_string(running_ ? opts_.deadline_ms : -1);
+    out += ",\"stalled_stage\":\"";
+    if (running_) AppendEscaped(&out, StalledStageLocked());
+    out += "\",\"stages\":[";
+    for (int i = 0; i < kNumStages; ++i) {
+      if (i) out += ',';
+      // unarmed: ages are meaningless, report -1
+      int64_t age = running_ ? now - tracks_[i].last_change_us : -1;
+      uint64_t v = running_
+                       ? tracks_[i].value
+                       : Registry::Get()->counter(kStages[i].counter).Value();
+      out += std::string("{\"stage\":\"") + kStages[i].stage +
+             "\",\"counter\":\"" + kStages[i].counter +
+             "\",\"value\":" + std::to_string(v) + ",\"progressed\":" +
+             (running_ && tracks_[i].progressed ? "true" : "false") +
+             ",\"age_us\":" + std::to_string(age) + "}";
+    }
+    out += "],\"registry\":" + Registry::Get()->SnapshotJson();
+    out += ",\"trace\":" + TraceDumpJson();
+    out += "}";
+    return out;
+  }
+
+  void Loop() {
+    for (;;) {
+      std::string record;
+      std::string dump_path;
+      bool do_abort = false;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        int64_t poll = opts_.poll_ms > 0
+                           ? opts_.poll_ms
+                           : std::min<int64_t>(
+                                 std::max<int64_t>(opts_.deadline_ms / 4, 50),
+                                 1000);
+        // Sliced plain-sleep polling instead of a timed cv wait: this
+        // toolchain's condition_variable::wait_for bottoms out in
+        // pthread_cond_clockwait, which its libtsan does not intercept —
+        // the untracked unlock/relock corrupts TSan's ownership model and
+        // reports bogus double-locks.  20 ms slices keep Stop() prompt.
+        lk.unlock();
+        for (int64_t slept = 0; slept < poll;) {
+          if (stop_.load(std::memory_order_acquire)) return;
+          const int64_t slice = std::min<int64_t>(20, poll - slept);
+          std::this_thread::sleep_for(std::chrono::milliseconds(slice));
+          slept += slice;
+        }
+        lk.lock();
+        if (stop_.load(std::memory_order_acquire)) return;
+        const int64_t now = NowUs();
+        SampleLocked(now);
+        int64_t newest = 0;
+        for (int i = 0; i < kNumStages; ++i) {
+          newest = std::max(newest, tracks_[i].last_change_us);
+        }
+        if (now - newest >= opts_.deadline_ms * 1000) {
+          stall_count_.fetch_add(1, std::memory_order_relaxed);
+          record = BuildRecordLocked("watchdog: no forward progress for " +
+                                     std::to_string(opts_.deadline_ms) +
+                                     " ms");
+          last_record_ = record;
+          stalled_for_log_ = StalledStageLocked();
+          dump_path = opts_.dump_path;
+          do_abort = opts_.abort_on_stall;
+          // re-arm so a warn-policy watchdog fires once per deadline
+          // window, not once per poll
+          for (int i = 0; i < kNumStages; ++i) {
+            tracks_[i].last_change_us = now;
+          }
+        }
+      }
+      // File write and log emission happen UNLOCKED: the log sink may be a
+      // Python callback that needs the GIL, and a Python thread holding the
+      // GIL may be blocked in Stop() on mu_ — emitting under mu_ deadlocks.
+      if (!record.empty()) {
+        std::string where = "log sink only";
+        if (!dump_path.empty()) {
+          std::ofstream f(dump_path, std::ios::trunc);
+          f << record;
+          where = f.good() ? dump_path : "write to " + dump_path + " FAILED";
+        }
+        log::Emit(LogSeverity::kError, "watchdog", 0,
+                  "pipeline stall: no forward progress for " +
+                      std::to_string(opts_.deadline_ms) +
+                      " ms; stalled stage: " + stalled_for_log_ +
+                      "; flight record: " + where);
+        if (do_abort) {
+          std::fflush(nullptr);
+          std::abort();
+        }
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::thread thread_;
+  bool running_ = false;         // guarded by mu_
+  std::atomic<bool> stop_{false};  // checked by the unlocked sleep slices
+  WatchdogOptions opts_;
+  Track tracks_[kNumStages];
+  std::atomic<uint64_t> stall_count_{0};
+  std::string last_record_;      // guarded by mu_
+  std::string stalled_for_log_;  // written under mu_, read by the one Loop
+};
+
+}  // namespace
+
+void WatchdogStart(const WatchdogOptions& opts) { Watchdog::Get().Start(opts); }
+void WatchdogStop() { Watchdog::Get().Stop(); }
+bool WatchdogRunning() { return Watchdog::Get().Running(); }
+uint64_t WatchdogStallCount() { return Watchdog::Get().StallCount(); }
+std::string FlightRecordJson(const std::string& reason) {
+  return Watchdog::Get().BuildRecord(reason);
+}
+std::string LastFlightRecordJson() { return Watchdog::Get().LastRecord(); }
+
+}  // namespace telemetry
+}  // namespace dmlctpu
+
+#endif  // DMLCTPU_TELEMETRY

@@ -5,7 +5,11 @@
 // in the DMLCTPU_TELEMETRY=0 tier of scripts/check.sh, where every
 // Enabled()-gated assertion flips to the stubbed-out expectations.
 #include <atomic>
+#include <chrono>
+#include <cmath>
 #include <cstring>
+#include <fstream>
+#include <limits>
 #include <map>
 #include <set>
 #include <sstream>
@@ -21,6 +25,7 @@
 #include "dmlctpu/stream.h"
 #include "dmlctpu/telemetry.h"
 #include "dmlctpu/temp_dir.h"
+#include "dmlctpu/watchdog.h"
 #include "testing.h"
 
 using namespace dmlctpu;  // NOLINT
@@ -415,6 +420,148 @@ TESTCASE(log_sink_swap_under_concurrent_emits) {
   for (auto& t : ts) t.join();
   log::SetSink(log::Sink());
   EXPECT_TRUE(seen.load() > 0);
+}
+
+TESTCASE(snapshot_capture_and_merge_conservative) {
+  using telemetry::Snapshot;
+  auto* reg = telemetry::Registry::Get();
+  reg->counter("test.merge_counter").Reset();
+  reg->counter("test.merge_counter").Add(5);
+  reg->gauge("test.merge_gauge").Set(3);
+  reg->histogram("test.merge_hist").Reset();
+  reg->histogram("test.merge_hist").Observe(3);  // bucket 2 (upper bound 4)
+  Snapshot a = Snapshot::Capture();
+  if (!telemetry::Enabled()) {
+    EXPECT_TRUE(a.counters.empty());
+    EXPECT_EQV(a.ToJson(), std::string("{\"enabled\":false}"));
+    Snapshot empty;
+    a.Merge(empty);  // stubbed no-op must not crash
+    return;
+  }
+  EXPECT_EQV(a.counters.at("test.merge_counter"), uint64_t{5});
+  EXPECT_EQV(a.gauges.at("test.merge_gauge"), int64_t{3});
+  EXPECT_EQV(a.histograms.at("test.merge_hist").count, 1u);
+  WalkJson(a.ToJson());
+
+  // a second "host": Merge is pure struct arithmetic, exactly what the
+  // tracker does across worker snapshots, so build it by hand
+  Snapshot b;
+  b.counters["test.merge_counter"] = 7;
+  b.counters["test.merge_only_b"] = 2;
+  b.gauges["test.merge_gauge"] = 4;
+  Snapshot::Hist hb;
+  hb.count = 1;
+  hb.sum = 100;
+  hb.buckets[7] = 1;  // 100 lands in bucket 7 (upper bound 128)
+  b.histograms["test.merge_hist"] = hb;
+
+  Snapshot m = a;
+  m.Merge(b);
+  EXPECT_EQV(m.counters.at("test.merge_counter"), uint64_t{12});
+  EXPECT_EQV(m.counters.at("test.merge_only_b"), uint64_t{2});
+  EXPECT_EQV(m.gauges.at("test.merge_gauge"), int64_t{7});
+  const Snapshot::Hist& mh = m.histograms.at("test.merge_hist");
+  EXPECT_EQV(mh.count, 2u);
+  EXPECT_EQV(mh.sum, 103u);
+  EXPECT_EQV(mh.buckets[2], 1u);
+  EXPECT_EQV(mh.buckets[7], 1u);
+  WalkJson(m.ToJson());
+
+  // merged quantile estimates stay conservative: each merged bucket keeps
+  // its upper bound, so the estimate never underestimates the true value
+  auto quantile_ub = [](const Snapshot::Hist& h, double q) -> double {
+    uint64_t target = static_cast<uint64_t>(q * static_cast<double>(h.count));
+    if (target < 1) target = 1;
+    uint64_t cum = 0;
+    for (int i = 0; i < telemetry::Histogram::kBuckets; ++i) {
+      cum += h.buckets[i];
+      if (cum >= target) return std::pow(2.0, i);
+    }
+    return std::numeric_limits<double>::infinity();
+  };
+  // true merged observations are {3, 100}: median 3, max 100
+  EXPECT_TRUE(quantile_ub(mh, 0.5) >= 3.0);
+  EXPECT_TRUE(quantile_ub(mh, 1.0) >= 100.0);
+}
+
+TESTCASE(watchdog_no_false_positive_while_progressing) {
+  telemetry::WatchdogOptions opts;
+  opts.deadline_ms = 600;
+  opts.poll_ms = 25;
+  telemetry::WatchdogStart(opts);
+  if (!telemetry::Enabled()) {
+    EXPECT_TRUE(!telemetry::WatchdogRunning());
+    EXPECT_EQV(telemetry::WatchdogStallCount(), 0u);
+    telemetry::WatchdogStop();
+    return;
+  }
+  EXPECT_TRUE(telemetry::WatchdogRunning());
+  const uint64_t stalls0 = telemetry::WatchdogStallCount();
+  telemetry::Counter& c = telemetry::Registry::Get()->counter("parse.rows");
+  // slow but steady: a tick every ~100 ms never hits the 600 ms deadline
+  for (int i = 0; i < 8; ++i) {
+    c.Add(1);
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  EXPECT_EQV(telemetry::WatchdogStallCount(), stalls0);
+  telemetry::WatchdogStop();
+  EXPECT_TRUE(!telemetry::WatchdogRunning());
+}
+
+TESTCASE(watchdog_stall_dumps_flight_record) {
+  TemporaryDirectory tmp;
+  const std::string dump = tmp.path + "/flight.json";
+  telemetry::WatchdogOptions opts;
+  opts.deadline_ms = 150;
+  opts.poll_ms = 25;
+  opts.abort_on_stall = false;  // warn policy: log + dump, keep running
+  opts.dump_path = dump;
+
+  std::atomic<int> stall_logs{0};
+  log::SetSink([&stall_logs](LogSeverity, const char* where,
+                             const std::string& msg) {
+    // the sink's `where` is "file:line"; the watchdog emits as "watchdog:0"
+    if (std::string(where).rfind("watchdog", 0) == 0 &&
+        msg.find("pipeline stall") != std::string::npos) {
+      stall_logs.fetch_add(1);
+    }
+  });
+
+  const uint64_t stalls0 = telemetry::WatchdogStallCount();
+  telemetry::WatchdogStart(opts);
+  if (telemetry::Enabled()) {
+    // march exactly one stage forward so the record can name it, then
+    // wedge: h2d emitted its last batch and nothing moved afterwards
+    telemetry::Registry::Get()->counter("h2d.batches").Add(1);
+    for (int i = 0;
+         i < 200 && telemetry::WatchdogStallCount() == stalls0; ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(25));
+    }
+    EXPECT_TRUE(telemetry::WatchdogStallCount() > stalls0);
+  }
+  telemetry::WatchdogStop();
+  log::SetSink(log::Sink());
+
+  if (!telemetry::Enabled()) {
+    EXPECT_EQV(telemetry::LastFlightRecordJson(), std::string());
+    WalkJson(telemetry::FlightRecordJson("manual"));  // {"enabled":false}
+    return;
+  }
+  const std::string rec = telemetry::LastFlightRecordJson();
+  WalkJson(rec);
+  EXPECT_TRUE(rec.find("\"stalled_stage\":\"h2d\"") != std::string::npos);
+  EXPECT_TRUE(rec.find("\"registry\":") != std::string::npos);
+  EXPECT_TRUE(rec.find("\"trace\":") != std::string::npos);
+  EXPECT_TRUE(stall_logs.load() >= 1);
+
+  std::ifstream f(dump);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  WalkJson(ss.str());
+  EXPECT_TRUE(ss.str().find("\"stalled_stage\":\"h2d\"") != std::string::npos);
+
+  // a manual flight record while unarmed is still well-formed (ages -1)
+  WalkJson(telemetry::FlightRecordJson("manual"));
 }
 
 TESTMAIN()

@@ -193,6 +193,19 @@ _LIB.DmlcTpuTelemetryTraceStop.argtypes = []
 _LIB.DmlcTpuTelemetryTraceDumpJson.argtypes = [ctypes.POINTER(ctypes.c_char_p)]
 _LIB.DmlcTpuTelemetryRecordSpan.argtypes = [
     ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64]
+_LIB.DmlcTpuTelemetryGaugeSet.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+_LIB.DmlcTpuTelemetryGaugeAdd.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+_LIB.DmlcTpuTelemetryGaugeGet.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+_LIB.DmlcTpuWatchdogStart.argtypes = [
+    ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_char_p]
+_LIB.DmlcTpuWatchdogStop.argtypes = []
+_LIB.DmlcTpuWatchdogRunning.argtypes = [ctypes.POINTER(ctypes.c_int)]
+_LIB.DmlcTpuWatchdogStallCount.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+_LIB.DmlcTpuFlightRecordJson.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuWatchdogLastRecordJson.argtypes = [
+    ctypes.POINTER(ctypes.c_char_p)]
 
 LOG_CALLBACK_TYPE = ctypes.CFUNCTYPE(
     None, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)

@@ -57,7 +57,21 @@ from .rowblock import Parser  # noqa: F401  (re-exported convenience)
 LOGGER = logging.getLogger("dmlc_core_tpu.staging")
 
 
-def _staged_iter(produce, prefetch: int):
+def _observability_scope():
+    """Arm the env-configured stall watchdog and start the tracker metrics
+    pusher for this process (both no-ops without their env vars — see
+    ``DMLCTPU_WATCHDOG_DEADLINE_S`` and ``DMLC_TRACKER_METRICS_PORT`` in
+    doc/observability.md): every epoch driven through a staging iterator
+    becomes job-wide observable without touching user code."""
+    try:
+        from ..tracker import metrics as _metrics
+        _metrics.ensure_pusher()
+    except Exception:  # tracker package is optional at data-plane runtime
+        LOGGER.debug("tracker metrics pusher unavailable", exc_info=True)
+    return telemetry.watchdog_from_env()
+
+
+def _staged_iter(produce, prefetch: int, depth_gauge: Optional[str] = None):
     """Drive ``produce(emit)`` on a background thread, yielding emitted items
     up to ``prefetch`` ahead of the consumer.
 
@@ -66,6 +80,10 @@ def _staged_iter(produce, prefetch: int):
     any native cursor locks — a plain blocking ``q.put`` here deadlocked
     abandoned iterators (producer parked in put holding the cursor lock).
     Producer exceptions are re-raised in the consumer.
+
+    ``depth_gauge`` names a telemetry gauge kept at the queue's occupancy —
+    pipeline state for the flight recorder (a stall with the gauge pinned at
+    ``prefetch`` means the consumer wedged; pinned at 0, the producer).
     """
     q: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
     sentinel = object()
@@ -76,6 +94,8 @@ def _staged_iter(produce, prefetch: int):
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                if depth_gauge is not None:
+                    telemetry.gauge_set(depth_gauge, q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -107,6 +127,8 @@ def _staged_iter(produce, prefetch: int):
     try:
         while True:
             item = q.get()
+            if depth_gauge is not None:
+                telemetry.gauge_set(depth_gauge, q.qsize())
             if item is sentinel:
                 reached_end = True
                 break
@@ -708,13 +730,18 @@ class RecordStagingIter:
             yield batch
 
     def __iter__(self) -> Iterator[RecordBatch]:
+        with _observability_scope():
+            yield from self._iter_epoch()
+
+    def _iter_epoch(self) -> Iterator[RecordBatch]:
         if self._sharding is not None and jax.process_count() > 1:
             yield from self._iter_multihost()
             return
 
         # two-stage: the read+pack stage fills a host queue; a dedicated
         # stager thread drains it through a double-buffered device feed
-        host_iter = _staged_iter(self._produce_host, self._prefetch)
+        host_iter = _staged_iter(self._produce_host, self._prefetch,
+                                 depth_gauge="record.queue_depth")
 
         def produce(emit):
             try:
@@ -736,7 +763,7 @@ class RecordStagingIter:
             finally:
                 host_iter.close()
 
-        yield from _staged_iter(produce, 2)
+        yield from _staged_iter(produce, 2, depth_gauge="h2d.queue_depth")
 
 
 class DeviceStagingIter:
@@ -1025,7 +1052,14 @@ class DeviceStagingIter:
 
     def __iter__(self) -> Iterator[PaddedBatch]:
         """Yield device-resident batches; parse/pack (C++) and device_put
-        (a background thread) run ahead of the consumer."""
+        (a background thread) run ahead of the consumer.  The epoch runs
+        under the env-configured stall watchdog (telemetry.watchdog_from_env)
+        and, when launched under a tracker, reports its counters to the
+        tracker's metrics channel."""
+        with _observability_scope():
+            yield from self._iter_epoch()
+
+    def _iter_epoch(self) -> Iterator[PaddedBatch]:
         self._epoch_t0 = time.monotonic()
         self._epoch_bytes0 = self.bytes_read
         self._epoch_batches0 = self.batches_staged
@@ -1071,7 +1105,8 @@ class DeviceStagingIter:
         # prefetch_depth); a dedicated stager thread turns host batches
         # into device arrays through a double-buffered feed, so the H2D
         # copy of batch k+1 overlaps the consumer's work on batch k
-        host_iter = _staged_iter(produce_host, self._prefetch)
+        host_iter = _staged_iter(produce_host, self._prefetch,
+                                 depth_gauge="pack.queue_depth")
 
         def produce_device(emit):
             try:
@@ -1103,4 +1138,5 @@ class DeviceStagingIter:
             finally:
                 host_iter.close()
 
-        yield from _staged_iter(produce_device, 2)
+        yield from _staged_iter(produce_device, 2,
+                                depth_gauge="h2d.queue_depth")

@@ -20,6 +20,8 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import json
+import os
+import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -27,9 +29,13 @@ from . import _native
 
 __all__ = [
     "enabled", "snapshot", "reset", "counter_add", "counter_get",
-    "counters_delta", "trace_start", "trace_stop", "trace_dump_json",
+    "gauge_set", "gauge_add", "gauge_get",
+    "counters_delta", "snapshot_restarted", "merge_snapshots",
+    "histogram_quantile", "trace_start", "trace_stop", "trace_dump_json",
     "trace_dump", "record_span", "span", "stall_attribution",
     "format_stall_table", "capture_logs",
+    "watchdog", "watchdog_from_env", "watchdog_running",
+    "watchdog_stall_count", "flight_record", "last_flight_record",
 ]
 
 
@@ -70,11 +76,89 @@ def counter_get(name: str) -> int:
     return int(out.value)
 
 
+def gauge_set(name: str, value: int) -> None:
+    """Set the named process-wide gauge (created on first use).  This is how
+    the staging loop publishes H2D queue depth for the flight recorder."""
+    _native.check(
+        _native.lib().DmlcTpuTelemetryGaugeSet(name.encode(), int(value)))
+
+
+def gauge_add(name: str, delta: int) -> None:
+    _native.check(
+        _native.lib().DmlcTpuTelemetryGaugeAdd(name.encode(), int(delta)))
+
+
+def gauge_get(name: str) -> int:
+    out = ctypes.c_int64()
+    _native.check(
+        _native.lib().DmlcTpuTelemetryGaugeGet(name.encode(),
+                                               ctypes.byref(out)))
+    return int(out.value)
+
+
 def counters_delta(before: dict, after: dict) -> Dict[str, int]:
     """Per-counter difference between two :func:`snapshot` results (counters
-    are monotonic, so this is the activity in the interval)."""
+    are monotonic, so this is the activity in the interval).
+
+    A counter that went BACKWARDS — a worker process restarted mid-epoch and
+    re-registered from zero — is clamped to 0 rather than reported as a
+    negative interval; :func:`snapshot_restarted` detects that case so
+    callers can tag the interval instead of silently mis-attributing it.
+    """
     b = before.get("counters", {})
-    return {k: v - b.get(k, 0) for k, v in after.get("counters", {}).items()}
+    return {k: max(v - b.get(k, 0), 0)
+            for k, v in after.get("counters", {}).items()}
+
+
+def snapshot_restarted(before: dict, after: dict) -> bool:
+    """True when any counter moved backwards between the snapshots — the
+    signature of a process restart (counters are otherwise monotonic)."""
+    b = before.get("counters", {})
+    return any(v < b.get(k, 0)
+               for k, v in after.get("counters", {}).items())
+
+
+def merge_snapshots(snaps: List[dict]) -> dict:
+    """Fold per-process :func:`snapshot` dicts into one job-wide view
+    (Python face of the native ``telemetry::Snapshot::Merge``).
+
+    Counters and histogram buckets add exactly (both are event tallies);
+    gauges add so a merged level reads as the job-wide total.  Because every
+    histogram bucket keeps its upper bound, quantiles read off the merged
+    buckets (:func:`histogram_quantile`) are conservative — they never
+    understate the true quantile of the pooled events."""
+    merged: dict = {"enabled": any(s.get("enabled") for s in snaps),
+                    "counters": {}, "gauges": {}, "histograms": {}}
+    for s in snaps:
+        for k, v in s.get("counters", {}).items():
+            merged["counters"][k] = merged["counters"].get(k, 0) + v
+        for k, v in s.get("gauges", {}).items():
+            merged["gauges"][k] = merged["gauges"].get(k, 0) + v
+        for k, h in s.get("histograms", {}).items():
+            m = merged["histograms"].setdefault(
+                k, {"count": 0, "sum": 0, "buckets": [0] * len(h["buckets"])})
+            m["count"] += h["count"]
+            m["sum"] += h["sum"]
+            m["buckets"] = [a + b for a, b in zip(m["buckets"], h["buckets"])]
+    return merged
+
+
+def histogram_quantile(hist: dict, q: float) -> Optional[float]:
+    """Upper bound of the ``q``-quantile from a snapshot histogram dict
+    (``{"count", "sum", "buckets"}``): the bucket upper bound (``2**i``)
+    where the cumulative count crosses ``q * count``.  ``inf`` when it lands
+    in the overflow bucket; ``None`` for an empty histogram."""
+    count = hist.get("count", 0)
+    if count <= 0:
+        return None
+    buckets = hist["buckets"]
+    target = max(q * count, 1.0)  # >=1: even q=0 points at a real event
+    cum = 0
+    for i, n in enumerate(buckets):
+        cum += n
+        if cum >= target:
+            return float("inf") if i == len(buckets) - 1 else float(2 ** i)
+    return float("inf")
 
 
 # ---- traces -----------------------------------------------------------------
@@ -144,6 +228,11 @@ def stall_attribution(before: dict, after: dict,
     total.  ``parse`` is excluded from the candidates whenever the sharded
     pool ran (its workers' parse time is already inside ``shard`` busy);
     ``shard`` busy is part wall time minus producer stalls.
+
+    ``restarted`` is True when any counter moved backwards between the
+    snapshots (a worker restart re-registered from zero): the clamped
+    deltas then under-count the interval, so treat the attribution as a
+    lower bound rather than silently trusting it.
     """
     d = counters_delta(before, after)
     us = lambda k: d.get(k, 0) / 1e6  # noqa: E731
@@ -172,6 +261,7 @@ def stall_attribution(before: dict, after: dict,
         "bound_stage": bound_stage,
         "table": table,
         "wall_s": None if wall_s is None else round(wall_s, 6),
+        "restarted": snapshot_restarted(before, after),
     }
 
 
@@ -185,6 +275,100 @@ def format_stall_table(attr: dict) -> str:
     if attr["table"]:
         lines.append(attr["table"])
     return "\n".join(lines)
+
+
+# ---- stall watchdog + flight recorder ---------------------------------------
+
+_watchdog_lock = threading.Lock()
+_watchdog_depth = 0
+
+
+@contextlib.contextmanager
+def watchdog(deadline_s: float = 30.0, poll_s: Optional[float] = None,
+             policy: str = "warn", dump_path: Optional[str] = None,
+             ) -> Iterator[None]:
+    """Arm the native stall watchdog for the duration of the body.
+
+    When NO pipeline progress counter (split/parse/shard/pack/record/h2d)
+    moves for ``deadline_s``, the watchdog dumps a flight record — stalled
+    stage, per-stage progress ages, every gauge, the trace buffers — to
+    ``dump_path`` (when given) and the log sink, then either keeps running
+    re-armed (``policy="warn"``) or aborts the process (``policy="abort"``).
+
+    Nesting refcounts: the outermost ``watchdog()`` arms (its options win)
+    and the last exit disarms, so the staging iterators can arm it per
+    epoch while a caller holds a longer-lived one.  No-op when telemetry is
+    compiled out."""
+    if policy not in ("warn", "abort"):
+        raise ValueError(f"watchdog policy must be 'warn' or 'abort', "
+                         f"got {policy!r}")
+    global _watchdog_depth
+    with _watchdog_lock:
+        _watchdog_depth += 1
+        if _watchdog_depth == 1:
+            _native.check(_native.lib().DmlcTpuWatchdogStart(
+                max(int(deadline_s * 1000), 1),
+                0 if poll_s is None else max(int(poll_s * 1000), 1),
+                1 if policy == "abort" else 0,
+                (dump_path or "").encode()))
+    try:
+        yield
+    finally:
+        with _watchdog_lock:
+            _watchdog_depth -= 1
+            if _watchdog_depth == 0:
+                _native.check(_native.lib().DmlcTpuWatchdogStop())
+
+
+def watchdog_from_env() -> contextlib.AbstractContextManager:
+    """Watchdog configured from the environment, or a no-op context when
+    ``DMLCTPU_WATCHDOG_DEADLINE_S`` is unset — how the staging iterators
+    arm it without new call-site plumbing.  Knobs:
+
+    * ``DMLCTPU_WATCHDOG_DEADLINE_S`` — deadline seconds (required)
+    * ``DMLCTPU_WATCHDOG_POLICY`` — ``warn`` (default) or ``abort``
+    * ``DMLCTPU_WATCHDOG_DUMP`` — flight-record file path
+    """
+    deadline = os.environ.get("DMLCTPU_WATCHDOG_DEADLINE_S")
+    if not deadline:
+        return contextlib.nullcontext()
+    return watchdog(
+        deadline_s=float(deadline),
+        policy=os.environ.get("DMLCTPU_WATCHDOG_POLICY", "warn"),
+        dump_path=os.environ.get("DMLCTPU_WATCHDOG_DUMP") or None)
+
+
+def watchdog_running() -> bool:
+    out = ctypes.c_int()
+    _native.check(_native.lib().DmlcTpuWatchdogRunning(ctypes.byref(out)))
+    return bool(out.value)
+
+
+def watchdog_stall_count() -> int:
+    """Stalls detected since process start (across arm/disarm cycles)."""
+    out = ctypes.c_int64()
+    _native.check(
+        _native.lib().DmlcTpuWatchdogStallCount(ctypes.byref(out)))
+    return int(out.value)
+
+
+def flight_record(reason: str = "manual") -> dict:
+    """Build a flight record right now (same JSON the watchdog dumps):
+    stalled stage + per-stage progress ages (when armed), the full registry
+    snapshot, and the trace buffers."""
+    out = ctypes.c_char_p()
+    _native.check(_native.lib().DmlcTpuFlightRecordJson(
+        reason.encode(), ctypes.byref(out)))
+    return json.loads((out.value or b"{}").decode())
+
+
+def last_flight_record() -> Optional[dict]:
+    """The record from the most recent watchdog stall, or None."""
+    out = ctypes.c_char_p()
+    _native.check(
+        _native.lib().DmlcTpuWatchdogLastRecordJson(ctypes.byref(out)))
+    raw = (out.value or b"").decode()
+    return json.loads(raw) if raw else None
 
 
 # ---- log capture ------------------------------------------------------------

@@ -1,0 +1,189 @@
+"""Live telemetry export over HTTP (stdlib only).
+
+One small ThreadingHTTPServer per process serving:
+
+* ``/metrics`` — the registry in Prometheus text exposition format.  Names
+  follow the doc/observability.md contract mapped to Prometheus rules:
+  ``shard.producer_wait_us`` becomes ``dmlctpu_shard_producer_wait_us_total``
+  (counters get ``_total``), gauges keep the bare name, histograms emit
+  cumulative ``_bucket{le="2^i"}`` series plus ``_sum``/``_count``.
+* ``/trace`` — the Chrome trace-event JSON buffered since ``trace_start()``.
+* ``/flight`` — the most recent watchdog flight record, or a fresh one
+  (``?fresh=1`` forces a fresh build even when a stall was recorded).
+* ``/snapshot`` — the raw registry snapshot JSON (what the tracker pushes).
+
+Workers serve their own process registry; the tracker passes a ``provider``
+returning ``(labels, snapshot)`` pairs so job-wide metrics come out as one
+exposition with a ``host`` label per worker.  Bind with ``port=0`` to let
+the OS pick (the chosen port is on the returned server).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from . import telemetry
+
+__all__ = ["serve", "TelemetryServer", "prometheus_text"]
+
+# provider: () -> [(labels, snapshot_dict), ...]
+Provider = Callable[[], List[Tuple[Dict[str, str], dict]]]
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    base = "".join(out)
+    return base if not base or not base[0].isdigit() else "_" + base
+
+
+def _labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (_sanitize(k),
+                     str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _merge_label(labels: Dict[str, str], extra: Dict[str, str]) -> Dict[str, str]:
+    out = dict(labels)
+    out.update(extra)
+    return out
+
+
+def prometheus_text(sources: List[Tuple[Dict[str, str], dict]]) -> str:
+    """Render ``(labels, snapshot)`` pairs as one Prometheus exposition.
+
+    Every metric name is prefixed ``dmlctpu_``; a ``# HELP``/``# TYPE``
+    header is emitted once per family even when several label sets share
+    it (the format requires families to be contiguous)."""
+    families: Dict[str, List[str]] = {}
+    types: Dict[str, str] = {}
+
+    def add(family: str, mtype: str, line: str) -> None:
+        families.setdefault(family, []).append(line)
+        types[family] = mtype
+
+    for labels, snap in sources:
+        for name, v in sorted(snap.get("counters", {}).items()):
+            fam = "dmlctpu_" + _sanitize(name) + "_total"
+            add(fam, "counter", f"{fam}{_labels(labels)} {v}")
+        for name, v in sorted(snap.get("gauges", {}).items()):
+            fam = "dmlctpu_" + _sanitize(name)
+            add(fam, "gauge", f"{fam}{_labels(labels)} {v}")
+        for name, h in sorted(snap.get("histograms", {}).items()):
+            fam = "dmlctpu_" + _sanitize(name)
+            buckets = h.get("buckets", [])
+            cum = 0
+            lines = []
+            for i, n in enumerate(buckets):
+                cum += n
+                le = "+Inf" if i == len(buckets) - 1 else str(2 ** i)
+                lab = _labels(_merge_label(labels, {"le": le}))
+                lines.append(f"{fam}_bucket{lab} {cum}")
+            lines.append(f"{fam}_sum{_labels(labels)} {h.get('sum', 0)}")
+            lines.append(f"{fam}_count{_labels(labels)} {h.get('count', 0)}")
+            for line in lines:
+                add(fam, "histogram", line)
+
+    out = []
+    for fam in sorted(families):
+        # classic text format: the TYPE name matches the sample name for
+        # counters/gauges (counters keep _total) and the family base for
+        # histograms (samples append _bucket/_sum/_count)
+        out.append(f"# HELP {fam} dmlctpu pipeline metric "
+                   f"(see doc/observability.md)")
+        out.append(f"# TYPE {fam} {types[fam]}")
+        out.extend(families[fam])
+    return "\n".join(out) + "\n"
+
+
+def _local_provider() -> List[Tuple[Dict[str, str], dict]]:
+    return [({}, telemetry.snapshot())]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dmlctpu-telemetry/0.1"
+
+    def log_message(self, *args):  # no stderr chatter from the scrape loop
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        try:
+            url = urlparse(self.path)
+            if url.path in ("/metrics", "/metrics/"):
+                text = prometheus_text(self.server.provider())
+                self._send(200, text, "text/plain; version=0.0.4")
+            elif url.path == "/trace":
+                self._send(200, telemetry.trace_dump_json(),
+                           "application/json")
+            elif url.path == "/flight":
+                rec = None
+                if "fresh=1" not in (url.query or ""):
+                    rec = telemetry.last_flight_record()
+                if rec is None:
+                    rec = telemetry.flight_record("http request")
+                self._send(200, json.dumps(rec), "application/json")
+            elif url.path == "/snapshot":
+                self._send(200, json.dumps(telemetry.snapshot()),
+                           "application/json")
+            else:
+                self._send(404, "not found: try /metrics /trace /flight\n",
+                           "text/plain")
+        except Exception as exc:  # a scrape must never kill the server
+            try:
+                self._send(500, f"error: {exc}\n", "text/plain")
+            except OSError:
+                pass
+
+
+class TelemetryServer:
+    """Handle for a running export endpoint; ``close()`` releases the port."""
+
+    def __init__(self, host: str, port: int,
+                 provider: Optional[Provider] = None):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.provider = provider or _local_provider
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dmlctpu-telemetry-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve(port: int = 0, host: str = "127.0.0.1",
+          provider: Optional[Provider] = None) -> TelemetryServer:
+    """Start the endpoint on a daemon thread and return its handle.
+    ``port=0`` binds an ephemeral port (read it back via ``.port``)."""
+    return TelemetryServer(host, port, provider)

@@ -1,0 +1,342 @@
+"""Tracker-side telemetry aggregation: the job-wide observability plane.
+
+Workers periodically push compact telemetry snapshots (counters, gauges,
+histogram buckets, plus a restart flag) to a lightweight TCP side channel
+owned by the tracker; the tracker merges them into a job view answering
+"which host is the straggler?" without attaching to any process.
+
+The channel is negotiated at rendezvous: :class:`MetricsAggregator` binds
+next to the rabit socket and its port rides the env contract as
+``DMLC_TRACKER_METRICS_PORT`` (see ``RabitTracker.worker_envs``), so every
+launcher ships it to workers for free.  The wire format mirrors the rabit
+framing (native-endian int32 + [len]+utf8) with its own magic, one push per
+connection:
+
+    worker -> MAGIC, json payload      tracker -> MAGIC, int ack (0)
+
+Payload: ``{"rank", "host", "pid", "restarted", "snapshot"}`` where
+``snapshot`` is ``telemetry.snapshot()``.  Pushes are cumulative (counters
+are monotonic), so the tracker can lose any number of them and the next one
+heals the view; a worker restart shows up as counters moving backwards and
+tags the host ``restarted``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import telemetry
+
+LOGGER = logging.getLogger("dmlc_tpu.tracker.metrics")
+
+METRICS_MAGIC = 0xFF98
+METRICS_PORT_ENV = "DMLC_TRACKER_METRICS_PORT"
+
+__all__ = [
+    "MetricsAggregator", "MetricsPusher", "push_once", "ensure_pusher",
+    "stop_pusher", "METRICS_MAGIC", "METRICS_PORT_ENV",
+]
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 65536))
+        if not chunk:
+            raise ConnectionError("peer closed during read")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _read_int(sock: socket.socket) -> int:
+    return struct.unpack("@i", _read_exact(sock, 4))[0]
+
+
+def _write_int(sock: socket.socket, value: int) -> None:
+    sock.sendall(struct.pack("@i", value))
+
+
+def _read_str(sock: socket.socket) -> str:
+    return _read_exact(sock, _read_int(sock)).decode()
+
+
+def _write_str(sock: socket.socket, value: str) -> None:
+    data = value.encode()
+    _write_int(sock, len(data))
+    sock.sendall(data)
+
+
+# ---- tracker side -----------------------------------------------------------
+
+class MetricsAggregator:
+    """Accepts worker snapshot pushes and merges them into a job view."""
+
+    def __init__(self, host_ip: str = "127.0.0.1", port: int = 0):
+        family = socket.getaddrinfo(host_ip, None)[0][0]
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((host_ip, port))
+        sock.listen(64)
+        self.sock = sock
+        self.host_ip = host_ip
+        self.port = sock.getsockname()[1]
+        self._lock = threading.Lock()
+        # rank -> {"host","pid","snapshot","restarted","last_update"}
+        self._hosts: Dict[int, dict] = {}
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._serve, name="dmlctpu-metrics-aggregator", daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                fd, _addr = self.sock.accept()
+            except OSError:
+                return  # closed
+            try:
+                self._handle(fd)
+            except (ConnectionError, OSError, ValueError, KeyError) as e:
+                LOGGER.debug("dropped metrics push: %s", e)
+            finally:
+                try:
+                    fd.close()
+                except OSError:
+                    pass
+
+    def _handle(self, fd: socket.socket) -> None:
+        fd.settimeout(10.0)
+        magic = _read_int(fd)
+        if magic != METRICS_MAGIC:
+            raise ConnectionError(f"bad metrics magic {magic:#x}")
+        _write_int(fd, METRICS_MAGIC)
+        payload = json.loads(_read_str(fd))
+        rank = int(payload["rank"])
+        with self._lock:
+            prev = self._hosts.get(rank)
+            restarted = bool(payload.get("restarted", False))
+            if prev is not None:
+                # counters moving backwards across pushes = the worker
+                # process restarted and re-registered from zero
+                restarted = restarted or prev["restarted"] or \
+                    telemetry.snapshot_restarted(prev["snapshot"],
+                                                 payload["snapshot"])
+            self._hosts[rank] = {
+                "host": str(payload.get("host", "?")),
+                "pid": int(payload.get("pid", -1)),
+                "snapshot": payload["snapshot"],
+                "restarted": restarted,
+                "last_update": time.time(),
+            }
+        _write_int(fd, 0)
+
+    # ---- job view -----------------------------------------------------------
+
+    def job_snapshot(self) -> dict:
+        """Merged job view: per-host snapshots plus a fleet roll-up.
+
+        ``hosts`` maps rank -> ``{"host", "pid", "age_s", "restarted",
+        "snapshot", "attribution"}`` (attribution over the host's lifetime
+        counters); ``fleet`` is ``telemetry.merge_snapshots`` over every
+        host — counters add exactly, so per-host byte counters sum to the
+        totals a single process would have seen.
+        """
+        now = time.time()
+        with self._lock:
+            hosts = {r: dict(h) for r, h in self._hosts.items()}
+        empty: dict = {"counters": {}}
+        view: Dict[str, object] = {"hosts": {}, "num_hosts": len(hosts)}
+        for rank, h in sorted(hosts.items()):
+            attr = telemetry.stall_attribution(empty, h["snapshot"])
+            view["hosts"][rank] = {
+                "host": h["host"],
+                "pid": h["pid"],
+                "age_s": round(now - h["last_update"], 3),
+                "restarted": h["restarted"],
+                "snapshot": h["snapshot"],
+                "attribution": attr,
+            }
+        view["fleet"] = telemetry.merge_snapshots(
+            [h["snapshot"] for h in hosts.values()]) if hosts else {
+                "enabled": False, "counters": {}, "gauges": {},
+                "histograms": {}}
+        view["restarted"] = any(h["restarted"] for h in hosts.values())
+        return view
+
+    def format_job_table(self, stale_s: float = 30.0) -> str:
+        """Per-host bottleneck table, worst first, flagging stragglers.
+
+        A host is flagged when its bound-stage busy share exceeds the fleet
+        median for that stage by 1.5x and at least 10 points ("host 3
+        shard-bound 91% vs fleet median 44%"), or when its last push is
+        older than ``stale_s`` seconds.
+        """
+        view = self.job_snapshot()
+        hosts: Dict[int, dict] = view["hosts"]  # type: ignore[assignment]
+        if not hosts:
+            return "(no worker telemetry yet)"
+        # fleet median busy share per stage
+        by_stage: Dict[str, List[float]] = {}
+        for h in hosts.values():
+            for stage, share in h["attribution"]["bound"].items():
+                by_stage.setdefault(stage, []).append(share)
+        median: Dict[str, float] = {}
+        for stage, shares in by_stage.items():
+            s = sorted(shares)
+            mid = len(s) // 2
+            median[stage] = s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2
+
+        def share_of(item):
+            attr = item[1]["attribution"]
+            st = attr["bound_stage"]
+            return attr["bound"].get(st, 0.0) if st else 0.0
+
+        lines = ["rank  host             bound           busy_s   flags"]
+        for rank, h in sorted(hosts.items(), key=share_of, reverse=True):
+            attr = h["attribution"]
+            st = attr["bound_stage"]
+            share = attr["bound"].get(st, 0.0) if st else 0.0
+            busy = sum(x["busy_s"] for x in attr["stages"].values())
+            flags = []
+            if st is not None:
+                med = median.get(st, 0.0)
+                if share >= 1.5 * med and share - med >= 10.0:
+                    flags.append(f"straggler ({st}-bound {share:.0f}% vs "
+                                 f"fleet median {med:.0f}%)")
+            if h["age_s"] > stale_s:
+                flags.append(f"stale {h['age_s']:.0f}s")
+            if h["restarted"]:
+                flags.append("restarted")
+            bound = f"{st}-bound {share:.0f}%" if st else "-"
+            lines.append(f"{rank:<6}{h['host']:<17}{bound:<16}"
+                         f"{busy:>7.2f}   {'; '.join(flags)}".rstrip())
+        return "\n".join(lines)
+
+    def provider(self) -> List[Tuple[Dict[str, str], dict]]:
+        """``telemetry_http.serve`` provider: one labeled source per host."""
+        with self._lock:
+            hosts = {r: dict(h) for r, h in self._hosts.items()}
+        return [({"rank": str(r), "host": h["host"]}, h["snapshot"])
+                for r, h in sorted(hosts.items())]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+
+
+# ---- worker side ------------------------------------------------------------
+
+def push_once(tracker_uri: str, metrics_port: int, rank: int,
+              restarted: bool = False, timeout: float = 10.0) -> None:
+    """Push one snapshot to the tracker (raises on connection failure —
+    the periodic pusher catches, a deterministic test caller should see)."""
+    payload = json.dumps({
+        "rank": int(rank),
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "restarted": bool(restarted),
+        "snapshot": telemetry.snapshot(),
+    })
+    with socket.create_connection((tracker_uri, metrics_port),
+                                  timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _write_int(sock, METRICS_MAGIC)
+        if _read_int(sock) != METRICS_MAGIC:
+            raise ConnectionError("metrics channel handshake failed")
+        _write_str(sock, payload)
+        if _read_int(sock) != 0:
+            raise ConnectionError("tracker rejected metrics push")
+
+
+class MetricsPusher:
+    """Daemon thread pushing this process's snapshot every ``interval_s``.
+
+    Push failures are tolerated silently (the tracker may not be up yet or
+    may already be gone); snapshots are cumulative so the next successful
+    push repairs the tracker's view.
+    """
+
+    def __init__(self, tracker_uri: str, metrics_port: int, rank: int,
+                 interval_s: float = 2.0):
+        self.tracker_uri = tracker_uri
+        self.metrics_port = int(metrics_port)
+        self.rank = int(rank)
+        self.interval_s = max(float(interval_s), 0.05)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="dmlctpu-metrics-pusher", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.push()
+
+    def push(self) -> bool:
+        """One immediate push; True on success."""
+        try:
+            push_once(self.tracker_uri, self.metrics_port, self.rank)
+            return True
+        except (OSError, ConnectionError, ValueError):
+            return False
+
+    def close(self, final_push: bool = True) -> None:
+        """Stop the thread; by default push one last snapshot so the tracker
+        sees the epoch's final counters even with a long interval."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if final_push:
+            self.push()
+
+
+_pusher_lock = threading.Lock()
+_pusher: Optional[MetricsPusher] = None
+
+
+def _env_rank() -> int:
+    for key in ("DMLC_WORKER_RANK", "DMLC_TASK_ID"):
+        v = os.environ.get(key, "")
+        if v.isdigit():
+            return int(v)
+    return 0
+
+
+def ensure_pusher() -> Optional[MetricsPusher]:
+    """Start (once) the process-wide pusher from the env contract, or None
+    when ``DMLC_TRACKER_METRICS_PORT`` is unset.  The staging iterators call
+    this so any worker launched under a tracker reports automatically."""
+    global _pusher
+    port = os.environ.get(METRICS_PORT_ENV)
+    if not port:
+        return None
+    with _pusher_lock:
+        if _pusher is None:
+            _pusher = MetricsPusher(
+                tracker_uri=os.environ.get("DMLC_TRACKER_URI", "127.0.0.1"),
+                metrics_port=int(port),
+                rank=_env_rank(),
+                interval_s=float(
+                    os.environ.get("DMLCTPU_METRICS_INTERVAL_S", "2.0")))
+        return _pusher
+
+
+def stop_pusher(final_push: bool = True) -> None:
+    """Stop the process-wide pusher (test hygiene)."""
+    global _pusher
+    with _pusher_lock:
+        p, _pusher = _pusher, None
+    if p is not None:
+        p.close(final_push=final_push)

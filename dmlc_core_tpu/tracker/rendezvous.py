@@ -232,10 +232,18 @@ class _Worker:
 
 
 class RabitTracker:
-    """Rendezvous server: assigns ranks, ships topology, brokers peer links."""
+    """Rendezvous server: assigns ranks, ships topology, brokers peer links.
+
+    Alongside the rendezvous socket the tracker owns a telemetry side
+    channel (``tracker/metrics.py``): workers push counter snapshots to it
+    and :meth:`job_snapshot` / :meth:`format_job_table` answer job-wide
+    questions ("which host is the straggler?").  Its port is negotiated at
+    rendezvous via ``DMLC_TRACKER_METRICS_PORT`` in :meth:`worker_envs`.
+    """
 
     def __init__(self, host_ip: str, num_workers: int, port: int = 9091,
-                 port_end: int = 9999, extra_envs: Optional[dict] = None):
+                 port_end: int = 9999, extra_envs: Optional[dict] = None,
+                 enable_metrics: bool = True):
         family = socket.getaddrinfo(host_ip, None)[0][0]
         sock = socket.socket(family, socket.SOCK_STREAM)
         bound = False
@@ -257,12 +265,34 @@ class RabitTracker:
         self.thread: Optional[threading.Thread] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
+        self.metrics = None
+        if enable_metrics:
+            from . import metrics as _metrics
+            self.metrics = _metrics.MetricsAggregator(host_ip=host_ip)
 
     def worker_envs(self) -> dict:
         """The DMLC_* contract handed to every worker."""
         envs = {"DMLC_TRACKER_URI": self.host_ip, "DMLC_TRACKER_PORT": self.port}
+        if self.metrics is not None:
+            envs["DMLC_TRACKER_METRICS_PORT"] = self.metrics.port
         envs.update(self.extra_envs)
         return envs
+
+    def job_snapshot(self) -> dict:
+        """Merged job telemetry (see MetricsAggregator.job_snapshot):
+        per-host snapshots + a fleet roll-up whose counters are exact sums
+        over hosts.  Empty view when metrics were disabled."""
+        if self.metrics is None:
+            return {"hosts": {}, "num_hosts": 0, "restarted": False,
+                    "fleet": {"enabled": False, "counters": {}, "gauges": {},
+                              "histograms": {}}}
+        return self.metrics.job_snapshot()
+
+    def format_job_table(self) -> str:
+        """Per-host bottleneck ranking with straggler flags."""
+        if self.metrics is None:
+            return "(tracker metrics disabled)"
+        return self.metrics.format_job_table()
 
     def _serve(self) -> None:
         num_workers = self.num_workers
@@ -359,6 +389,8 @@ class RabitTracker:
             self.sock.close()
         except OSError:
             pass
+        if self.metrics is not None:
+            self.metrics.close()
 
 
 class PSTracker:

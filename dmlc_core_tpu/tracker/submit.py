@@ -3,8 +3,10 @@
 Parity: reference tracker/dmlc_tracker/{submit.py:38-56, tracker.py:410-433}.
 The env contract handed to workers is kept verbatim (DMLC_TRACKER_URI/PORT,
 DMLC_ROLE, DMLC_TASK_ID, DMLC_NUM_WORKER/SERVER, DMLC_PS_ROOT_URI/PORT,
-DMLC_JOB_CLUSTER) plus one TPU-era addition: DMLC_JAX_COORDINATOR, the
-address of the JAX coordination service (tracker host, tracker port + 1).
+DMLC_JOB_CLUSTER) plus two TPU-era additions: DMLC_JAX_COORDINATOR, the
+address of the JAX coordination service (tracker host, tracker port + 1),
+and DMLC_TRACKER_METRICS_PORT, the tracker's telemetry aggregation channel
+(tracker/metrics.py) that workers push counter snapshots to.
 """
 from __future__ import annotations
 

@@ -98,8 +98,19 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   else
     python -m pytest tests/ -x -q -m "not slow"
   fi
+
+  # Watchdog tier: the whole staging suite under an AGGRESSIVE 2 s stall
+  # deadline with abort policy.  Every epoch arms the env watchdog via
+  # _observability_scope; any spurious stall verdict calls abort() in the
+  # test process and the tier goes red — proving the detector stays quiet
+  # on busy pipelines (slow epochs, tiny buffers, worker pools) and only
+  # ever fires on real wedges.  Tests that inject a REAL stall are safe:
+  # their own outer watchdog() context arms first (warn policy), and the
+  # env arming nests refcounted inside it without replacing the policy.
+  DMLCTPU_WATCHDOG_DEADLINE_S=2 DMLCTPU_WATCHDOG_POLICY=abort \
+    python -m pytest tests/test_staging.py -x -q -m "not slow"
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier")
 echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + $py)"

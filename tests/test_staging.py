@@ -1,4 +1,7 @@
 """DeviceStagingIter: static shapes, padding semantics, sharded layout."""
+import json
+import time
+
 import numpy as np
 import pytest
 
@@ -406,3 +409,61 @@ def test_parallel_parts_pool_full_buffer_part_boundary():
             got = list(_parallel_parts_iter(open_part, 16, nw, True,
                                             max_buffered=1))
             assert got == want
+
+
+# ---- stall watchdog over live staging ---------------------------------------
+
+def test_watchdog_no_false_positive_on_slow_epoch(libsvm_file):
+    """A slow-but-progressing epoch must never trip the watchdog: the
+    deadline is measured from the LAST progress event, not epoch start.
+    buffer_mb=1 keeps the pool starved so the pipeline runs as slowly as it
+    ever will, and the consumer adds its own think time per batch."""
+    from dmlc_core_tpu import telemetry
+
+    stalls0 = telemetry.watchdog_stall_count()
+    with telemetry.watchdog(deadline_s=2.0, poll_s=0.1):
+        it = dt.DeviceStagingIter(libsvm_file, batch_size=64, nnz_bucket=256,
+                                  num_workers=2, buffer_mb=1)
+        rows = 0
+        for b in it:
+            rows += int(b.num_rows)
+            time.sleep(0.05)  # a "slow" consumer, still far under 2 s
+        assert rows == 1000
+    assert telemetry.watchdog_stall_count() == stalls0
+
+
+def test_watchdog_flags_paused_consumer(libsvm_file, tmp_path):
+    """Acceptance: injecting a stall by pausing the consumer mid-epoch
+    produces a flight-record JSON naming the stalled stage."""
+    from dmlc_core_tpu import telemetry
+
+    if not telemetry.enabled():
+        pytest.skip("watchdog is compiled out")
+    dump = tmp_path / "flight.json"
+    stalls0 = telemetry.watchdog_stall_count()
+    with telemetry.watchdog(deadline_s=0.5, poll_s=0.1, policy="warn",
+                            dump_path=str(dump)):
+        it = dt.DeviceStagingIter(libsvm_file, batch_size=64, nnz_bucket=256,
+                                  num_workers=2)
+        rows = 0
+        for i, b in enumerate(it):
+            rows += int(b.num_rows)
+            if i == 2:
+                # consumer pauses: every queue upstream tops off, then
+                # nothing moves until the watchdog deadline expires
+                deadline = time.monotonic() + 15.0
+                while (telemetry.watchdog_stall_count() == stalls0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.1)
+        assert rows == 1000  # pipeline resumes after the pause: warn policy
+    assert telemetry.watchdog_stall_count() > stalls0
+    rec = json.loads(dump.read_text())
+    # staged batches sat ready in the device feed while nothing progressed,
+    # so the record names the h2d handoff, not whichever upstream stage
+    # happened to fill its buffer first
+    assert rec["stalled_stage"] == "h2d"
+    assert rec["enabled"] is True
+    assert {s["stage"] for s in rec["stages"]} == {
+        "split", "parse", "shard", "pack", "record", "h2d"}
+    last = telemetry.last_flight_record()
+    assert last is not None and last["stalled_stage"] == rec["stalled_stage"]

@@ -6,6 +6,7 @@ value assertions are gated on :func:`telemetry.enabled`, while the API shape
 independent of the telemetry macro) is asserted unconditionally.
 """
 import json
+import time
 
 import numpy as np
 import pytest
@@ -128,7 +129,9 @@ def test_stall_attribution_staging(libsvm_file):
     assert rows == 2000
     attr = telemetry.stall_attribution(before, telemetry.snapshot(), wall_s=1.0)
 
-    assert set(attr) == {"stages", "bound", "bound_stage", "table", "wall_s"}
+    assert set(attr) == {"stages", "bound", "bound_stage", "table", "wall_s",
+                         "restarted"}
+    assert attr["restarted"] is False
     assert set(attr["stages"]) == {"parse", "shard", "pack", "h2d"}
     for st in attr["stages"].values():
         assert st["busy_s"] >= 0.0 and st["wait_s"] >= 0.0
@@ -194,3 +197,198 @@ def test_reset_zeroes_counters(libsvm_file):
     if telemetry.enabled():
         assert all(v == 0 for v in snap["counters"].values())
         assert all(h["count"] == 0 for h in snap["histograms"].values())
+
+
+def test_gauge_roundtrip():
+    telemetry.gauge_set("test.py_gauge", 7)
+    telemetry.gauge_add("test.py_gauge", -3)
+    v = telemetry.gauge_get("test.py_gauge")
+    assert v == (4 if telemetry.enabled() else 0)
+    if telemetry.enabled():
+        assert telemetry.snapshot()["gauges"]["test.py_gauge"] == 4
+
+
+def test_counters_delta_clamps_worker_restart():
+    # a worker restart re-registers counters from zero; the delta must clamp
+    # at zero (not report a huge negative interval) and the snapshots must
+    # be taggable as restarted so callers don't silently trust them
+    before = {"counters": {"parse.rows": 1000, "split.bytes": 500}}
+    after = {"counters": {"parse.rows": 40, "split.bytes": 700}}
+    assert telemetry.counters_delta(before, after) == {"parse.rows": 0,
+                                                       "split.bytes": 200}
+    assert telemetry.snapshot_restarted(before, after) is True
+    assert telemetry.snapshot_restarted(after, after) is False
+    # counters appearing for the first time are growth, not a restart
+    assert telemetry.snapshot_restarted({"counters": {}}, after) is False
+    attr = telemetry.stall_attribution(before, after, wall_s=1.0)
+    assert attr["restarted"] is True
+
+
+def test_merge_snapshots_and_conservative_quantile():
+    h_a = {"count": 1, "sum": 3, "buckets": [0] * 32}
+    h_a["buckets"][2] = 1          # one observation of 3 (upper bound 4)
+    h_b = {"count": 1, "sum": 100, "buckets": [0] * 32}
+    h_b["buckets"][7] = 1          # one observation of 100 (upper bound 128)
+    a = {"enabled": True, "counters": {"parse.rows": 5, "only.a": 1},
+         "gauges": {"depth": 2}, "histograms": {"lat": h_a}}
+    b = {"enabled": True, "counters": {"parse.rows": 7},
+         "gauges": {"depth": 3}, "histograms": {"lat": h_b}}
+    m = telemetry.merge_snapshots([a, b])
+    assert m["counters"] == {"parse.rows": 12, "only.a": 1}
+    assert m["gauges"] == {"depth": 5}
+    lat = m["histograms"]["lat"]
+    assert lat["count"] == 2 and lat["sum"] == 103
+    assert lat["buckets"][2] == 1 and lat["buckets"][7] == 1
+    # bucket upper bounds survive the merge, so quantile estimates are
+    # conservative: never below the true quantile of the pooled events
+    assert telemetry.histogram_quantile(lat, 0.5) >= 3    # true median: 3
+    assert telemetry.histogram_quantile(lat, 1.0) >= 100  # true max: 100
+    assert telemetry.histogram_quantile({"count": 0, "sum": 0,
+                                         "buckets": [0] * 32}, 0.5) is None
+    overflow = {"count": 1, "sum": 1, "buckets": [0] * 31 + [1]}
+    assert telemetry.histogram_quantile(overflow, 0.5) == float("inf")
+
+
+def test_watchdog_context_arms_and_disarms():
+    assert telemetry.watchdog_running() is False
+    with telemetry.watchdog(deadline_s=30.0):
+        assert telemetry.watchdog_running() is telemetry.enabled()
+        with telemetry.watchdog(deadline_s=1.0):  # nested: refcounts
+            assert telemetry.watchdog_running() is telemetry.enabled()
+        assert telemetry.watchdog_running() is telemetry.enabled()
+    assert telemetry.watchdog_running() is False
+    with pytest.raises(ValueError):
+        with telemetry.watchdog(policy="explode"):
+            pass
+
+
+def test_flight_record_shape():
+    rec = telemetry.flight_record("unit test")
+    assert rec["enabled"] == telemetry.enabled()
+    if not telemetry.enabled():
+        return
+    assert rec["reason"] == "unit test"
+    stages = {s["stage"] for s in rec["stages"]}
+    assert stages == {"split", "parse", "shard", "pack", "record", "h2d"}
+    for s in rec["stages"]:
+        assert s["age_us"] == -1  # unarmed: progress ages are meaningless
+    assert rec["registry"]["enabled"] is True
+    assert isinstance(rec["trace"]["traceEvents"], list)
+
+
+def test_watchdog_detects_injected_stall(tmp_path):
+    if not telemetry.enabled():
+        pytest.skip("watchdog is compiled out")
+    dump = tmp_path / "flight.json"
+    stalls0 = telemetry.watchdog_stall_count()
+    with telemetry.capture_logs(min_severity=3) as records:
+        with telemetry.watchdog(deadline_s=0.2, poll_s=0.05, policy="warn",
+                                dump_path=str(dump)):
+            # one h2d batch, then nothing: the pipeline "wedged" right
+            # after the device feed emitted its last batch
+            telemetry.counter_add("h2d.batches", 1)
+            deadline = time.monotonic() + 10.0
+            while (telemetry.watchdog_stall_count() == stalls0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+    assert telemetry.watchdog_stall_count() > stalls0
+    rec = telemetry.last_flight_record()
+    assert rec is not None and rec["stalled_stage"] == "h2d"
+    on_disk = json.loads(dump.read_text())
+    assert on_disk["stalled_stage"] == "h2d"
+    assert any("pipeline stall" in msg and "h2d" in msg
+               for _, where, msg in records if where.startswith("watchdog"))
+
+
+def test_telemetry_http_endpoints(libsvm_file):
+    import urllib.error
+    from urllib.request import urlopen
+
+    from dmlc_core_tpu import telemetry_http
+
+    drain(libsvm_file)  # make sure the registry has pipeline families
+    with telemetry_http.serve(port=0) as srv:
+        with urlopen(srv.url + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        _assert_prometheus_wellformed(text)
+        if telemetry.enabled():
+            assert "dmlctpu_parse_rows_total" in text
+        with urlopen(srv.url + "/trace", timeout=10) as resp:
+            assert "traceEvents" in json.loads(resp.read().decode())
+        with urlopen(srv.url + "/flight?fresh=1", timeout=10) as resp:
+            rec = json.loads(resp.read().decode())
+            assert rec["enabled"] == telemetry.enabled()
+        with urlopen(srv.url + "/snapshot", timeout=10) as resp:
+            snap = json.loads(resp.read().decode())
+            assert snap["enabled"] == telemetry.enabled()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urlopen(srv.url + "/nope", timeout=10)
+        assert ei.value.code == 404
+
+
+def _assert_prometheus_wellformed(text):
+    """Minimal validity check for the classic text exposition format."""
+    import re
+
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9]+(\.[0-9]+)?$")
+    typed = set()
+    seen_families = []
+    for line in text.rstrip("\n").split("\n"):
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            name, mtype = line.split()[2:4]
+            assert mtype in ("counter", "gauge", "histogram")
+            assert name not in typed, f"duplicate TYPE for {name}"
+            typed.add(name)
+            seen_families.append(name)
+        elif line.startswith("#"):
+            continue
+        else:
+            assert sample_re.match(line), f"bad sample line: {line!r}"
+            metric = line.split("{", 1)[0].split(" ", 1)[0]
+            fam = seen_families[-1] if seen_families else ""
+            assert metric == fam or metric.startswith(fam + "_"), \
+                f"sample {metric} outside its family block {fam}"
+    if telemetry.enabled():
+        assert typed, "no TYPE lines in exposition"
+
+
+def test_capture_logs_interleaved_thread_ordering():
+    """Native and Python emitters racing on several threads: the captured
+    stream must preserve each thread's emission order (the sink serializes
+    under one mutex, so per-thread subsequences stay sorted)."""
+    import threading
+
+    n_per_thread = 200
+    with telemetry.capture_logs(min_severity=2) as records:
+        def native_emitter(tag):
+            for i in range(n_per_thread):
+                _native.log_emit(2, f"{tag}:{i}")
+
+        def python_emitter(tag):
+            # the Python-side path: route through the same sink via the
+            # C API's log_emit — what telemetry.capture_logs forwards
+            for i in range(n_per_thread):
+                _native.log_emit(3, f"{tag}:{i}")
+
+        threads = [threading.Thread(target=native_emitter, args=(f"n{t}",))
+                   for t in range(2)]
+        threads += [threading.Thread(target=python_emitter, args=(f"p{t}",))
+                    for t in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(records) == 4 * n_per_thread
+    by_tag = {}
+    for _, _, msg in records:
+        tag, idx = msg.rsplit(":", 1)
+        by_tag.setdefault(tag, []).append(int(idx))
+    assert set(by_tag) == {"n0", "n1", "p0", "p1"}
+    for tag, seq in by_tag.items():
+        assert seq == list(range(n_per_thread)), \
+            f"thread {tag} order scrambled"
