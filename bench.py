@@ -385,15 +385,15 @@ print("RATE %.6f" % best, flush=True)
 """
 
 
-def build_notelemetry_so() -> Path | None:
-    """Build build/notelemetry/libdmlctpu.so with telemetry compiled out,
-    mirroring _native.py's direct-g++ fallback flags.  Cached on source
-    mtimes (the -O3 rebuild costs minutes on a 1-core box)."""
+def build_variant_so(variant: str, defines: tuple[str, ...]) -> Path | None:
+    """Build build/<variant>/libdmlctpu.so with extra -D flags, mirroring
+    _native.py's direct-g++ fallback flags.  Cached on source mtimes (the
+    -O3 rebuild costs minutes on a 1-core box)."""
     import shutil
     cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
     if cxx is None:
         return None
-    so = REPO / "build" / "notelemetry" / "libdmlctpu.so"
+    so = REPO / "build" / variant / "libdmlctpu.so"
     sources = sorted(
         str(p) for sub in ("cpp/src", "cpp/src/io", "cpp/src/data")
         for p in (REPO / sub).glob("*.cc"))
@@ -404,13 +404,17 @@ def build_notelemetry_so() -> Path | None:
         return so
     so.parent.mkdir(parents=True, exist_ok=True)
     cmd = [cxx, "-O3", "-g", "-std=c++20", "-fPIC", "-shared", "-pthread",
-           "-fvisibility-inlines-hidden", "-DDMLCTPU_TELEMETRY=0",
+           "-fvisibility-inlines-hidden", *defines,
            "-I", str(REPO / "cpp/include"), *sources, "-o", str(so)]
     proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
     if proc.returncode != 0:
-        log(f"[bench] notelemetry build failed: {proc.stderr[-300:]}")
+        log(f"[bench] {variant} build failed: {proc.stderr[-300:]}")
         return None
     return so
+
+
+def build_notelemetry_so() -> Path | None:
+    return build_variant_so("notelemetry", ("-DDMLCTPU_TELEMETRY=0",))
 
 
 def run_telemetry_overhead(data: Path, repeats: int = 3) -> dict:
@@ -448,6 +452,47 @@ def run_telemetry_overhead(data: Path, repeats: int = 3) -> dict:
         # round (noisy 1-core boxes wobble more than the 2% budget)
         log(f"[bench] WARNING: telemetry overhead {pct:.2f}% exceeds the "
             f"2% budget ({rate_on:.1f} vs {rate_off:.1f} MB/s)")
+    return out
+
+
+def run_faults_overhead(data: Path, repeats: int = 3) -> dict:
+    """Compare the libsvm parse headline with the fault-injection points
+    compiled in (but unarmed — the shipping default) vs -DDMLCTPU_FAULTS=0.
+    The robustness contract (doc/robustness.md): an unarmed point is one
+    relaxed atomic load, <=1% on the parse headline.  Telemetry stays ON in
+    both builds so only the fault points differ."""
+    so = build_variant_so("nofaults", ("-DDMLCTPU_FAULTS=0",))
+    if so is None:
+        return {"error": "no compiler for the nofaults build"}
+
+    def child_rate(library_path: str | None) -> float | None:
+        env = dict(os.environ)
+        env.pop("DMLCTPU_LIBRARY_PATH", None)
+        env.pop("DMLCTPU_FAULTS", None)  # the gate measures UNARMED points
+        if library_path is not None:
+            env["DMLCTPU_LIBRARY_PATH"] = library_path
+        proc = subprocess.run(
+            [sys.executable, "-c", _PARSE_RATE_CHILD, str(data),
+             str(repeats)], env=env, capture_output=True, text=True,
+            timeout=900, cwd=REPO)
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("RATE "):
+                return float(line.split()[1])
+        log(f"[bench] faults-overhead child failed "
+            f"(rc={proc.returncode}): {proc.stderr[-300:]}")
+        return None
+
+    rate_on = child_rate(None)
+    rate_off = child_rate(str(so))
+    if not rate_on or not rate_off:
+        return {"error": "overhead child produced no rate"}
+    pct = (rate_off - rate_on) / rate_off * 100.0
+    out = {"mb_s_on": round(rate_on, 2), "mb_s_off": round(rate_off, 2),
+           "faults_overhead_pct": round(pct, 2),
+           "faults_overhead_ok": pct <= 1.0}
+    if not out["faults_overhead_ok"]:
+        log(f"[bench] WARNING: fault-point overhead {pct:.2f}% exceeds the "
+            f"1% budget ({rate_on:.1f} vs {rate_off:.1f} MB/s)")
     return out
 
 
@@ -1149,6 +1194,11 @@ def main() -> None:
     except Exception as e:  # never let the gate phase kill the round
         overhead = {"error": str(e)[-300:]}
     log(f"[bench] telemetry overhead: {overhead}")
+    try:
+        faults_overhead = run_faults_overhead(data)
+    except Exception as e:
+        faults_overhead = {"error": str(e)[-300:]}
+    log(f"[bench] fault-point overhead: {faults_overhead}")
     csv_data = make_csv_dataset()
     csv_ref_rate = None
     csv_exe = ensure_reference_csv_binary()
@@ -1238,6 +1288,7 @@ def main() -> None:
             "stall_attribution"),
         "staging_job_table": staging.get("parallel", {}).get("job_table"),
         "telemetry_overhead": overhead,
+        "faults_overhead": faults_overhead,
         "tpu_probe": probe_summary,
         "data_mb": data.stat().st_size >> 20,
     }
@@ -1263,6 +1314,7 @@ def main() -> None:
         "staging_platform": full["staging_platform"],
         "stall": (full["stall_attribution"] or {}).get("table"),
         "telemetry_overhead_pct": overhead.get("telemetry_overhead_pct"),
+        "faults_overhead_pct": faults_overhead.get("faults_overhead_pct"),
         "tpu_probe_ok": probe_summary["ok"],
         "detail": "full numbers on the DETAIL line above",
     }

@@ -84,8 +84,15 @@ int DmlcTpuRecordIOWriterClose(DmlcTpuRecordIOWriterHandle handle);
 /*! \brief closes the underlying stream (failures logged, not reported) */
 void DmlcTpuRecordIOWriterFree(DmlcTpuRecordIOWriterHandle handle);
 int DmlcTpuRecordIOReaderCreate(const char* uri, DmlcTpuRecordIOReaderHandle* out);
+/*! \brief as Create; recover != 0 skips corrupt record spans (resyncing to
+ *         the next record head and counting record.corrupt_skipped) instead
+ *         of failing the read — see doc/robustness.md */
+int DmlcTpuRecordIOReaderCreateEx(const char* uri, int recover,
+                                  DmlcTpuRecordIOReaderHandle* out);
 int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** data,
                               uint64_t* size);
+/*! \brief corrupt spans skipped so far by this reader (recover mode) */
+int64_t DmlcTpuRecordIOReaderCorruptSkipped(DmlcTpuRecordIOReaderHandle handle);
 void DmlcTpuRecordIOReaderFree(DmlcTpuRecordIOReaderHandle handle);
 
 /* ---- StagedBatcher: parse→pack→pad pipeline for device staging ---------- */
@@ -192,6 +199,13 @@ typedef struct {
 int DmlcTpuRecordBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                uint64_t records_cap, uint64_t bytes_cap,
                                DmlcTpuRecordBatcherHandle* out);
+/*! \brief as Create; recover != 0 skips corrupt record spans inside each
+ *         chunk (counted in record.corrupt_skipped) instead of aborting the
+ *         epoch — see doc/robustness.md */
+int DmlcTpuRecordBatcherCreateEx(const char* uri, unsigned part,
+                                 unsigned num_parts, uint64_t records_cap,
+                                 uint64_t bytes_cap, int recover,
+                                 DmlcTpuRecordBatcherHandle* out);
 /*! \brief next batch (1/0/-1); buffers valid until the following call */
 int DmlcTpuRecordBatcherNext(DmlcTpuRecordBatcherHandle handle,
                              DmlcTpuRecordBatchC* out);
@@ -275,6 +289,27 @@ int DmlcTpuWatchdogStallCount(int64_t* out);
 int DmlcTpuFlightRecordJson(const char* reason, const char** out);
 /* the record dumped by the most recent watchdog stall ("" when none). */
 int DmlcTpuWatchdogLastRecordJson(const char** out);
+
+/* ---- deterministic fault injection (dmlctpu/fault.h) ---------------------- */
+/* *out = 1 when the fault registry was compiled in (DMLCTPU_FAULTS=1, the
+ * default); 0 in a -DDMLCTPU_FAULTS=0 build, where Arm with a nonempty spec
+ * fails and snapshots report {"enabled":false}. */
+int DmlcTpuFaultCompiledIn(int* out);
+/* (Re)arm named fault points from a spec string, replacing any previous
+ * arming atomically:
+ *   "io.ranged.read=err@0.01;io.opener.5xx=503@0.05:n=20;seed=7"
+ * Grammar per clause: <point>=<mode>@<rate>[:n=<count>][:after=<skip>];
+ * modes err|eof|503|5xx|corrupt; "seed=N" reseeds the deterministic
+ * decision stream.  NULL/"" disarms everything.  Malformed specs fail with
+ * -1 and leave the previous arming untouched. */
+int DmlcTpuFaultArm(const char* spec);
+/* disarm every fault point (armed specs and hit counters reset). */
+int DmlcTpuFaultDisarm(void);
+/* JSON state: {"enabled":...,"armed":...,"seed":...,"points":[...]};
+ * pointer valid until the next fault/telemetry call on the same thread. */
+int DmlcTpuFaultSnapshotJson(const char** out);
+/* total injected faults across all points since the last (re)arm. */
+int DmlcTpuFaultInjectedTotal(int64_t* out);
 
 /* ---- logging ------------------------------------------------------------- */
 /* severity: 0=DEBUG 1=INFO 2=WARNING 3=ERROR 4=FATAL.  `where` is

@@ -1,0 +1,148 @@
+// dmlctpu/fault.h — deterministic fault injection for the IO/staging substrate.
+//
+// A fault POINT is a named site in the native code (the name contract lives in
+// doc/robustness.md): "io.http.connect", "io.ranged.read", "io.opener.5xx",
+// "recordio.magic", "shard.worker.chunk".  Sites cache a Point& once
+// (DMLCTPU_FAULT_POINT) and call Fire() per potentially-faultable operation;
+// Fire() returns the armed Mode when THIS hit should fault, kNone otherwise.
+//
+// Arming (all three replace the full arming set):
+//  * env   DMLCTPU_FAULTS="io.ranged.read=err@0.01;io.opener.5xx=503@1:n=3;seed=7"
+//  * C API DmlcTpuFaultArm(spec)
+//  * Python dmlc_core_tpu.faultinject.arm(...)
+//
+// Spec grammar: ';'-separated entries.  "seed=N" sets the decision seed;
+// every other entry is "<point>=<mode>@<rate>[:n=<count>][:after=<skip>]":
+//   mode   err | eof | 503 | corrupt   (what the site should simulate)
+//   rate   probability in [0,1] that an eligible hit fires
+//   n      at most <count> injections for this point (default unlimited)
+//   after  first <skip> hits are always clean (default 0)
+//
+// Determinism: the fire/no-fire decision for hit k of point p is a pure
+// function of (seed, p, k) — a splitmix64 hash compared against the scaled
+// rate — so a failing run replays exactly by re-arming the same spec+seed.
+// (Which thread takes hit k can vary with scheduling; the decision for hit k
+// cannot.)  Every injection bumps the "fault.injected" telemetry counter and
+// the per-point tally in SnapshotJson().
+//
+// Compiling with -DDMLCTPU_FAULTS=0 replaces everything with inline no-op
+// stubs exactly like -DDMLCTPU_TELEMETRY=0 does for telemetry.h: Fire()
+// becomes a constant kNone and every injection branch folds away.
+#ifndef DMLCTPU_FAULT_H_
+#define DMLCTPU_FAULT_H_
+
+#ifndef DMLCTPU_FAULTS
+#define DMLCTPU_FAULTS 1
+#endif
+
+#include <cstdint>
+#include <string>
+
+#if DMLCTPU_FAULTS
+#include <atomic>
+#endif
+
+namespace dmlctpu {
+namespace fault {
+
+/*! \brief true when fault injection was compiled in (mirrors the macro). */
+constexpr bool Enabled() { return DMLCTPU_FAULTS != 0; }
+
+/*! \brief what the firing site should simulate.  kNone means "no fault". */
+enum class Mode : int { kNone = 0, kErr = 1, kEof = 2, kHttp503 = 3, kCorrupt = 4 };
+
+#if DMLCTPU_FAULTS
+
+/*! \brief process-wide "anything armed at all" flag: the entire unarmed hot
+ *  path is this one relaxed load. */
+std::atomic<bool>& ArmedFlag();
+
+class Point {
+ public:
+  explicit Point(std::string name) : name_(std::move(name)) {}
+
+  /*! \brief count this hit and decide whether it faults.  Unarmed steady
+   *  state: one relaxed bool load, no RMW. */
+  Mode Fire() {
+    if (!ArmedFlag().load(std::memory_order_relaxed)) return Mode::kNone;
+    return FireSlow();
+  }
+
+  const std::string& name() const { return name_; }
+  uint64_t hits() const { return hits_.load(std::memory_order_relaxed); }
+  uint64_t injected() const { return injected_.load(std::memory_order_relaxed); }
+
+ private:
+  friend class RegistryImpl;
+  Mode FireSlow();
+
+  const std::string name_;
+  std::atomic<bool> armed_{false};
+  Mode mode_ = Mode::kNone;
+  uint64_t threshold_ = 0;          // rate scaled to [0, 2^64)
+  uint64_t after_ = 0;              // hits to skip before eligibility
+  uint64_t seed_ = 0;               // global seed mixed with the point name
+  std::atomic<int64_t> budget_{-1};  // remaining injections; -1 = unlimited
+  std::atomic<uint64_t> hits_{0};
+  std::atomic<uint64_t> injected_{0};
+};
+
+/*! \brief look up (creating on first use) the named point.  Points live
+ *  forever; sites cache the reference via DMLCTPU_FAULT_POINT.  The first
+ *  call anywhere applies the DMLCTPU_FAULTS env spec, if set. */
+Point& GetPoint(const std::string& name);
+
+/*! \brief replace the full arming set from a spec string ("" / empty
+ *  disarms everything).  Returns false and fills *err on a malformed spec
+ *  (the previous arming stays in place, never half-applied). */
+bool ArmSpec(const std::string& spec, std::string* err);
+
+/*! \brief disarm every point and zero the hit/injected tallies. */
+void DisarmAll();
+
+/*! \brief {"enabled":true,"seed":N,"points":[{name,mode,rate,hits,injected},..]} */
+std::string SnapshotJson();
+
+/*! \brief total injections since the last DisarmAll (sum over points). */
+uint64_t InjectedTotal();
+
+#else  // DMLCTPU_FAULTS == 0: inline no-op stubs, call sites compile unchanged
+
+class Point {
+ public:
+  explicit Point(std::string) {}
+  Mode Fire() { return Mode::kNone; }
+  const std::string& name() const {
+    static std::string empty;
+    return empty;
+  }
+  uint64_t hits() const { return 0; }
+  uint64_t injected() const { return 0; }
+};
+
+inline Point& GetPoint(const std::string&) {
+  static Point p{std::string()};
+  return p;
+}
+inline bool ArmSpec(const std::string& spec, std::string* err) {
+  if (!spec.empty()) {
+    if (err != nullptr) *err = "fault injection compiled out (-DDMLCTPU_FAULTS=0)";
+    return false;
+  }
+  return true;
+}
+inline void DisarmAll() {}
+inline std::string SnapshotJson() { return "{\"enabled\":false}"; }
+inline uint64_t InjectedTotal() { return 0; }
+
+#endif  // DMLCTPU_FAULTS
+
+}  // namespace fault
+}  // namespace dmlctpu
+
+/*! \brief cache the named fault point in a function-local static, mirroring
+ *  the telemetry stage-accessor idiom (one registry lookup per site). */
+#define DMLCTPU_FAULT_POINT(var, name) \
+  static ::dmlctpu::fault::Point& var = ::dmlctpu::fault::GetPoint(name)
+
+#endif  // DMLCTPU_FAULT_H_

@@ -44,13 +44,38 @@ class RecordIOWriter {
 
 class RecordIOReader {
  public:
-  explicit RecordIOReader(Stream* stream) : stream_(stream) {}
+  /*!
+   * \brief reader over a stream of records.  With recover=false (default)
+   *        any corruption — bad magic, truncated header/payload, mid-record
+   *        EOF — is fatal.  With recover=true the damaged span is skipped:
+   *        the reader discards the partial record, counts it in
+   *        corrupt_skipped() (+ the record.corrupt_skipped telemetry
+   *        counter), resyncs to the next plausible record head (magic word
+   *        followed by a start-flagged header), and keeps iterating
+   *        (doc/robustness.md).
+   */
+  explicit RecordIOReader(Stream* stream, bool recover = false)
+      : stream_(stream), recover_(recover) {}
   /*! \brief read next logical record; false at end of stream */
   bool NextRecord(std::string* out);
+  /*! \brief corrupt spans skipped so far (recover mode only) */
+  uint64_t corrupt_skipped() const { return corrupt_skipped_; }
 
  private:
+  /*! \brief count one skipped corrupt span (recover mode) */
+  void CountSkip(const char* why);
+  /*! \brief byte-at-a-time window slide to the next plausible record head;
+   *  on success header holds the resync'd (magic, lrec) pair */
+  bool Resync(uint32_t header[2]);
+  /*! \brief loop Read until size bytes or EOF; false on short read */
+  bool ReadFully(void* buf, size_t size);
+
   Stream* stream_;
+  bool recover_;
   bool eos_ = false;
+  bool has_pending_ = false;     // Resync already consumed the next header
+  uint32_t pending_[2] = {0, 0};
+  uint64_t corrupt_skipped_ = 0;
 };
 
 /*!
@@ -64,16 +89,27 @@ class RecordIOChunkReader {
     char* dptr = nullptr;
     size_t size = 0;
   };
-  explicit RecordIOChunkReader(Blob chunk, unsigned part_index = 0, unsigned num_parts = 1);
+  explicit RecordIOChunkReader(Blob chunk, unsigned part_index = 0,
+                               unsigned num_parts = 1, bool recover = false);
   /*!
    * \brief get next record; out points into the chunk when the record is
-   *        contiguous, else into an internal reassembly buffer.
+   *        contiguous, else into an internal reassembly buffer.  With
+   *        recover=true a corrupt span is skipped (counted in
+   *        corrupt_skipped() + record.corrupt_skipped) by scanning forward
+   *        to the next record head instead of aborting.
    */
   bool NextRecord(Blob* out);
+  /*! \brief corrupt spans skipped so far (recover mode only) */
+  uint64_t corrupt_skipped() const { return corrupt_skipped_; }
 
  private:
+  bool NextRecordStrict(Blob* out);
+  bool NextRecordRecover(Blob* out);
+
   char* pbegin_;
   char* pend_;
+  bool recover_ = false;
+  uint64_t corrupt_skipped_ = 0;
   std::string temp_;
 };
 

@@ -1,0 +1,194 @@
+// dmlctpu/retry.h — the one retry/backoff policy the IO substrate shares.
+//
+// Classification contract: transport-level failures (connect refused/reset,
+// recv timeout, TLS read drop, dropped body) and throttling/server statuses
+// (408, 429, 5xx) throw TransientError and are RETRYABLE; everything else
+// (4xx, auth, corrupt data) stays dmlctpu::Error and is FATAL — retrying a
+// deterministic failure just multiplies the outage.  Retry loops honor a
+// server's Retry-After hint when one rode the transient (429/503) response.
+//
+// Backoff is exponential with DECORRELATED jitter (sleep = uniform(base,
+// prev*3), capped): concurrent workers hitting one flaky endpoint spread out
+// instead of re-converging in synchronized retry waves.  An overall deadline
+// bounds the total wait regardless of attempt count.
+//
+// Env knobs (read once per process; doc/robustness.md):
+//   DMLCTPU_IO_RETRIES            attempts per operation (default 4)
+//   DMLCTPU_IO_RETRY_BASE_MS      first backoff (default 50; tests set 1)
+//   DMLCTPU_IO_RETRY_CAP_MS       per-sleep cap (default 10000)
+//   DMLCTPU_IO_RETRY_DEADLINE_S   total retry budget (default 120)
+//
+// Every retry bumps io.retry + io.retry_wait_us; exhausting the policy bumps
+// io.giveup and rethrows the last error (stall_attribution() surfaces the
+// wait; the watchdog flight record carries all three).
+#ifndef DMLCTPU_RETRY_H_
+#define DMLCTPU_RETRY_H_
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <string>
+#include <thread>
+
+#include "./logging.h"
+#include "./telemetry.h"
+
+namespace dmlctpu {
+namespace retry {
+
+/*! \brief a retryable failure: transport errors and 408/429/5xx statuses.
+ *  http_status is 0 for pure transport failures; retry_after_ms carries the
+ *  server's Retry-After hint (-1 when absent). */
+class TransientError : public Error {
+ public:
+  explicit TransientError(const std::string& what, int status = 0,
+                          int64_t retry_after = -1)
+      : Error(what), http_status(status), retry_after_ms(retry_after) {}
+  int http_status;
+  int64_t retry_after_ms;
+};
+
+struct RetryPolicy {
+  int max_attempts = 4;
+  int64_t base_ms = 50;
+  int64_t cap_ms = 10000;
+  int64_t deadline_ms = 120000;
+};
+
+/*! \brief the process-wide IO retry policy, read from env once. */
+inline const RetryPolicy& IoPolicy() {
+  static RetryPolicy p = [] {
+    RetryPolicy out;
+    auto env_i64 = [](const char* name, int64_t dflt) {
+      const char* v = std::getenv(name);
+      return (v != nullptr && v[0] != '\0') ? std::atoll(v) : dflt;
+    };
+    out.max_attempts =
+        static_cast<int>(env_i64("DMLCTPU_IO_RETRIES", out.max_attempts));
+    if (out.max_attempts < 1) out.max_attempts = 1;
+    out.base_ms = std::max<int64_t>(env_i64("DMLCTPU_IO_RETRY_BASE_MS", out.base_ms), 0);
+    out.cap_ms = std::max<int64_t>(env_i64("DMLCTPU_IO_RETRY_CAP_MS", out.cap_ms), 1);
+    int64_t deadline_s = env_i64("DMLCTPU_IO_RETRY_DEADLINE_S", -1);
+    if (deadline_s >= 0) out.deadline_ms = deadline_s * 1000;
+    return out;
+  }();
+  return p;
+}
+
+/*! \brief 408/429/5xx: worth another try.  4xx (auth, not-found, bad
+ *  request) is deterministic — fail fast. */
+inline bool RetryableHttpStatus(int status) {
+  return status == 408 || status == 429 || (status >= 500 && status < 600);
+}
+
+/*! \brief Retry-After (delay-seconds form) from lowercased response headers,
+ *  in ms; -1 when absent/unparseable (HTTP-date form falls back to -1 and
+ *  the backoff schedule decides). */
+inline int64_t RetryAfterMs(const std::map<std::string, std::string>& headers) {
+  auto it = headers.find("retry-after");
+  if (it == headers.end()) return -1;
+  char* end = nullptr;
+  long long s = std::strtoll(it->second.c_str(), &end, 10);
+  if (end == it->second.c_str() || s < 0) return -1;
+  return static_cast<int64_t>(s) * 1000;
+}
+
+/*! \brief throw TransientError when the status is retryable (carrying any
+ *  Retry-After hint); pass through otherwise so the caller's own status
+ *  validation (206 proof, 4xx message) still runs. */
+inline void ThrowIfTransientStatus(int status,
+                                   const std::map<std::string, std::string>& headers,
+                                   const std::string& what) {
+  if (RetryableHttpStatus(status)) {
+    throw TransientError(what + ": transient HTTP " + std::to_string(status),
+                         status, RetryAfterMs(headers));
+  }
+}
+
+/*! \brief one operation's backoff state: decorrelated-jitter sleeps under a
+ *  policy deadline.  Not thread-safe; one instance per retried operation. */
+class Backoff {
+ public:
+  explicit Backoff(const RetryPolicy& policy)
+      : policy_(policy),
+        prev_ms_(policy.base_ms),
+        started_(std::chrono::steady_clock::now()) {
+    // jitter seed: address + a process-wide sequence — retries must spread
+    // across workers, but injected-fault DETERMINISM never depends on the
+    // sleep schedule, only on the fault registry's seeded decisions
+    static std::atomic<uint64_t> seq{0};
+    rng_ = reinterpret_cast<uintptr_t>(this) ^
+           (seq.fetch_add(1, std::memory_order_relaxed) * 0x9e3779b97f4a7c15ull);
+  }
+
+  /*! \brief total wall time this operation has been retrying, in ms */
+  int64_t ElapsedMs() const {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now() - started_)
+        .count();
+  }
+
+  bool DeadlineExpired() const { return ElapsedMs() >= policy_.deadline_ms; }
+
+  /*! \brief next sleep: uniform(base, prev*3) capped, or the server's
+   *  Retry-After hint when it asks for longer (never beyond the cap). */
+  int64_t NextDelayMs(int64_t server_hint_ms = -1) {
+    int64_t lo = policy_.base_ms;
+    int64_t hi = std::max<int64_t>(prev_ms_ * 3, lo + 1);
+    int64_t d = lo + static_cast<int64_t>(NextRand() % static_cast<uint64_t>(hi - lo));
+    if (server_hint_ms > d) d = server_hint_ms;
+    if (d > policy_.cap_ms) d = policy_.cap_ms;
+    prev_ms_ = std::max<int64_t>(d, 1);
+    return d;
+  }
+
+  /*! \brief sleep the next backoff step, accounting it as io.retry_wait_us */
+  void SleepNext(int64_t server_hint_ms = -1) {
+    int64_t d = NextDelayMs(server_hint_ms);
+    telemetry::stage::IoRetryWaitUs().Add(static_cast<uint64_t>(d) * 1000);
+    if (d > 0) std::this_thread::sleep_for(std::chrono::milliseconds(d));
+  }
+
+ private:
+  uint64_t NextRand() {
+    // xorshift64*: cheap, no <random> machinery on the retry path
+    rng_ ^= rng_ >> 12;
+    rng_ ^= rng_ << 25;
+    rng_ ^= rng_ >> 27;
+    return rng_ * 0x2545f4914f6cdd1dull;
+  }
+
+  const RetryPolicy& policy_;
+  int64_t prev_ms_;
+  std::chrono::steady_clock::time_point started_;
+  uint64_t rng_;
+};
+
+/*! \brief run fn() retrying TransientError per the policy; counts io.retry /
+ *  io.giveup and logs each retry at WARNING with the remaining budget. */
+template <typename Fn>
+auto WithRetry(const RetryPolicy& policy, const std::string& what, Fn&& fn)
+    -> decltype(fn()) {
+  Backoff backoff(policy);
+  for (int attempt = 1;; ++attempt) {
+    try {
+      return fn();
+    } catch (const TransientError& e) {
+      if (attempt >= policy.max_attempts || backoff.DeadlineExpired()) {
+        telemetry::stage::IoGiveup().Add(1);
+        throw;
+      }
+      telemetry::stage::IoRetry().Add(1);
+      TLOG(Warning) << what << ": transient failure (attempt " << attempt
+                    << "/" << policy.max_attempts << "): " << e.what();
+      backoff.SleepNext(e.retry_after_ms);
+    }
+  }
+}
+
+}  // namespace retry
+}  // namespace dmlctpu
+#endif  // DMLCTPU_RETRY_H_

@@ -386,6 +386,17 @@ DMLCTPU_STAGE_GAUGE(PackQueued, "pack.queued")
 // chunk bytes here; RecordStagingIter.bytes_read reads the delta).
 DMLCTPU_STAGE_COUNTER(RecordBatches, "record.batches")
 DMLCTPU_STAGE_COUNTER(RecordBytes, "record.bytes")
+// Robust-IO substrate (dmlctpu/retry.h, doc/robustness.md): retries taken,
+// operations abandoned after the policy was exhausted, wall time slept in
+// backoff (stall_attribution surfaces it as the "io" pseudo-stage), records
+// skipped by RecordIO recover mode, part re-parses in the sharded pool, and
+// injections fired by the fault registry (fault.h).
+DMLCTPU_STAGE_COUNTER(IoRetry, "io.retry")
+DMLCTPU_STAGE_COUNTER(IoGiveup, "io.giveup")
+DMLCTPU_STAGE_COUNTER(IoRetryWaitUs, "io.retry_wait_us")
+DMLCTPU_STAGE_COUNTER(RecordCorruptSkipped, "record.corrupt_skipped")
+DMLCTPU_STAGE_COUNTER(ShardPartRetries, "shard.part_retries")
+DMLCTPU_STAGE_COUNTER(FaultInjected, "fault.injected")
 
 #undef DMLCTPU_STAGE_COUNTER
 #undef DMLCTPU_STAGE_GAUGE

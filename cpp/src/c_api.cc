@@ -11,6 +11,7 @@
 #include "./data/sharded_parser.h"
 #include "./data/staged_batcher.h"
 #include "dmlctpu/data.h"
+#include "dmlctpu/fault.h"
 #include "dmlctpu/input_split.h"
 #include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
@@ -242,6 +243,47 @@ int DmlcTpuWatchdogLastRecordJson(const char** out) {
   return Guard([&] {
     telemetry_json = dmlctpu::telemetry::LastFlightRecordJson();
     *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+/* ---- deterministic fault injection --------------------------------------- */
+
+int DmlcTpuFaultCompiledIn(int* out) {
+  return Guard([&] {
+    *out = dmlctpu::fault::Enabled() ? 1 : 0;
+    return 0;
+  });
+}
+
+int DmlcTpuFaultArm(const char* spec) {
+  return Guard([&] {
+    std::string err;
+    if (!dmlctpu::fault::ArmSpec(spec == nullptr ? "" : spec, &err)) {
+      throw dmlctpu::Error(err);
+    }
+    return 0;
+  });
+}
+
+int DmlcTpuFaultDisarm(void) {
+  return Guard([&] {
+    dmlctpu::fault::DisarmAll();
+    return 0;
+  });
+}
+
+int DmlcTpuFaultSnapshotJson(const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::fault::SnapshotJson();
+    *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuFaultInjectedTotal(int64_t* out) {
+  return Guard([&] {
+    *out = static_cast<int64_t>(dmlctpu::fault::InjectedTotal());
     return 0;
   });
 }
@@ -562,13 +604,24 @@ void DmlcTpuRecordIOWriterFree(DmlcTpuRecordIOWriterHandle handle) {
 }
 
 int DmlcTpuRecordIOReaderCreate(const char* uri, DmlcTpuRecordIOReaderHandle* out) {
+  return DmlcTpuRecordIOReaderCreateEx(uri, 0, out);
+}
+
+int DmlcTpuRecordIOReaderCreateEx(const char* uri, int recover,
+                                  DmlcTpuRecordIOReaderHandle* out) {
   return Guard([&] {
     auto ctx = std::make_unique<ReaderCtx>();
     ctx->stream = dmlctpu::Stream::Create(uri, "r");
-    ctx->reader = std::make_unique<dmlctpu::RecordIOReader>(ctx->stream.get());
+    ctx->reader = std::make_unique<dmlctpu::RecordIOReader>(ctx->stream.get(),
+                                                            recover != 0);
     *out = ctx.release();
     return 0;
   });
+}
+
+int64_t DmlcTpuRecordIOReaderCorruptSkipped(DmlcTpuRecordIOReaderHandle handle) {
+  return static_cast<int64_t>(
+      static_cast<ReaderCtx*>(handle)->reader->corrupt_skipped());
 }
 
 int DmlcTpuRecordIOReaderNext(DmlcTpuRecordIOReaderHandle handle, const void** data,
@@ -706,11 +759,19 @@ void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle) {
 int DmlcTpuRecordBatcherCreate(const char* uri, unsigned part, unsigned num_parts,
                                uint64_t records_cap, uint64_t bytes_cap,
                                DmlcTpuRecordBatcherHandle* out) {
+  return DmlcTpuRecordBatcherCreateEx(uri, part, num_parts, records_cap,
+                                      bytes_cap, 0, out);
+}
+
+int DmlcTpuRecordBatcherCreateEx(const char* uri, unsigned part,
+                                 unsigned num_parts, uint64_t records_cap,
+                                 uint64_t bytes_cap, int recover,
+                                 DmlcTpuRecordBatcherHandle* out) {
   return Guard([&] {
     auto ctx = std::make_unique<RecordBatcherCtx>();
     auto split = dmlctpu::InputSplit::Create(uri, part, num_parts, "recordio");
     ctx->batcher = std::make_unique<dmlctpu::data::RecordBatcher>(
-        std::move(split), records_cap, bytes_cap);
+        std::move(split), records_cap, bytes_cap, recover != 0);
     // report the same clamped caps RecordBatcher sizes its buffers with —
     // records_cap=0 would otherwise make consumers mis-shape the offsets view
     ctx->records_cap = std::max<uint64_t>(records_cap, 1);

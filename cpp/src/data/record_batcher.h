@@ -39,11 +39,14 @@ struct RecordBatch {
 
 class RecordBatcher {
  public:
+  /*! \brief recover=true skips corrupt record spans (counting them in
+   *  record.corrupt_skipped) instead of aborting — doc/robustness.md */
   RecordBatcher(std::unique_ptr<InputSplit> split, size_t records_cap,
-                size_t bytes_cap)
+                size_t bytes_cap, bool recover = false)
       : split_(std::move(split)),
         records_cap_(std::max<size_t>(records_cap, 1)),
         bytes_cap_(std::max<size_t>(bytes_cap, 1)),
+        recover_(recover),
         iter_(4) {
     TCHECK_LT(bytes_cap_, (1ull << 31))
         << "bytes_cap must fit int32 offsets for device staging";
@@ -96,8 +99,10 @@ class RecordBatcher {
         // the per-instance BytesRead and telemetry "record.bytes" can never
         // drift (the unified tally RecordStagingIter.bytes_read reads)
         telemetry::stage::RecordBytes().Add(chunk_.size);
-        reader_ = std::make_unique<RecordIOChunkReader>(RecordIOChunkReader::Blob{
-            static_cast<char*>(chunk_.dptr), chunk_.size});
+        reader_ = std::make_unique<RecordIOChunkReader>(
+            RecordIOChunkReader::Blob{static_cast<char*>(chunk_.dptr),
+                                      chunk_.size},
+            0u, 1u, recover_);
         continue;
       }
       if (used + rec.size > bytes_cap_) {
@@ -125,6 +130,7 @@ class RecordBatcher {
   std::unique_ptr<InputSplit> split_;
   size_t records_cap_;
   size_t bytes_cap_;
+  bool recover_ = false;
   InputSplit::Blob chunk_{};
   std::unique_ptr<RecordIOChunkReader> reader_;
   std::string pending_;

@@ -25,12 +25,24 @@
 // blocks when the buffer is full UNLESS it owns the part the consumer is
 // draining (that part must always make progress — this is what keeps the
 // pool scaling instead of serializing behind the in-order drain).
+//
+// Graceful degradation (doc/robustness.md): a part whose parse fails is
+// rolled back (its unconsumed queued chunks discarded) and re-parsed from
+// the top up to DMLCTPU_SHARD_RETRIES extra attempts (default 2).  Chunk
+// boundaries are a pure function of the part's bytes (ReadChunk fills its
+// buffer fully), so the re-parse reproduces the identical chunk sequence;
+// chunks the consumer already popped are replayed silently and publishing
+// resumes at the first unconsumed chunk — the emitted row stream stays
+// bit-identical to a fault-free epoch no matter where the failure landed.
+// Each round trip counts shard.part_retries; fault point
+// "shard.worker.chunk" injects a transient chunk-parse failure here.
 #ifndef DMLCTPU_SRC_DATA_SHARDED_PARSER_H_
 #define DMLCTPU_SRC_DATA_SHARDED_PARSER_H_
 
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
+#include <cstdlib>
 #include <deque>
 #include <exception>
 #include <map>
@@ -44,8 +56,10 @@
 #include "./parser_impl.h"
 #include "../io/line_split.h"
 #include "dmlctpu/data.h"
+#include "dmlctpu/fault.h"
 #include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
+#include "dmlctpu/retry.h"
 #include "dmlctpu/row_block.h"
 #include "dmlctpu/telemetry.h"
 
@@ -136,7 +150,19 @@ class ShardedParser : public Parser<IndexType, DType> {
   struct PartQueue {
     std::deque<std::pair<Blocks, size_t>> q;  // (blocks, byte cost)
     bool done = false;
+    size_t popped = 0;  // chunks the consumer took (a re-parse skips these)
   };
+
+  /*! \brief failed-part re-parse budget: DMLCTPU_SHARD_RETRIES extra
+   *  attempts on top of the first (default 2; 0 disables) */
+  static int ShardMaxAttempts() {
+    static int attempts = [] {
+      const char* v = std::getenv("DMLCTPU_SHARD_RETRIES");
+      int retries = (v != nullptr && v[0] != '\0') ? std::atoi(v) : 2;
+      return std::max(retries, 0) + 1;
+    }();
+    return attempts;
+  }
 
   static unsigned PickVirtualParts(const std::string& path,
                                    unsigned num_parts) {
@@ -192,7 +218,7 @@ class ShardedParser : public Parser<IndexType, DType> {
           parts_[j];  // publish the (empty) queue so the consumer can see it
         }
         cv_consume_.notify_all();  // consumer may be waiting on parts_[j]
-        ParseOnePart(j);
+        ParsePartWithRetry(j);
         {
           std::lock_guard<std::mutex> lk(mu_);
           parts_[j].done = true;
@@ -209,7 +235,59 @@ class ShardedParser : public Parser<IndexType, DType> {
     }
   }
 
-  void ParseOnePart(unsigned j) {
+  /*! \brief parse part j, re-parsing from the top on failure; chunks the
+   *  consumer already popped replay silently (identical bytes re-parse to
+   *  identical blocks) and publishing resumes at the first unconsumed
+   *  chunk.  Exhaustion rethrows and the pool relays the error. */
+  void ParsePartWithRetry(unsigned j) {
+    const int max_attempts = ShardMaxAttempts();
+    retry::Backoff backoff(retry::IoPolicy());
+    size_t skip = 0;
+    for (int attempt = 1;; ++attempt) {
+      try {
+        ParseOnePart(j, skip);
+        return;
+      } catch (const Error& e) {
+        bool can_retry;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = parts_.find(j);
+          can_retry = !stop_ && !error_ && attempt < max_attempts &&
+                      it != parts_.end();
+          if (can_retry) {
+            skip = it->second.popped;
+            RollbackPartLocked(&it->second);
+          }
+        }
+        if (!can_retry) throw;
+        // discarded chunks free buffer budget other producers may be
+        // blocked on
+        cv_produce_.notify_all();
+        telemetry::stage::ShardPartRetries().Add(1);
+        TLOG(Warning) << "shard: re-parsing part " << j << " (attempt "
+                      << attempt << "/" << max_attempts << "): " << e.what();
+        backoff.SleepNext();
+      }
+    }
+  }
+
+  /*! \brief discard part j's unconsumed queued chunks (caller holds mu_);
+   *  buffered-byte accounting is unwound, bytes_read_ is NOT — those bytes
+   *  really were read and the re-parse reads them again */
+  void RollbackPartLocked(PartQueue* pq) {
+    for (auto& [blocks, cost] : pq->q) {
+      buffered_bytes_ -= cost;
+      if (free_pool_.size() < static_cast<size_t>(2 * num_workers_)) {
+        for (auto& b : blocks) b.Clear();
+        free_pool_.push_back(std::move(blocks));
+      }
+    }
+    pq->q.clear();
+    telemetry::stage::ShardBufferedBytes().Set(
+        static_cast<int64_t>(buffered_bytes_));
+  }
+
+  void ParseOnePart(unsigned j, size_t skip_chunks = 0) {
     telemetry::ScopedSpan span("shard.part");
     telemetry::ScopedAccum part_timer(telemetry::stage::ShardPartUs());
     telemetry::stage::ShardParts().Add(1);
@@ -222,6 +300,7 @@ class ShardedParser : public Parser<IndexType, DType> {
         num_parts_ * virtual_parts_, format_.c_str());
     auto* impl = dynamic_cast<ParserImpl<IndexType, DType>*>(parser.get());
     size_t last_bytes = 0;
+    size_t chunk_idx = 0;
     for (;;) {
       Blocks blocks;
       if (impl != nullptr) {
@@ -244,6 +323,28 @@ class ShardedParser : public Parser<IndexType, DType> {
       size_t nb = parser->BytesRead();
       size_t delta = nb - last_bytes;
       last_bytes = nb;
+      if (chunk_idx++ < skip_chunks) {
+        // re-parse replaying chunks the consumer already took from a prior
+        // attempt: identical bytes re-parsed to identical blocks, so drop
+        // them (the bytes were really read again and stay counted)
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stop_ || error_) return;
+        telemetry::stage::ShardBytes().Add(delta);
+        bytes_read_.fetch_add(delta, std::memory_order_relaxed);
+        if (free_pool_.size() < static_cast<size_t>(2 * num_workers_)) {
+          for (auto& b : blocks) b.Clear();
+          free_pool_.push_back(std::move(blocks));
+        }
+        continue;
+      }
+      DMLCTPU_FAULT_POINT(fp_chunk, "shard.worker.chunk");
+      if (fp_chunk.Fire() != fault::Mode::kNone) {
+        // before publish: this attempt's already-published chunks roll back
+        // in ParsePartWithRetry, so the re-parse re-emits the same stream
+        throw retry::TransientError(
+            "shard worker: injected chunk-parse failure in part " +
+            std::to_string(j));
+      }
       size_t cost = 0;
       for (const auto& b : blocks) cost += b.MemCostBytes();
       {
@@ -339,6 +440,7 @@ class ShardedParser : public Parser<IndexType, DType> {
 
   void TakeFront(PartQueue* pq) {
     RecycleCurBlocks();
+    ++pq->popped;  // a re-parse must replay (not republish) this chunk
     cur_blocks_ = std::move(pq->q.front().first);
     buffered_bytes_ -= pq->q.front().second;
     telemetry::stage::ShardBufferedBytes().Set(

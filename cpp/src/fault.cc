@@ -1,0 +1,296 @@
+// fault.cc — registry + spec parsing behind dmlctpu/fault.h.
+#include <dmlctpu/fault.h>
+
+#if DMLCTPU_FAULTS
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include <dmlctpu/logging.h>
+#include <dmlctpu/telemetry.h>
+
+namespace dmlctpu {
+namespace fault {
+namespace {
+
+/*! \brief splitmix64: the decision hash.  Statistically uniform, and a pure
+ *  function of its input so (seed, point, hit) always replays. */
+uint64_t Mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+uint64_t HashName(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (char c : s) {
+    h ^= static_cast<unsigned char>(c);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+struct PointSpec {
+  Mode mode = Mode::kNone;
+  double rate = 0.0;
+  int64_t count = -1;
+  uint64_t after = 0;
+};
+
+}  // namespace
+
+class RegistryImpl {
+ public:
+  static RegistryImpl* Get() {
+    static RegistryImpl* r = new RegistryImpl();  // leaked: process-lifetime
+    return r;
+  }
+
+  Point& point(const std::string& name) {
+    ApplyEnvOnce();
+    std::lock_guard<std::mutex> lk(mu_);
+    return PointLocked(name);
+  }
+
+  bool Arm(const std::string& spec, std::string* err) {
+    ApplyEnvOnce();
+    return ArmInternal(spec, err);
+  }
+
+  void Disarm() {
+    std::lock_guard<std::mutex> lk(mu_);
+    DisarmLocked();
+  }
+
+  std::string Snapshot() {
+    ApplyEnvOnce();
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out = "{\"enabled\":true,\"armed\":";
+    out += ArmedFlag().load(std::memory_order_relaxed) ? "true" : "false";
+    out += ",\"seed\":" + std::to_string(seed_) + ",\"points\":[";
+    bool first = true;
+    for (const auto& [name, p] : points_) {
+      if (!first) out += ',';
+      first = false;
+      out += "{\"name\":\"" + name + "\",\"mode\":\"" + ModeName(p->mode_) +
+             "\",\"armed\":" + (p->armed_.load(std::memory_order_relaxed) ? "true" : "false") +
+             ",\"hits\":" + std::to_string(p->hits()) +
+             ",\"injected\":" + std::to_string(p->injected()) + "}";
+    }
+    out += "]}";
+    return out;
+  }
+
+  uint64_t InjectedTotal() {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t total = 0;
+    for (const auto& [name, p] : points_) total += p->injected();
+    return total;
+  }
+
+ private:
+  static const char* ModeName(Mode m) {
+    switch (m) {
+      case Mode::kErr: return "err";
+      case Mode::kEof: return "eof";
+      case Mode::kHttp503: return "503";
+      case Mode::kCorrupt: return "corrupt";
+      default: return "none";
+    }
+  }
+
+  /*! \brief lazily apply the DMLCTPU_FAULTS env spec exactly once, before
+   *  the first lookup/arm/snapshot anywhere in the process. */
+  void ApplyEnvOnce() {
+    std::call_once(env_once_, [this] {
+      const char* spec = std::getenv("DMLCTPU_FAULTS");
+      if (spec == nullptr || spec[0] == '\0') return;
+      std::string err;
+      if (!ArmInternal(spec, &err)) {
+        TLOG(Fatal) << "DMLCTPU_FAULTS: " << err;
+      }
+    });
+  }
+
+  Point& PointLocked(const std::string& name) {
+    auto it = points_.find(name);
+    if (it == points_.end()) {
+      it = points_.emplace(name, new Point(name)).first;  // leaked, like telemetry
+      ConfigureLocked(it->second);
+    }
+    return *it->second;
+  }
+
+  /*! \brief (re)apply the current armed spec to one point; caller holds mu_ */
+  void ConfigureLocked(Point* p) {
+    auto it = specs_.find(p->name_);
+    if (it == specs_.end()) {
+      p->armed_.store(false, std::memory_order_relaxed);
+      return;
+    }
+    const PointSpec& s = it->second;
+    p->mode_ = s.mode;
+    // scale rate to a u64 threshold; rate >= 1 always fires
+    p->threshold_ = s.rate >= 1.0
+                        ? ~0ull
+                        : static_cast<uint64_t>(s.rate * 18446744073709551615.0);
+    p->after_ = s.after;
+    p->seed_ = Mix64(seed_ ^ HashName(p->name_));
+    p->budget_.store(s.count, std::memory_order_relaxed);
+    p->hits_.store(0, std::memory_order_relaxed);
+    p->injected_.store(0, std::memory_order_relaxed);
+    p->armed_.store(true, std::memory_order_relaxed);
+  }
+
+  void DisarmLocked() {
+    specs_.clear();
+    for (auto& [name, p] : points_) {
+      p->armed_.store(false, std::memory_order_relaxed);
+      p->hits_.store(0, std::memory_order_relaxed);
+      p->injected_.store(0, std::memory_order_relaxed);
+    }
+    ArmedFlag().store(false, std::memory_order_relaxed);
+  }
+
+  bool ArmInternal(const std::string& spec, std::string* err) {
+    std::map<std::string, PointSpec> parsed;
+    uint64_t seed = 0;
+    std::vector<std::string> entries;
+    std::string cur;
+    for (char c : spec) {
+      if (c == ';') {
+        entries.push_back(cur);
+        cur.clear();
+      } else {
+        cur.push_back(c);
+      }
+    }
+    entries.push_back(cur);
+    for (std::string& e : entries) {
+      // trim spaces
+      while (!e.empty() && (e.front() == ' ' || e.front() == '\t')) e.erase(e.begin());
+      while (!e.empty() && (e.back() == ' ' || e.back() == '\t')) e.pop_back();
+      if (e.empty()) continue;
+      size_t eq = e.find('=');
+      if (eq == std::string::npos) {
+        return Fail(err, "entry '" + e + "' has no '='");
+      }
+      std::string key = e.substr(0, eq);
+      std::string val = e.substr(eq + 1);
+      if (key == "seed") {
+        seed = std::strtoull(val.c_str(), nullptr, 10);
+        continue;
+      }
+      // <mode>@<rate>[:n=<count>][:after=<skip>]
+      PointSpec ps;
+      size_t at = val.find('@');
+      if (at == std::string::npos) {
+        return Fail(err, "point '" + key + "': expected <mode>@<rate>, got '" + val + "'");
+      }
+      std::string mode = val.substr(0, at);
+      std::string rest = val.substr(at + 1);
+      if (mode == "err") {
+        ps.mode = Mode::kErr;
+      } else if (mode == "eof") {
+        ps.mode = Mode::kEof;
+      } else if (mode == "503" || mode == "5xx") {
+        ps.mode = Mode::kHttp503;
+      } else if (mode == "corrupt") {
+        ps.mode = Mode::kCorrupt;
+      } else {
+        return Fail(err, "point '" + key + "': unknown mode '" + mode + "'");
+      }
+      size_t colon = rest.find(':');
+      std::string rate_str = colon == std::string::npos ? rest : rest.substr(0, colon);
+      char* end = nullptr;
+      ps.rate = std::strtod(rate_str.c_str(), &end);
+      if (end == rate_str.c_str() || ps.rate < 0.0) {
+        return Fail(err, "point '" + key + "': bad rate '" + rate_str + "'");
+      }
+      while (colon != std::string::npos) {
+        size_t next = rest.find(':', colon + 1);
+        std::string opt = rest.substr(colon + 1, next == std::string::npos
+                                                     ? std::string::npos
+                                                     : next - colon - 1);
+        if (opt.rfind("n=", 0) == 0) {
+          ps.count = std::strtoll(opt.c_str() + 2, nullptr, 10);
+        } else if (opt.rfind("after=", 0) == 0) {
+          ps.after = std::strtoull(opt.c_str() + 6, nullptr, 10);
+        } else {
+          return Fail(err, "point '" + key + "': unknown option '" + opt + "'");
+        }
+        colon = next;
+      }
+      parsed[key] = ps;
+    }
+
+    std::lock_guard<std::mutex> lk(mu_);
+    DisarmLocked();
+    if (parsed.empty()) return true;  // "" / seed-only spec = disarm
+    seed_ = seed;
+    specs_ = std::move(parsed);
+    // materialize + configure every armed point now so Fire() never touches
+    // the spec map (points created later pick their spec up in PointLocked)
+    for (const auto& [name, ps] : specs_) ConfigureLocked(&PointLocked(name));
+    ArmedFlag().store(true, std::memory_order_relaxed);
+    return true;
+  }
+
+  static bool Fail(std::string* err, const std::string& what) {
+    if (err != nullptr) *err = "bad fault spec: " + what;
+    return false;
+  }
+
+  std::mutex mu_;
+  std::once_flag env_once_;
+  std::map<std::string, Point*> points_;
+  std::map<std::string, PointSpec> specs_;
+  uint64_t seed_ = 0;
+};
+
+std::atomic<bool>& ArmedFlag() {
+  static std::atomic<bool> armed{false};
+  return armed;
+}
+
+Mode Point::FireSlow() {
+  if (!armed_.load(std::memory_order_relaxed)) return Mode::kNone;
+  const uint64_t hit = hits_.fetch_add(1, std::memory_order_relaxed);
+  if (hit < after_) return Mode::kNone;
+  if (Mix64(seed_ ^ hit) >= threshold_) return Mode::kNone;
+  // budget: -1 is unlimited; otherwise claim one injection slot atomically
+  int64_t budget = budget_.load(std::memory_order_relaxed);
+  while (budget >= 0) {
+    if (budget == 0) return Mode::kNone;
+    if (budget_.compare_exchange_weak(budget, budget - 1,
+                                      std::memory_order_relaxed)) {
+      break;
+    }
+  }
+  injected_.fetch_add(1, std::memory_order_relaxed);
+  telemetry::Registry::Get()->counter("fault.injected").Add(1);
+  TLOG(Debug) << "fault injected: " << name_ << " (hit " << hit << ")";
+  return mode_;
+}
+
+Point& GetPoint(const std::string& name) {
+  return RegistryImpl::Get()->point(name);
+}
+
+bool ArmSpec(const std::string& spec, std::string* err) {
+  return RegistryImpl::Get()->Arm(spec, err);
+}
+
+void DisarmAll() { RegistryImpl::Get()->Disarm(); }
+
+std::string SnapshotJson() { return RegistryImpl::Get()->Snapshot(); }
+
+uint64_t InjectedTotal() { return RegistryImpl::Get()->InjectedTotal(); }
+
+}  // namespace fault
+}  // namespace dmlctpu
+
+#endif  // DMLCTPU_FAULTS

@@ -14,6 +14,7 @@
 #include "./xml_scan.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
+#include "dmlctpu/retry.h"
 
 namespace dmlctpu {
 namespace io {
@@ -207,9 +208,9 @@ void AzureFileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out)
     if (!marker.empty()) query["marker"] = marker;
     auto signed_req =
         signer_.Sign("GET", ep.path_prefix + resource, query, {}, 0, NowRfc1123());
-    http::Response resp = http::Request(ep.host, ep.port, "GET",
-                                        WirePath(ep, resource) + BuildQuery(query),
-                                        signed_req.headers, "", ep.tls);
+    http::Response resp = http::RequestWithRetry(
+        ep.host, ep.port, "GET", WirePath(ep, resource) + BuildQuery(query),
+        signed_req.headers, "", ep.tls);
     TCHECK_EQ(resp.status, 200) << "azure List Blobs failed (" << resp.status
                                 << "): " << resp.body.substr(0, 256);
     std::vector<std::string> prefixes;
@@ -231,9 +232,9 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
   std::string resource = "/" + path.host + path.name;
   auto signed_req =
       signer_.Sign("HEAD", ep.path_prefix + resource, {}, {}, 0, NowRfc1123());
-  http::Response resp = http::Request(ep.host, ep.port, "HEAD",
-                                      WirePath(ep, resource), signed_req.headers,
-                                      "", ep.tls);
+  http::Response resp = http::RequestWithRetry(ep.host, ep.port, "HEAD",
+                                               WirePath(ep, resource),
+                                               signed_req.headers, "", ep.tls);
   FileInfo info;
   info.path = path;
   if (resp.status == 404) {
@@ -247,9 +248,9 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
                                              {"restype", "container"}};
     auto list_req = signer_.Sign("GET", ep.path_prefix + container_res, query,
                                  {}, 0, NowRfc1123());
-    http::Response list = http::Request(ep.host, ep.port, "GET",
-                                        WirePath(ep, container_res) + BuildQuery(query),
-                                        list_req.headers, "", ep.tls);
+    http::Response list = http::RequestWithRetry(
+        ep.host, ep.port, "GET", WirePath(ep, container_res) + BuildQuery(query),
+        list_req.headers, "", ep.tls);
     XMLScan scan(list.body);
     std::string any;
     TCHECK(list.status == 200 && scan.Next("Name", &any))
@@ -285,6 +286,10 @@ RangedReadStream::Opener AzureRangedOpener(AzureFileSystem::Endpoint ep,
                                    headers, 0, NowRfc1123());
     auto body = http::RequestStream(ep.host, ep.port, "GET", req_path,
                                     signed_req.headers, "", ep.tls);
+    // throttling/server errors are retryable by the ranged-read loop (the
+    // opener re-signs with a fresh x-ms-date on every attempt)
+    retry::ThrowIfTransientStatus(body->status(), body->headers(),
+                                  "azure GET " + req_path);
     // a server that ignores Range and replies 200 with the full body would
     // silently serve bytes from 0 — only 206 proves the offset was honored
     TCHECK(body->status() == 206 || (offset == 0 && body->status() == 200))

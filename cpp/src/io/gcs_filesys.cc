@@ -20,6 +20,7 @@
 #include "dmlctpu/json.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
+#include "dmlctpu/retry.h"
 
 namespace dmlctpu {
 namespace io {
@@ -116,7 +117,7 @@ std::string FetchMetadataToken(time_t* expiry) {
     host = addr.substr(0, colon);
     port = std::atoi(addr.c_str() + colon + 1);
   }
-  http::Response resp = http::Request(
+  http::Response resp = http::RequestWithRetry(
       host, port, "GET",
       "/computeMetadata/v1/instance/service-accounts/default/token",
       {{"Metadata-Flavor", "Google"}});
@@ -174,6 +175,9 @@ RangedReadStream::Opener GcsMediaOpener(GcsFileSystem::Endpoint ep,
     auto body = http::RequestStream(ep.host, ep.port, "GET",
                                     media_path + "?alt=media", headers, "",
                                     ep.tls);
+    // throttling/server errors are retryable by the ranged-read loop
+    retry::ThrowIfTransientStatus(body->status(), body->headers(),
+                                  "GCS media GET " + media_path);
     // only 206 proves a nonzero offset was honored (a 200 would silently
     // serve the object from byte 0)
     TCHECK(body->status() == 206 || (offset == 0 && body->status() == 200))
@@ -365,8 +369,8 @@ FileInfo GcsFileSystem::GetPathInfo(const URI& path) {
     info.type = FileType::kDirectory;
     return info;
   }
-  http::Response resp = http::Request(ep.host, ep.port, "GET", ObjectPath(path),
-                                      AuthHeaders(), "", ep.tls);
+  http::Response resp = http::RequestWithRetry(
+      ep.host, ep.port, "GET", ObjectPath(path), AuthHeaders(), "", ep.tls);
   if (resp.status == 200) {
     GcsObject obj = ParseObjectMetadata(resp.body);
     FileInfo info;
@@ -383,8 +387,8 @@ FileInfo GcsFileSystem::GetPathInfo(const URI& path) {
   if (prefix.back() != '/') prefix += '/';
   std::string list_path = "/storage/v1/b/" + path.host + "/o?maxResults=1&prefix=" +
                           http::PercentEncodeQuery(prefix);
-  resp = http::Request(ep.host, ep.port, "GET", list_path, AuthHeaders(), "",
-                       ep.tls);
+  resp = http::RequestWithRetry(ep.host, ep.port, "GET", list_path,
+                                AuthHeaders(), "", ep.tls);
   TCHECK_EQ(resp.status, 200) << "GCS list failed (" << resp.status << "): "
                               << resp.body.substr(0, 200);
   GcsListPage page = ParseListPage(resp.body);
@@ -408,8 +412,8 @@ void GcsFileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
     if (!page_token.empty()) {
       list_path += "&pageToken=" + http::PercentEncodeQuery(page_token);
     }
-    http::Response resp = http::Request(ep.host, ep.port, "GET", list_path,
-                                        AuthHeaders(), "", ep.tls);
+    http::Response resp = http::RequestWithRetry(
+        ep.host, ep.port, "GET", list_path, AuthHeaders(), "", ep.tls);
     TCHECK_EQ(resp.status, 200) << "GCS list " << path.str() << " failed ("
                                 << resp.status << "): "
                                 << resp.body.substr(0, 200);

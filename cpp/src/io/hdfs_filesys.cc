@@ -15,6 +15,7 @@
 #include "dmlctpu/json.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
+#include "dmlctpu/retry.h"
 
 namespace dmlctpu {
 namespace io {
@@ -125,10 +126,12 @@ std::string OpPath(const HdfsFileSystem::Endpoint& ep, const std::string& path,
   return full;
 }
 
-/*! \brief namenode request; follows one noredirect/307 hop when asked */
+/*! \brief namenode request; follows one noredirect/307 hop when asked.
+ *  Retried under the shared IO policy: every namenode op here either reads
+ *  metadata or just resolves a datanode Location, so a repeat is safe. */
 http::Response NamenodeRequest(const HdfsFileSystem::Endpoint& ep,
                                const std::string& method, const std::string& path) {
-  return http::Request(ep.host, ep.port, method, path, {}, "", ep.tls);
+  return http::RequestWithRetry(ep.host, ep.port, method, path, {}, "", ep.tls);
 }
 
 /*! \brief Opener for the shared RangedReadStream: two-step OPEN — the
@@ -141,6 +144,10 @@ RangedReadStream::Opener WebHdfsOpener(HdfsFileSystem::Endpoint ep,
                                  "offset=" + std::to_string(offset) +
                                  "&noredirect=true");
     http::Response hop = NamenodeRequest(ep, "GET", nn_path);
+    // a still-throttled namenode after RequestWithRetry's own budget is
+    // transient for the outer ranged-read loop too
+    retry::ThrowIfTransientStatus(hop.status, hop.headers,
+                                  "WebHDFS OPEN " + path);
     std::string location;
     if (hop.status == 200) {
       location = ParseLocation(hop.body);
@@ -153,6 +160,8 @@ RangedReadStream::Opener WebHdfsOpener(HdfsFileSystem::Endpoint ep,
     ParsedUrl dn = ParseUrl(location);
     auto body = http::RequestStream(dn.host, dn.port, "GET", dn.path_and_query,
                                     {}, "", dn.tls);
+    retry::ThrowIfTransientStatus(body->status(), body->headers(),
+                                  "WebHDFS datanode GET " + path);
     TCHECK(body->status() == 200 || body->status() == 206)
         << "WebHDFS datanode GET failed (" << body->status() << ")";
     return body;

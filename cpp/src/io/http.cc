@@ -1,6 +1,9 @@
 #include "./http.h"
 
+#include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -11,32 +14,60 @@
 #include <sstream>
 
 #include "./tls.h"
+#include "dmlctpu/fault.h"
 #include "dmlctpu/logging.h"
+#include "dmlctpu/retry.h"
 
 namespace dmlctpu {
 namespace http {
 namespace {
 
+/*! \brief connect + read/write timeout, seconds (DMLCTPU_HTTP_TIMEOUT_S,
+ *  default 60; <=0 disables).  A black-holed endpoint used to hang the
+ *  pipeline until the watchdog aborted; now it surfaces as a retryable
+ *  TransientError inside the watchdog's deadline. */
+int TimeoutSeconds() {
+  static int s = [] {
+    const char* v = std::getenv("DMLCTPU_HTTP_TIMEOUT_S");
+    return (v != nullptr && v[0] != '\0') ? std::atoi(v) : 60;
+  }();
+  return s;
+}
+
 /*! \brief connected TCP socket; optionally upgraded to TLS (https).  The
- *  request/response machinery above is transport-agnostic. */
+ *  request/response machinery above is transport-agnostic.  Transport
+ *  failures (resolve, connect, reset, timeout) throw retry::TransientError:
+ *  they are the retryable class, unlike protocol/auth failures upstack. */
 class Socket {
  public:
   Socket(const std::string& host, int port, bool use_tls) {
+    DMLCTPU_FAULT_POINT(fp_connect, "io.http.connect");
+    if (fp_connect.Fire() != fault::Mode::kNone) {
+      throw retry::TransientError("http: injected connect fault for " + host +
+                                  ":" + std::to_string(port));
+    }
     addrinfo hints{};
     hints.ai_family = AF_UNSPEC;
     hints.ai_socktype = SOCK_STREAM;
     addrinfo* res = nullptr;
     int rc = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
-    TCHECK_EQ(rc, 0) << "http: cannot resolve " << host << ": " << gai_strerror(rc);
+    if (rc != 0) {
+      throw retry::TransientError("http: cannot resolve " + host + ": " +
+                                  gai_strerror(rc));
+    }
     for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
       fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
       if (fd_ < 0) continue;
-      if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      if (ConnectWithTimeout(ai)) break;
       ::close(fd_);
       fd_ = -1;
     }
     ::freeaddrinfo(res);
-    TCHECK_GE(fd_, 0) << "http: cannot connect to " << host << ":" << port;
+    if (fd_ < 0) {
+      throw retry::TransientError("http: cannot connect to " + host + ":" +
+                                  std::to_string(port));
+    }
+    SetIoTimeouts();
     if (use_tls) {
       try {
         tls_ = std::make_unique<tls::Connection>(fd_, host);
@@ -60,7 +91,12 @@ class Socket {
     }
     while (len != 0) {
       ssize_t n = ::send(fd_, data, len, MSG_NOSIGNAL);
-      TCHECK_GT(n, 0) << "http: send failed";
+      if (n <= 0) {
+        throw retry::TransientError(
+            (errno == EAGAIN || errno == EWOULDBLOCK)
+                ? "http: send timed out"
+                : std::string("http: send failed: ") + std::strerror(errno));
+      }
       data += n;
       len -= static_cast<size_t>(n);
     }
@@ -68,11 +104,50 @@ class Socket {
   size_t Recv(void* buf, size_t len) {
     if (tls_ != nullptr) return tls_->Read(buf, len);
     ssize_t n = ::recv(fd_, buf, len, 0);
-    TCHECK_GE(n, 0) << "http: recv failed";
+    if (n < 0) {
+      throw retry::TransientError(
+          (errno == EAGAIN || errno == EWOULDBLOCK)
+              ? "http: read timed out"
+              : std::string("http: recv failed: ") + std::strerror(errno));
+    }
     return static_cast<size_t>(n);
   }
 
  private:
+  /*! \brief poll-based connect with the configured timeout (a plain
+   *  ::connect blocks for the kernel's SYN retry schedule — minutes). */
+  bool ConnectWithTimeout(const addrinfo* ai) {
+    const int timeout_s = TimeoutSeconds();
+    if (timeout_s <= 0) return ::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0;
+    int flags = ::fcntl(fd_, F_GETFL, 0);
+    if (flags < 0 || ::fcntl(fd_, F_SETFL, flags | O_NONBLOCK) < 0) {
+      return ::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0;
+    }
+    int rc = ::connect(fd_, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno != EINPROGRESS) return false;
+    if (rc != 0) {
+      pollfd pfd{fd_, POLLOUT, 0};
+      if (::poll(&pfd, 1, timeout_s * 1000) <= 0) return false;  // timeout/err
+      int soerr = 0;
+      socklen_t slen = sizeof(soerr);
+      if (::getsockopt(fd_, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 ||
+          soerr != 0) {
+        return false;
+      }
+    }
+    return ::fcntl(fd_, F_SETFL, flags) == 0;  // back to blocking
+  }
+
+  /*! \brief SO_RCVTIMEO/SO_SNDTIMEO so an established-but-silent peer cannot
+   *  wedge a read forever (applies beneath TLS too: OpenSSL reads the fd). */
+  void SetIoTimeouts() {
+    const int timeout_s = TimeoutSeconds();
+    if (timeout_s <= 0) return;
+    timeval tv{timeout_s, 0};
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
   int fd_ = -1;
   std::unique_ptr<tls::Connection> tls_;
 };
@@ -142,7 +217,10 @@ class BodyStreamImpl : public BodyStream {
     char c;
     while (head.find("\r\n\r\n") == std::string::npos) {
       size_t n = sock_.Recv(&c, 1);
-      TCHECK_GT(n, 0u) << "http: connection closed in headers";
+      if (n == 0) {
+        // peer accepted then dropped us before a full status line: transient
+        throw retry::TransientError("http: connection closed in headers");
+      }
       head.push_back(c);
       TCHECK_LT(head.size(), 1u << 20u) << "http: oversized header block";
     }
@@ -237,6 +315,40 @@ Response Request(const std::string& host, int port, const std::string& method,
   size_t n;
   while ((n = stream->Read(buf, sizeof(buf))) != 0) resp.body.append(buf, n);
   return resp;
+}
+
+Response RequestWithRetry(const std::string& host, int port,
+                          const std::string& method, const std::string& path,
+                          const std::map<std::string, std::string>& headers,
+                          std::string_view body, bool use_tls) {
+  const retry::RetryPolicy& policy = retry::IoPolicy();
+  retry::Backoff backoff(policy);
+  for (int attempt = 1;; ++attempt) {
+    bool last = attempt >= policy.max_attempts || backoff.DeadlineExpired();
+    try {
+      Response r = Request(host, port, method, path, headers, body, use_tls);
+      if (!retry::RetryableHttpStatus(r.status) || last) {
+        // the final retryable status is RETURNED, not thrown: callers keep
+        // their own status validation (and its error messages) unchanged
+        return r;
+      }
+      telemetry::stage::IoRetry().Add(1);
+      TLOG(Warning) << "http: " << method << " " << host << ":" << port
+                    << " -> " << r.status << " (attempt " << attempt << "/"
+                    << policy.max_attempts << "), retrying";
+      backoff.SleepNext(retry::RetryAfterMs(r.headers));
+    } catch (const retry::TransientError& e) {
+      if (last) {
+        telemetry::stage::IoGiveup().Add(1);
+        throw;
+      }
+      telemetry::stage::IoRetry().Add(1);
+      TLOG(Warning) << "http: " << method << " " << host << ":" << port
+                    << " failed (attempt " << attempt << "/"
+                    << policy.max_attempts << "): " << e.what();
+      backoff.SleepNext(e.retry_after_ms);
+    }
+  }
 }
 
 namespace {

@@ -37,6 +37,18 @@ Response Request(const std::string& host, int port, const std::string& method,
                  const std::map<std::string, std::string>& headers,
                  std::string_view body = {}, bool use_tls = false);
 
+/*! \brief as Request, but transparently retries transport failures and
+ *  429/5xx statuses under the shared retry::IoPolicy (honoring Retry-After).
+ *  The LAST retryable status is returned — not thrown — so caller-side
+ *  status validation behaves exactly as with Request; only transport errors
+ *  that outlive the policy propagate (as retry::TransientError).  Use for
+ *  idempotent requests (GET/HEAD metadata, ranged reads). */
+Response RequestWithRetry(const std::string& host, int port,
+                          const std::string& method,
+                          const std::string& path_and_query,
+                          const std::map<std::string, std::string>& headers,
+                          std::string_view body = {}, bool use_tls = false);
+
 /*! \brief as Request but hands back a stream over the response body */
 std::unique_ptr<BodyStream> RequestStream(
     const std::string& host, int port, const std::string& method,

@@ -1,11 +1,19 @@
 // ranged_stream.h — the one seekable ranged-read stream all HTTP-speaking
 // remote backends share (S3, plain HTTP(S), GCS, WebHDFS).  Semantics:
 // reopen at the cursor on Seek, and resume at the cursor when a connection
-// drops mid-body (one reopened attempt per Read call).  Each backend
-// supplies an Opener that issues its signed/authorized request for
-// "everything from byte `offset`" and validates the response status
-// (a nonzero offset must be proven honored — 206/equivalent — before the
-// body is trusted).
+// drops mid-body.  Each backend supplies an Opener that issues its
+// signed/authorized request for "everything from byte `offset`" and
+// validates the response status (a nonzero offset must be proven honored —
+// 206/equivalent — before the body is trusted).
+//
+// Resilience (doc/robustness.md): every Read runs a bounded retry loop under
+// the shared retry::IoPolicy — a dropped body, a reset connection, or a
+// transient opener failure (connect, 429/5xx) reopens at the cursor with
+// decorrelated-jitter backoff instead of the old single blind reopen.
+// Because the reopen resumes at pos_, a retried read returns byte-identical
+// data; exhausting the policy counts io.giveup and rethrows.  Fault points:
+// "io.ranged.read" (simulated mid-body connection drop) and "io.opener.5xx"
+// (simulated throttling response from the opener).
 #ifndef DMLCTPU_SRC_IO_RANGED_STREAM_H_
 #define DMLCTPU_SRC_IO_RANGED_STREAM_H_
 
@@ -15,7 +23,9 @@
 #include <utility>
 
 #include "./http.h"
+#include "dmlctpu/fault.h"
 #include "dmlctpu/logging.h"
+#include "dmlctpu/retry.h"
 #include "dmlctpu/stream.h"
 
 namespace dmlctpu {
@@ -32,15 +42,24 @@ class RangedReadStream : public SeekStream {
 
   size_t Read(void* ptr, size_t size) override {
     if (pos_ >= size_) return 0;
-    if (body_ == nullptr) body_ = opener_(pos_);
-    size_t n = body_->Read(ptr, size);
-    if (n == 0 && pos_ < size_) {
-      // connection dropped mid-range: reopen at the current position
-      body_ = opener_(pos_);
-      n = body_->Read(ptr, size);
+    const retry::RetryPolicy& policy = retry::IoPolicy();
+    retry::Backoff backoff(policy);
+    for (int attempt = 1;; ++attempt) {
+      try {
+        return ReadOnce(ptr, size);
+      } catch (const retry::TransientError& e) {
+        body_.reset();  // reopen at pos_ on the next attempt
+        if (attempt >= policy.max_attempts || backoff.DeadlineExpired()) {
+          telemetry::stage::IoGiveup().Add(1);
+          throw;
+        }
+        telemetry::stage::IoRetry().Add(1);
+        TLOG(Warning) << what_ << ": retrying read at byte " << pos_
+                      << " (attempt " << attempt << "/" << policy.max_attempts
+                      << "): " << e.what();
+        backoff.SleepNext(e.retry_after_ms);
+      }
     }
-    pos_ += n;
-    return n;
   }
   size_t Write(const void*, size_t) override {
     TLOG(Fatal) << what_ << " read stream is read-only";
@@ -56,6 +75,38 @@ class RangedReadStream : public SeekStream {
   bool AtEnd() override { return pos_ >= size_; }
 
  private:
+  /*! \brief one attempt: open at the cursor if needed, read, advance.  A
+   *  zero-byte read before the known end means the connection dropped
+   *  mid-range — thrown as transient so the retry loop reopens. */
+  size_t ReadOnce(void* ptr, size_t size) {
+    if (body_ == nullptr) body_ = OpenAt(pos_);
+    DMLCTPU_FAULT_POINT(fp_read, "io.ranged.read");
+    if (fp_read.Fire() != fault::Mode::kNone) {
+      // simulate the peer dropping the connection mid-body: no data is
+      // consumed from the real stream, so the retried read stays
+      // byte-identical to a fault-free run
+      throw retry::TransientError(what_ + ": injected mid-body drop at byte " +
+                                  std::to_string(pos_));
+    }
+    size_t n = body_->Read(ptr, size);
+    if (n == 0 && pos_ < size_) {
+      throw retry::TransientError(
+          what_ + ": connection dropped mid-range at byte " +
+          std::to_string(pos_) + " of " + std::to_string(size_));
+    }
+    pos_ += n;
+    return n;
+  }
+
+  std::unique_ptr<http::BodyStream> OpenAt(size_t offset) {
+    DMLCTPU_FAULT_POINT(fp_open, "io.opener.5xx");
+    if (fp_open.Fire() != fault::Mode::kNone) {
+      throw retry::TransientError(what_ + ": injected HTTP 503 from opener",
+                                  503);
+    }
+    return opener_(offset);
+  }
+
   Opener opener_;
   size_t size_;
   std::string what_;

@@ -12,6 +12,7 @@
 #include "./xml_scan.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/parameter.h"
+#include "dmlctpu/retry.h"
 
 namespace dmlctpu {
 namespace io {
@@ -176,8 +177,8 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
   auto signed_req = signer_.Sign("GET", ep.host, req_path, query, {},
                                  kUnsignedPayload, NowAmzDate());
   std::string full = req_path + "?" + SigV4::CanonicalQuery(query);
-  http::Response resp = http::Request(ep.host, ep.port, "GET", full,
-                                      signed_req.headers, "", ep.tls);
+  http::Response resp = http::RequestWithRetry(ep.host, ep.port, "GET", full,
+                                               signed_req.headers, "", ep.tls);
   TCHECK_EQ(resp.status, 200) << "S3 ListObjects failed (" << resp.status << "): "
                               << resp.body.substr(0, 256);
   std::vector<std::string> prefixes;
@@ -200,8 +201,8 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
   auto signed_req = signer_.Sign("GET", ep.host, req_path, query, {},
                                  kUnsignedPayload, NowAmzDate());
   std::string full = req_path + "?" + SigV4::CanonicalQuery(query);
-  http::Response resp = http::Request(ep.host, ep.port, "GET", full,
-                                      signed_req.headers, "", ep.tls);
+  http::Response resp = http::RequestWithRetry(ep.host, ep.port, "GET", full,
+                                               signed_req.headers, "", ep.tls);
   TCHECK_EQ(resp.status, 200) << "S3 list failed (" << resp.status << ")";
   std::vector<FileInfo> files;
   std::vector<std::string> prefixes;
@@ -233,6 +234,9 @@ RangedReadStream::Opener S3RangedOpener(S3FileSystem::Endpoint ep,
                                    kUnsignedPayload, NowAmzDate());
     auto body = http::RequestStream(ep.host, ep.port, "GET", req_path,
                                     signed_req.headers, "", ep.tls);
+    // throttling/server errors are retryable by the ranged-read loop
+    retry::ThrowIfTransientStatus(body->status(), body->headers(),
+                                  "S3 GET " + req_path);
     // only 206 proves a nonzero offset was honored (a 200 would silently
     // serve the object from byte 0)
     TCHECK(body->status() == 206 || (offset == 0 && body->status() == 200))
@@ -394,8 +398,8 @@ S3FileSystem::Endpoint HttpEndpoint(const URI& path) {
 
 FileInfo HttpFileSystem::GetPathInfo(const URI& path) {
   S3FileSystem::Endpoint ep = HttpEndpoint(path);
-  http::Response resp = http::Request(ep.host, ep.port, "HEAD", path.name, {},
-                                      "", ep.tls);
+  http::Response resp = http::RequestWithRetry(ep.host, ep.port, "HEAD",
+                                               path.name, {}, "", ep.tls);
   TCHECK_LT(resp.status, 400) << "HTTP HEAD " << path.str() << " -> " << resp.status;
   FileInfo info;
   info.path = path;

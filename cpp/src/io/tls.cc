@@ -7,6 +7,7 @@
 #include <cstring>
 
 #include "dmlctpu/logging.h"
+#include "dmlctpu/retry.h"
 
 namespace dmlctpu {
 namespace tls {
@@ -169,8 +170,10 @@ size_t Connection::Read(void* buf, size_t len) {
   // servers that close TCP without close_notify (common) read as EOF too;
   // report anything else
   if (n == 0) return 0;
-  TLOG(Fatal) << "TLS: read failed (ssl error " << err << "): " << LastError();
-  return 0;  // unreachable
+  // mid-stream transport failure (reset, SO_RCVTIMEO expiry): retryable —
+  // the ranged-read layer reopens at its cursor
+  throw retry::TransientError("TLS: read failed (ssl error " +
+                              std::to_string(err) + "): " + LastError());
 }
 
 void Connection::WriteAll(const char* data, size_t len) {
@@ -178,7 +181,9 @@ void Connection::WriteAll(const char* data, size_t len) {
   while (len != 0) {
     int n = a.SSL_write(ssl_, data, static_cast<int>(std::min(
         len, static_cast<size_t>(1) << 30)));
-    TCHECK_GT(n, 0) << "TLS: write failed: " << LastError();
+    if (n <= 0) {
+      throw retry::TransientError("TLS: write failed: " + LastError());
+    }
     data += n;
     len -= static_cast<size_t>(n);
   }

@@ -9,7 +9,9 @@
 #include <algorithm>
 #include <cstring>
 
+#include "dmlctpu/fault.h"
 #include "dmlctpu/logging.h"
+#include "dmlctpu/telemetry.h"
 
 namespace dmlctpu {
 namespace {
@@ -60,27 +62,110 @@ void RecordIOWriter::WriteRecord(const void* buf, size_t size) {
   WritePiece(stream_, final_flag, head + piece_start, len - piece_start, /*pad=*/true);
 }
 
+void RecordIOReader::CountSkip(const char* why) {
+  ++corrupt_skipped_;
+  telemetry::stage::RecordCorruptSkipped().Add(1);
+  TLOG(Warning) << "RecordIO recover: skipping corrupt data (" << why << ")";
+}
+
+bool RecordIOReader::ReadFully(void* buf, size_t size) {
+  char* p = static_cast<char*>(buf);
+  while (size != 0) {
+    size_t n = stream_->Read(p, size);
+    if (n == 0) return false;
+    p += n;
+    size -= n;
+  }
+  return true;
+}
+
+bool RecordIOReader::Resync(uint32_t header[2]) {
+  // slide an 8-byte window one byte at a time until it decodes as a record
+  // head: the magic word followed by a start-flagged (0/1) header.  The
+  // window is seeded with the corrupt header just read, so a single flipped
+  // word costs at most a few dozen byte reads to recover from.
+  unsigned char win[8];
+  std::memcpy(win, header, sizeof(win));
+  for (;;) {
+    uint32_t magic, lrec;
+    std::memcpy(&magic, win, 4);
+    std::memcpy(&lrec, win + 4, 4);
+    const uint32_t cflag = RecordIOWriter::DecodeFlag(lrec);
+    if (magic == RecordIOWriter::kMagic && (cflag == 0u || cflag == 1u)) {
+      header[0] = magic;
+      header[1] = lrec;
+      return true;
+    }
+    unsigned char c;
+    if (stream_->Read(&c, 1) != 1) return false;  // EOF while resyncing
+    std::memmove(win, win + 1, 7);
+    win[7] = c;
+  }
+}
+
 bool RecordIOReader::NextRecord(std::string* out) {
   if (eos_) return false;
   out->clear();
   size_t size = 0;
   while (true) {
     uint32_t header[2];
-    size_t n = stream_->Read(header, sizeof(header));
-    if (n == 0) {
-      eos_ = true;
-      // mid-record EOF means the file lost the tail pieces of a split record
-      TCHECK_EQ(size, 0u) << "truncated RecordIO file: split record missing tail pieces";
-      return false;
+    size_t n;
+    if (has_pending_) {
+      // a previous resync already consumed this record's header
+      std::memcpy(header, pending_, sizeof(header));
+      has_pending_ = false;
+      n = sizeof(header);
+    } else {
+      n = stream_->Read(header, sizeof(header));
+      if (n == 0) {
+        eos_ = true;
+        if (size != 0) {
+          // mid-record EOF: the file lost the tail pieces of a split record
+          if (!recover_) {
+            TLOG(Fatal)
+                << "truncated RecordIO file: split record missing tail pieces";
+          }
+          CountSkip("mid-record EOF: split record missing tail pieces");
+          out->clear();
+        }
+        return false;
+      }
+      DMLCTPU_FAULT_POINT(fp_magic, "recordio.magic");
+      if (fp_magic.Fire() != fault::Mode::kNone) {
+        // flip the magic word: downstream sees exactly what wire corruption
+        // looks like, driving the recover (or fatal) path below
+        header[0] ^= 0x5a5a5a5au;
+      }
+      if (n != sizeof(header) || header[0] != RecordIOWriter::kMagic) {
+        if (!recover_) {
+          TCHECK_EQ(n, sizeof(header)) << "truncated RecordIO header";
+          TCHECK_EQ(header[0], RecordIOWriter::kMagic) << "bad RecordIO magic";
+        }
+        CountSkip(n != sizeof(header) ? "truncated header" : "bad magic");
+        out->clear();
+        size = 0;
+        if (n != sizeof(header) || !Resync(header)) {
+          eos_ = true;
+          return false;
+        }
+        // header now holds the resync'd record head; fall through
+      }
     }
-    TCHECK_EQ(n, sizeof(header)) << "truncated RecordIO header";
-    TCHECK_EQ(header[0], RecordIOWriter::kMagic) << "bad RecordIO magic";
     const uint32_t cflag = RecordIOWriter::DecodeFlag(header[1]);
     const uint32_t len = RecordIOWriter::DecodeLength(header[1]);
     const uint32_t padded = RoundUp4(len);
     out->resize(size + padded);
     if (padded != 0) {
-      stream_->ReadAll(&(*out)[size], padded);
+      if (recover_) {
+        if (!ReadFully(&(*out)[size], padded)) {
+          eos_ = true;
+          CountSkip("truncated payload");
+          out->clear();
+          return false;
+        }
+      } else {
+        stream_->ReadAll(&(*out)[size], padded);
+      }
     }
     size += len;
     out->resize(size);
@@ -110,7 +195,9 @@ char* ScanForRecordHead(char* begin, char* end) {
 }
 }  // namespace
 
-RecordIOChunkReader::RecordIOChunkReader(Blob chunk, unsigned part_index, unsigned num_parts) {
+RecordIOChunkReader::RecordIOChunkReader(Blob chunk, unsigned part_index,
+                                         unsigned num_parts, bool recover)
+    : recover_(recover) {
   size_t step = ((chunk.size + num_parts - 1) / num_parts + 3) & ~static_cast<size_t>(3);
   size_t begin = std::min(chunk.size, step * part_index);
   size_t end = std::min(chunk.size, step * (part_index + 1));
@@ -120,6 +207,65 @@ RecordIOChunkReader::RecordIOChunkReader(Blob chunk, unsigned part_index, unsign
 }
 
 bool RecordIOChunkReader::NextRecord(Blob* out) {
+  return recover_ ? NextRecordRecover(out) : NextRecordStrict(out);
+}
+
+bool RecordIOChunkReader::NextRecordRecover(Blob* out) {
+  while (pbegin_ + 8 <= pend_) {
+    uint32_t hdr[2];
+    std::memcpy(hdr, pbegin_, 8);
+    uint32_t cflag = RecordIOWriter::DecodeFlag(hdr[1]);
+    uint32_t len = RecordIOWriter::DecodeLength(hdr[1]);
+    bool ok = hdr[0] == RecordIOWriter::kMagic &&
+              (cflag == 0u || cflag == 1u) &&
+              pbegin_ + 8 + RoundUp4(len) <= pend_;
+    if (ok && cflag == 0u) {
+      out->dptr = pbegin_ + 8;
+      out->size = len;
+      pbegin_ += 8 + RoundUp4(len);
+      return true;
+    }
+    if (ok) {
+      // split record: reassemble pieces, validating each before committing
+      temp_.clear();
+      char* p = pbegin_;
+      for (;;) {
+        if (p + 8 > pend_) {
+          ok = false;
+          break;
+        }
+        std::memcpy(hdr, p, 8);
+        cflag = RecordIOWriter::DecodeFlag(hdr[1]);
+        len = RecordIOWriter::DecodeLength(hdr[1]);
+        if (hdr[0] != RecordIOWriter::kMagic ||
+            p + 8 + RoundUp4(len) > pend_) {
+          ok = false;
+          break;
+        }
+        temp_.append(p + 8, len);
+        p += 8 + RoundUp4(len);
+        if (cflag == 3u) break;
+        const uint32_t magic = RecordIOWriter::kMagic;
+        temp_.append(reinterpret_cast<const char*>(&magic), 4);
+      }
+      if (ok) {
+        pbegin_ = p;
+        out->dptr = temp_.empty() ? nullptr : &temp_[0];
+        out->size = temp_.size();
+        return true;
+      }
+    }
+    // corrupt span at pbegin_: count it and scan forward to the next
+    // plausible record head (4-byte stepping keeps alignment)
+    ++corrupt_skipped_;
+    telemetry::stage::RecordCorruptSkipped().Add(1);
+    TLOG(Warning) << "RecordIO recover: skipping corrupt chunk span";
+    pbegin_ = ScanForRecordHead(pbegin_ + kAlign, pend_);
+  }
+  return false;
+}
+
+bool RecordIOChunkReader::NextRecordStrict(Blob* out) {
   if (pbegin_ >= pend_) return false;
   uint32_t hdr[2];
   std::memcpy(hdr, pbegin_, 8);

@@ -13,6 +13,7 @@
 #include <mutex>
 #include <thread>
 
+#include <dmlctpu/fault.h>
 #include <dmlctpu/logging.h>
 
 namespace dmlctpu {
@@ -179,7 +180,24 @@ class Watchdog {
              (running_ && tracks_[i].progressed ? "true" : "false") +
              ",\"age_us\":" + std::to_string(age) + "}";
     }
-    out += "],\"registry\":" + Registry::Get()->SnapshotJson();
+    // retry substrate state: how hard the IO layer is fighting right now —
+    // a stall with a climbing io.retry (or a nonzero io.giveup) points at a
+    // flaky/unreachable source rather than a wedged pipeline stage
+    out += "],\"io\":{\"retry\":" +
+           std::to_string(Registry::Get()->counter("io.retry").Value()) +
+           ",\"giveup\":" +
+           std::to_string(Registry::Get()->counter("io.giveup").Value()) +
+           ",\"retry_wait_us\":" +
+           std::to_string(Registry::Get()->counter("io.retry_wait_us").Value()) +
+           ",\"corrupt_skipped\":" +
+           std::to_string(
+               Registry::Get()->counter("record.corrupt_skipped").Value()) +
+           ",\"part_retries\":" +
+           std::to_string(
+               Registry::Get()->counter("shard.part_retries").Value()) +
+           "}";
+    out += ",\"faults\":" + fault::SnapshotJson();
+    out += ",\"registry\":" + Registry::Get()->SnapshotJson();
     out += ",\"trace\":" + TraceDumpJson();
     out += "}";
     return out;

@@ -11,9 +11,12 @@
 
 #include "../src/data/libsvm_parser.h"
 #include "../src/data/record_batcher.h"
+#include "../src/data/sharded_parser.h"
 #include "../src/data/staged_batcher.h"
 #include "dmlctpu/data.h"
+#include "dmlctpu/fault.h"
 #include "dmlctpu/input_split.h"
+#include "dmlctpu/memory_io.h"
 #include "dmlctpu/row_block.h"
 #include "dmlctpu/stream.h"
 #include "dmlctpu/temp_dir.h"
@@ -784,6 +787,103 @@ TESTCASE(staged_batcher_nnz_max_fixed_shapes_and_spill) {
     EXPECT_EQV(got.labels[i], ref.label[i]);
     EXPECT_EQV(got.rows[i].size(), ref.offset[i + 1] - ref.offset[i]);
   }
+}
+
+// ---- graceful degradation (doc/robustness.md) -----------------------------
+
+namespace {
+
+// frame offset of record k (cflag-0 records whose payloads avoid the magic
+// word, so offsets are a pure function of the payload sizes)
+size_t RecordFrameOffset(const std::vector<std::string>& records, size_t k) {
+  size_t off = 0;
+  for (size_t i = 0; i < k; ++i) off += 8 + ((records[i].size() + 3) & ~3ull);
+  return off;
+}
+
+std::vector<std::string> DrainBatcher(data::RecordBatcher* batcher) {
+  std::vector<std::string> got;
+  data::RecordBatch* b = nullptr;
+  while (batcher->Next(&b)) {
+    for (size_t r = 0; r < b->num_records; ++r) {
+      got.emplace_back(b->bytes.data() + b->offsets[r],
+                       b->bytes.data() + b->offsets[r + 1]);
+    }
+    batcher->Recycle(&b);
+  }
+  return got;
+}
+
+}  // namespace
+
+TESTCASE(record_batcher_recover_skips_corrupt_span) {
+  TemporaryDirectory tmp;
+  std::vector<std::string> records;
+  for (int i = 0; i < 60; ++i) {
+    records.push_back("row-" + std::to_string(i) + std::string(i % 13, 'p'));
+  }
+  std::string path = tmp.path + "/corrupt.rec";
+  std::string buf;
+  {
+    MemoryStringStream ms(&buf);
+    RecordIOWriter w(&ms);
+    for (const auto& r : records) w.WriteRecord(r);
+  }
+  buf[RecordFrameOffset(records, 7)] ^= 0x5a;  // break record 7's magic
+  WriteFile(path, buf);
+  {
+    // strict batcher: the corrupt span is fatal (relayed off the producer)
+    data::RecordBatcher strict(
+        InputSplit::Create(path.c_str(), 0, 1, "recordio"), 16, 1 << 12);
+    EXPECT_THROWS(DrainBatcher(&strict));
+  }
+  uint64_t skipped_before = telemetry::stage::RecordCorruptSkipped().Value();
+  data::RecordBatcher recovering(
+      InputSplit::Create(path.c_str(), 0, 1, "recordio"), 16, 1 << 12,
+      /*recover=*/true);
+  auto got = DrainBatcher(&recovering);
+  std::vector<std::string> want = records;
+  want.erase(want.begin() + 7);
+  EXPECT_TRUE(got == want);
+  EXPECT_TRUE(telemetry::stage::RecordCorruptSkipped().Value() >
+              skipped_before);
+}
+
+TESTCASE(sharded_parser_reparse_keeps_stream_bit_identical) {
+  // the shard.worker.chunk fault point simulates transient mid-part parse
+  // failures; the pool must retry them invisibly — same row stream, with
+  // shard.part_retries counting the round trips
+  if (!fault::Enabled()) {
+    std::string err;
+    EXPECT_TRUE(!fault::ArmSpec("shard.worker.chunk=err@1.0", &err));
+    return;
+  }
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/shard.libsvm";
+  std::ostringstream os;
+  for (int i = 0; i < 4000; ++i) {
+    os << (i % 2) << ' ' << i % 97 << ':' << 0.25f * static_cast<float>(i)
+       << ' ' << (i % 89 + 100) << ":1\n";
+  }
+  WriteFile(f, os.str());
+  auto ref = DrainParser(Parser<uint32_t>::Create(f.c_str(), 0, 1, "libsvm").get());
+  // n=2 caps the storm below the 3-attempt budget: even if both injections
+  // land on the same part, its third attempt must succeed — so the epoch
+  // can never exhaust retries, while rate 1.0 guarantees the faults fire
+  std::string err;
+  EXPECT_TRUE(fault::ArmSpec("shard.worker.chunk=err@1.0:n=2;seed=11", &err));
+  uint64_t retries_before = telemetry::stage::ShardPartRetries().Value();
+  {
+    data::ShardedParser<uint32_t, float> sharded(f, 0, 1, "libsvm",
+                                                 /*num_workers=*/3);
+    auto got = DrainParser<uint32_t, float>(&sharded);
+    EXPECT_TRUE(SameContent(ref, got));
+  }
+  fault::DisarmAll();
+  EXPECT_TRUE(telemetry::stage::ShardPartRetries().Value() > retries_before);
+  // disarmed epoch still clean
+  data::ShardedParser<uint32_t, float> clean(f, 0, 1, "libsvm", 3);
+  EXPECT_TRUE(SameContent(ref, DrainParser<uint32_t, float>(&clean)));
 }
 
 TESTCASE(staged_batcher_single_row_over_cap_throws) {

@@ -26,6 +26,7 @@
 #include "../src/io/http.h"
 #include "../src/io/s3_filesys.h"
 #include "dmlctpu/stream.h"
+#include "dmlctpu/telemetry.h"
 #include "testing.h"
 
 using namespace dmlctpu;  // NOLINT
@@ -505,6 +506,7 @@ class MiniGcsServer : public MiniHttpServer {
   std::atomic<int> media_hits{0};
   std::atomic<int> truncate_next_media{0};  // next media GET: drop the
                                             // connection after this many bytes
+  std::atomic<int> fail_media_5xx{0};       // next N media GETs: reply 503
 
  protected:
   void Handle(const HttpRequest& req, HttpReply* reply) override {
@@ -592,6 +594,12 @@ class MiniGcsServer : public MiniHttpServer {
         reply->body = R"({"error":{"code":404,"message":"no such object"}})";
       } else if (QueryParam(req.query, "alt") == "media") {
         ++media_hits;
+        if (fail_media_5xx.load() > 0) {
+          --fail_media_5xx;
+          reply->status = "503 Service Unavailable";
+          reply->body = R"({"error":{"code":503,"message":"throttled"}})";
+          return;
+        }
         size_t begin = 0;
         auto range = req.headers.find("range");
         if (range != req.headers.end()) {
@@ -958,6 +966,31 @@ TESTCASE(gcs_read_resumes_after_midbody_drop) {
   in->ReadAll(got.data(), got.size());
   EXPECT_TRUE(got == payload);
   EXPECT_TRUE(server.media_hits.load() >= 2);  // initial + resumed request
+  ::unsetenv("GOOGLE_ACCESS_TOKEN");
+  ::unsetenv("STORAGE_EMULATOR_HOST");
+}
+
+TESTCASE(gcs_read_survives_5xx_storm) {
+  // a 503 storm shorter than the retry budget (default 4 attempts) must be
+  // absorbed by the opener's backoff loop: byte-exact payload, io.retry
+  // counting each absorbed rejection, and no error escaping to the caller
+  MiniGcsServer server;
+  ::setenv("STORAGE_EMULATOR_HOST",
+           ("http://127.0.0.1:" + std::to_string(server.port())).c_str(), 1);
+  ::setenv("GOOGLE_ACCESS_TOKEN", "testtoken", 1);
+  std::string payload;
+  for (int i = 0; i < 2000; ++i) payload += "storm-rec-" + std::to_string(i) + "\n";
+  server.objects["data/throttled.txt"] = payload;
+
+  server.fail_media_5xx = 3;
+  uint64_t retries_before = telemetry::stage::IoRetry().Value();
+  auto in = SeekStream::CreateForRead("gs://bkt/data/throttled.txt");
+  std::string got(payload.size(), '\0');
+  in->ReadAll(got.data(), got.size());
+  EXPECT_TRUE(got == payload);
+  EXPECT_EQV(server.fail_media_5xx.load(), 0);  // the storm was consumed
+  EXPECT_TRUE(server.media_hits.load() >= 4);   // 3 rejected + 1 served
+  EXPECT_TRUE(telemetry::stage::IoRetry().Value() >= retries_before + 3);
   ::unsetenv("GOOGLE_ACCESS_TOKEN");
   ::unsetenv("STORAGE_EMULATOR_HOST");
 }

@@ -11,7 +11,8 @@ Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
     the DMLC_* env bootstrap onto jax.distributed;
   * `tracker`: dmlc-submit job launch + rabit-compatible rendezvous.
 """
-from . import checkpoint, data, io, models, ops, parallel, telemetry, timer
+from . import (checkpoint, data, faultinject, io, models, ops, parallel,
+               telemetry, timer)
 from ._native import NativeError, version as native_version
 from .data import (DeviceStagingIter, PaddedBatch, Parser, RecordBatch,
                    RecordStagingIter, RowBlock)
@@ -20,8 +21,8 @@ from .io import (FileInfo, InputSplit, RecordIOReader, RecordIOWriter,
 
 __version__ = "0.1.0"
 __all__ = [
-    "checkpoint", "data", "io", "models", "ops", "parallel", "telemetry",
-    "timer",
+    "checkpoint", "data", "faultinject", "io", "models", "ops", "parallel",
+    "telemetry", "timer",
     "NativeError", "native_version",
     "DeviceStagingIter", "PaddedBatch", "Parser", "RowBlock",
     "RecordBatch", "RecordStagingIter",

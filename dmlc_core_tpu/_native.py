@@ -159,8 +159,12 @@ _LIB.DmlcTpuRecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ct
 _LIB.DmlcTpuRecordIOWriterClose.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuRecordIOWriterFree.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuRecordIOReaderCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+_LIB.DmlcTpuRecordIOReaderCreateEx.argtypes = [
+    ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuRecordIOReaderNext.argtypes = [
     ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+_LIB.DmlcTpuRecordIOReaderCorruptSkipped.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuRecordIOReaderCorruptSkipped.restype = ctypes.c_int64
 _LIB.DmlcTpuRecordIOReaderFree.argtypes = [ctypes.c_void_p]
 
 _LIB.DmlcTpuStreamCreate.argtypes = [
@@ -206,6 +210,12 @@ _LIB.DmlcTpuFlightRecordJson.argtypes = [
     ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
 _LIB.DmlcTpuWatchdogLastRecordJson.argtypes = [
     ctypes.POINTER(ctypes.c_char_p)]
+
+_LIB.DmlcTpuFaultCompiledIn.argtypes = [ctypes.POINTER(ctypes.c_int)]
+_LIB.DmlcTpuFaultArm.argtypes = [ctypes.c_char_p]
+_LIB.DmlcTpuFaultDisarm.argtypes = []
+_LIB.DmlcTpuFaultSnapshotJson.argtypes = [ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuFaultInjectedTotal.argtypes = [ctypes.POINTER(ctypes.c_int64)]
 
 LOG_CALLBACK_TYPE = ctypes.CFUNCTYPE(
     None, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)

@@ -500,6 +500,10 @@ def _declare_record_batcher_sig():
     L.DmlcTpuRecordBatcherCreate.argtypes = [
         ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint,
         ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p)]
+    L.DmlcTpuRecordBatcherCreateEx.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p)]
     L.DmlcTpuRecordBatcherNext.argtypes = [ctypes.c_void_p,
                                            ctypes.POINTER(_RecordBatchC)]
     L.DmlcTpuRecordBatcherBeforeFirst.argtypes = [ctypes.c_void_p]
@@ -534,17 +538,22 @@ class RecordStagingIter:
     reorder : yield parts in deterministic order (True) or arrival order.
     prefetch_depth : host batches the pack stage keeps in flight
         (``prefetch`` is the back-compat alias).
+    recover : skip corrupt record spans (counted in the
+        ``record.corrupt_skipped`` telemetry counter) instead of aborting
+        the epoch — doc/robustness.md.
     """
 
     def __init__(self, uri: str, records_cap: int = 4096,
                  bytes_cap: int = 1 << 22, part: int = 0, num_parts: int = 1,
                  sharding=None, prefetch: int = 2, num_workers: int = 1,
-                 reorder: bool = True, prefetch_depth: Optional[int] = None):
+                 reorder: bool = True, prefetch_depth: Optional[int] = None,
+                 recover: bool = False):
         self._lib = _declare_record_batcher_sig()
         self._handle = ctypes.c_void_p()
-        check(self._lib.DmlcTpuRecordBatcherCreate(
+        self._recover = bool(recover)
+        check(self._lib.DmlcTpuRecordBatcherCreateEx(
             uri.encode(), part, num_parts, records_cap, bytes_cap,
-            ctypes.byref(self._handle)))
+            1 if self._recover else 0, ctypes.byref(self._handle)))
         self._uri = uri
         self._part = part
         self._num_parts = num_parts
@@ -656,9 +665,10 @@ class RecordStagingIter:
         L = self._lib
         V = self._virtual_parts
         h = ctypes.c_void_p()
-        check(L.DmlcTpuRecordBatcherCreate(
+        check(L.DmlcTpuRecordBatcherCreateEx(
             self._uri.encode(), self._part * V + j, self._num_parts * V,
-            self._records_cap, self._bytes_cap, ctypes.byref(h)))
+            self._records_cap, self._bytes_cap, 1 if self._recover else 0,
+            ctypes.byref(h)))
         try:
             c = _RecordBatchC()
             while check(L.DmlcTpuRecordBatcherNext(h, ctypes.byref(c))) == 1:

@@ -1,0 +1,90 @@
+"""Deterministic fault injection for the native IO/staging substrate.
+
+Python face of ``dmlctpu/fault.h``.  The native runtime exposes a registry
+of named fault points compiled into the IO hot paths (``io.http.connect``,
+``io.ranged.read``, ``io.opener.5xx``, ``recordio.magic``,
+``shard.worker.chunk``).  Arming is a single spec string::
+
+    io.ranged.read=err@0.01;seed=7
+    io.opener.5xx=503@1.0:n=3;recordio.magic=corrupt@0.05;seed=42
+
+Each clause is ``<point>=<mode>@<rate>[:n=<count>][:after=<skip>]`` with
+modes ``err`` (throw a transient error), ``eof`` (truncate), ``503``/
+``5xx`` (synthesize an HTTP 503), and ``corrupt`` (flip bytes).  ``seed=N``
+makes the per-hit decisions deterministic: hit ``k`` of point ``p`` fires
+(or not) identically across runs and regardless of thread interleaving, so
+a failure found under faults can be replayed exactly.
+
+Faults can also be armed without code changes via the ``DMLCTPU_FAULTS``
+environment variable (read once at library load).  When the library was
+compiled with ``-DDMLCTPU_FAULTS=0`` every call here degrades to a no-op:
+:func:`compiled_in` returns ``False``, :func:`arm` raises on a nonempty
+spec, and snapshots report ``{"enabled": False}``.
+
+See ``doc/robustness.md`` for the point-name contract and replay recipe.
+"""
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import json
+from typing import Iterator
+
+from . import _native
+
+__all__ = [
+    "compiled_in", "arm", "disarm", "snapshot", "injected_total", "armed",
+]
+
+
+def compiled_in() -> bool:
+    """True when the native library was built with fault injection
+    compiled in (the default; ``-DDMLCTPU_FAULTS=0`` stubs it out)."""
+    out = ctypes.c_int()
+    _native.check(_native.lib().DmlcTpuFaultCompiledIn(ctypes.byref(out)))
+    return bool(out.value)
+
+
+def arm(spec: str) -> None:
+    """Arm fault points from a spec string (see module docstring for the
+    grammar).  Arming is atomic — a malformed spec raises ``NativeError``
+    and leaves the previous arming untouched.  An empty spec disarms."""
+    _native.check(_native.lib().DmlcTpuFaultArm(spec.encode()))
+
+
+def disarm() -> None:
+    """Disarm every fault point (counters in telemetry are NOT reset)."""
+    _native.check(_native.lib().DmlcTpuFaultDisarm())
+
+
+def snapshot() -> dict:
+    """Parsed JSON state: ``{"enabled", "armed", "seed", "points": [{"name",
+    "mode", "armed", "hits", "injected"}, ...]}`` (just ``{"enabled":
+    False}`` when compiled out)."""
+    out = ctypes.c_char_p()
+    _native.check(
+        _native.lib().DmlcTpuFaultSnapshotJson(ctypes.byref(out)))
+    return json.loads((out.value or b"{}").decode())
+
+
+def injected_total() -> int:
+    """Total faults injected across all points since process start."""
+    out = ctypes.c_int64()
+    _native.check(
+        _native.lib().DmlcTpuFaultInjectedTotal(ctypes.byref(out)))
+    return int(out.value)
+
+
+@contextlib.contextmanager
+def armed(spec: str) -> Iterator[None]:
+    """Context manager arming ``spec`` for the body and disarming on exit —
+    the shape the fault-injection test suite uses::
+
+        with faultinject.armed("io.ranged.read=err@0.01;seed=7"):
+            rows = stage_epoch()
+    """
+    arm(spec)
+    try:
+        yield
+    finally:
+        disarm()

@@ -106,11 +106,26 @@ class RecordIOWriter:
             pass  # interpreter teardown: errors already logged natively
 
 class RecordIOReader:
-    """Stream logical records back out of a RecordIO container."""
+    """Stream logical records back out of a RecordIO container.
 
-    def __init__(self, uri: str):
+    ``recover=True`` turns corrupt spans (bad magic, truncated tails) into
+    skips instead of hard errors: the reader resynchronizes to the next
+    record boundary and counts what it dropped in :attr:`corrupt_skipped`
+    (also the ``record.corrupt_skipped`` telemetry counter).  See
+    ``doc/robustness.md``.
+    """
+
+    def __init__(self, uri: str, recover: bool = False):
         self._handle = ctypes.c_void_p()
-        check(lib().DmlcTpuRecordIOReaderCreate(uri.encode(), ctypes.byref(self._handle)))
+        check(lib().DmlcTpuRecordIOReaderCreateEx(
+            uri.encode(), 1 if recover else 0, ctypes.byref(self._handle)))
+
+    @property
+    def corrupt_skipped(self) -> int:
+        """Corrupt record spans skipped so far (0 unless ``recover=True``)."""
+        if not self._handle:
+            return 0
+        return int(lib().DmlcTpuRecordIOReaderCorruptSkipped(self._handle))
 
     def __iter__(self) -> Iterator[bytes]:
         data = ctypes.c_void_p()

@@ -233,6 +233,13 @@ def stall_attribution(before: dict, after: dict,
     snapshots (a worker restart re-registered from zero): the clamped
     deltas then under-count the interval, so treat the attribution as a
     lower bound rather than silently trusting it.
+
+    When the retry substrate was active in the interval (any of
+    ``io.retry`` / ``io.giveup`` / ``io.retry_wait_us`` moved) an ``io``
+    pseudo-stage joins the table, with backoff sleep time as its busy
+    seconds — a flaky source then shows up as "io-bound" instead of being
+    silently folded into the reading stage's busy time.  The raw interval
+    totals are always in the result's ``io`` dict.
     """
     d = counters_delta(before, after)
     us = lambda k: d.get(k, 0) / 1e6  # noqa: E731
@@ -243,6 +250,18 @@ def stall_attribution(before: dict, after: dict,
         if name == "shard":
             busy = max(busy - wait, 0.0)
         stages[name] = {"busy_s": round(busy, 6), "wait_s": round(wait, 6)}
+
+    io = {
+        "retry": d.get("io.retry", 0),
+        "giveup": d.get("io.giveup", 0),
+        "retry_wait_s": round(us("io.retry_wait_us"), 6),
+        "corrupt_skipped": d.get("record.corrupt_skipped", 0),
+        "part_retries": d.get("shard.part_retries", 0),
+    }
+    if io["retry"] or io["giveup"] or io["retry_wait_s"]:
+        # pseudo-stage only when retries actually happened, so quiet runs
+        # keep the classic four-stage table
+        stages["io"] = {"busy_s": io["retry_wait_s"], "wait_s": 0.0}
 
     sharded = d.get("shard.parts", 0) > 0
     candidates = [n for n in stages if not (sharded and n == "parse")]
@@ -262,6 +281,7 @@ def stall_attribution(before: dict, after: dict,
         "table": table,
         "wall_s": None if wall_s is None else round(wall_s, 6),
         "restarted": snapshot_restarted(before, after),
+        "io": io,
     }
 
 

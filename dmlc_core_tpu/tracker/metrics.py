@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import socket
 import struct
 import threading
@@ -215,6 +216,16 @@ class MetricsAggregator:
                 flags.append(f"stale {h['age_s']:.0f}s")
             if h["restarted"]:
                 flags.append("restarted")
+            # retry-substrate health: a host fighting a flaky source shows
+            # its lifetime retry/giveup/corruption totals here (.get —
+            # pushes from older workers carry no "io" block)
+            io = attr.get("io", {})
+            if io.get("giveup"):
+                flags.append(f"io-giveup {io['giveup']}")
+            elif io.get("retry"):
+                flags.append(f"io-retry {io['retry']}")
+            if io.get("corrupt_skipped"):
+                flags.append(f"corrupt {io['corrupt_skipped']}")
             bound = f"{st}-bound {share:.0f}%" if st else "-"
             lines.append(f"{rank:<6}{h['host']:<17}{bound:<16}"
                          f"{busy:>7.2f}   {'; '.join(flags)}".rstrip())
@@ -265,9 +276,14 @@ def push_once(tracker_uri: str, metrics_port: int, rank: int,
 class MetricsPusher:
     """Daemon thread pushing this process's snapshot every ``interval_s``.
 
-    Push failures are tolerated silently (the tracker may not be up yet or
-    may already be gone); snapshots are cumulative so the next successful
-    push repairs the tracker's view.
+    Push failures are tolerated (the tracker may not be up yet or may
+    already be gone) but accounted: each one bumps :attr:`pushes_dropped`
+    (mirrored into the ``tracker.pushes_dropped`` telemetry counter so the
+    NEXT successful push reports the gap), and consecutive failures widen
+    the loop's sleep with jitter — capped at 8 intervals — so a dead
+    tracker costs a few connect attempts per minute, not a reconnect spin.
+    A success snaps the cadence back to ``interval_s``.  Snapshots are
+    cumulative, so any successful push repairs the tracker's view.
     """
 
     def __init__(self, tracker_uri: str, metrics_port: int, rank: int,
@@ -276,21 +292,38 @@ class MetricsPusher:
         self.metrics_port = int(metrics_port)
         self.rank = int(rank)
         self.interval_s = max(float(interval_s), 0.05)
+        self.pushes_dropped = 0
+        self._failure_streak = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="dmlctpu-metrics-pusher", daemon=True)
         self._thread.start()
 
+    def _next_delay(self) -> float:
+        streak = self._failure_streak
+        if streak <= 0:
+            return self.interval_s
+        backoff = min(self.interval_s * (2 ** min(streak, 3)),
+                      8.0 * self.interval_s)
+        return backoff * (0.75 + random.random() * 0.5)
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not self._stop.wait(self._next_delay()):
             self.push()
 
     def push(self) -> bool:
         """One immediate push; True on success."""
         try:
             push_once(self.tracker_uri, self.metrics_port, self.rank)
+            self._failure_streak = 0
             return True
         except (OSError, ConnectionError, ValueError):
+            self._failure_streak += 1
+            self.pushes_dropped += 1
+            try:
+                telemetry.counter_add("tracker.pushes_dropped", 1)
+            except Exception:  # telemetry compiled out or lib torn down
+                pass
             return False
 
     def close(self, final_push: bool = True) -> None:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import socket
 import struct
 import subprocess
@@ -205,6 +206,7 @@ class _Worker:
                 c.write_int(neighbour)
             else:
                 c.write_int(-1)
+        rounds_failed = 0
         while True:
             ngood = c.read_int()
             good = {c.read_int() for _ in range(ngood)}
@@ -218,7 +220,20 @@ class _Worker:
                 c.write_int(wait_conn[r].port)
                 c.write_int(r)
             if c.read_int() != 0:
-                continue  # worker failed some connects; retry round
+                # worker failed some connects; retry the round, but pace the
+                # loop — a peer that is down makes the worker report failure
+                # instantly, and an unthrottled retry spins the tracker and
+                # floods the peer with SYNs.  Jittered, capped backoff keeps
+                # recovery prompt without the stampede.
+                rounds_failed += 1
+                if rounds_failed % 10 == 0:
+                    LOGGER.warning(
+                        "rank %d: %d peer-connect rounds failed (still "
+                        "retrying; unreachable peers among ranks %s)",
+                        rank, rounds_failed, sorted(bad))
+                time.sleep(min(0.05 * (2 ** min(rounds_failed, 6)), 2.0) *
+                           (0.5 + random.random()))
+                continue  # retry round
             self.port = c.read_int()
             finished = []
             for r in connectable:

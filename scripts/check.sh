@@ -90,6 +90,31 @@ for t in test_data test_telemetry; do
     exit 1
   fi
 done
+
+# Fault-injection-opt-out tier: fault.h promises the same compile-out
+# contract as telemetry (-DDMLCTPU_FAULTS=0 stubs every point).  Build and
+# run the recordio/staging suites against the stubbed header: test_core's
+# recover-mode tests and test_data's retry tests must degrade to their
+# stubbed expectations, and everything else must be bit-identical.
+mkdir -p build/nofaults
+for t in test_core test_data; do
+  nf_bin=build/nofaults/$t
+  if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+    cmake -S . -B build/nofaults -G Ninja -DCMAKE_BUILD_TYPE=Release \
+          -DDMLCTPU_FAULTS=OFF >/dev/null
+    ninja -C build/nofaults "$t" >/dev/null
+  else
+    # -rdynamic: test_core's stack-trace test needs symbol names from
+    # backtrace_symbols (the cmake build links test binaries the same way)
+    g++ -O1 -g -std=c++20 -DDMLCTPU_FAULTS=0 -pthread -rdynamic \
+        -I cpp/include -I cpp cpp/tests/"$t".cc cpp/src/*.cc \
+        cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$nf_bin"
+  fi
+  if ! "$nf_bin" >/tmp/dmlctpu_check_nofaults_$t.log 2>&1; then
+    echo "check.sh: NOFAULTS SUITE FAILED: $t (log: /tmp/dmlctpu_check_nofaults_$t.log)" >&2
+    exit 1
+  fi
+done
 flock -u 9
 
 if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
@@ -109,8 +134,19 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # env arming nests refcounted inside it without replacing the policy.
   DMLCTPU_WATCHDOG_DEADLINE_S=2 DMLCTPU_WATCHDOG_POLICY=abort \
     python -m pytest tests/test_staging.py -x -q -m "not slow"
+
+  # Faults tier: the whole staging suite with a worker-chunk fault armed
+  # from the environment (seeded — every run injects the same failures).
+  # The sharded pool's part-retry must absorb every injection: any output
+  # drift or surfaced error fails the suite, proving the degradation path
+  # is transparent.  Only shard.worker.chunk is armed here — it is retried
+  # above the parse, so a green run means bit-identical staging; arming
+  # corruption points (recordio.magic) would legitimately fail non-recover
+  # readers.
+  DMLCTPU_FAULTS="shard.worker.chunk=err@0.02;seed=3" \
+    python -m pytest tests/test_staging.py -x -q -m "not slow"
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier")
-echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + $py)"
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier")
+echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"

@@ -130,8 +130,12 @@ def test_stall_attribution_staging(libsvm_file):
     attr = telemetry.stall_attribution(before, telemetry.snapshot(), wall_s=1.0)
 
     assert set(attr) == {"stages", "bound", "bound_stage", "table", "wall_s",
-                         "restarted"}
+                         "restarted", "io"}
     assert attr["restarted"] is False
+    # local file, nothing armed: no retries, so the io pseudo-stage stays out
+    # of the table and the raw totals are all zero
+    assert attr["io"] == {"retry": 0, "giveup": 0, "retry_wait_s": 0.0,
+                          "corrupt_skipped": 0, "part_retries": 0}
     assert set(attr["stages"]) == {"parse", "shard", "pack", "h2d"}
     for st in attr["stages"].values():
         assert st["busy_s"] >= 0.0 and st["wait_s"] >= 0.0
