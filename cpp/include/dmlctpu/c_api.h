@@ -32,9 +32,12 @@ const char* DmlcTpuGetLastError(void);
 typedef void* DmlcTpuParserHandle;
 int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
                         const char* format, DmlcTpuParserHandle* out);
-/*! \brief parser with a parallel sharded parse pool.  num_workers <= 1 is
+/*! \brief parser with a parallel sharded parse pool.  num_workers 0..1 is
  *  exactly DmlcTpuParserCreate (bit-identical stream); num_workers > 1 fans
- *  the parse over worker threads driving per-virtual-part inner parsers.
+ *  the parse over worker threads driving per-virtual-part inner parsers;
+ *  num_workers < 0 forces the pool with |num_workers| workers even when
+ *  that is 1 — same stream, but live-retunable via *SetPoolKnobs (how the
+ *  autotuner arms an iterator that starts at one worker).
  *  reorder != 0 (recommended) re-emits blocks in deterministic part order,
  *  so the row stream is IDENTICAL for any worker count; reorder == 0 emits
  *  in arrival order.  buffer_bytes caps buffered parsed bytes (0 = default
@@ -48,6 +51,17 @@ int DmlcTpuParserCreateEx(const char* uri, unsigned part, unsigned num_parts,
  *  created after the call. */
 int DmlcTpuSetDefaultParseThreads(int nthread);
 int DmlcTpuGetDefaultParseThreads(int* out);
+/*! \brief retune a live sharded parse pool (parsers created with
+ *  num_workers > 1): num_workers <= 0 / buffer_bytes == 0 / chunk_bytes
+ *  == 0 each leave that knob unchanged; workers and the buffer clamp to
+ *  their floors (1 worker, 1 MiB).  Growth spawns workers immediately;
+ *  shrink retires surplus workers at their next part boundary; chunk_bytes
+ *  raises the chunk-read size of parts parsed from here on — through all
+ *  of it the emitted row stream stays bit-identical.  *out_applied = 1
+ *  when the parser has a pool, 0 for single-stream parsers (no-op). */
+int DmlcTpuParserSetPoolKnobs(DmlcTpuParserHandle handle, int num_workers,
+                              uint64_t buffer_bytes, uint64_t chunk_bytes,
+                              int* out_applied);
 int DmlcTpuParserNext(DmlcTpuParserHandle handle, DmlcTpuRowBlockC* out);
 int DmlcTpuParserBeforeFirst(DmlcTpuParserHandle handle);
 int64_t DmlcTpuParserBytesRead(DmlcTpuParserHandle handle);
@@ -159,8 +173,10 @@ int DmlcTpuStagedBatcherCreate(const char* uri, unsigned part, unsigned num_part
 /*! \brief staged batcher over a parallel sharded parse pool.  Batch packing
  *  is a pure function of the row stream, so with reorder != 0 every staged
  *  batch is bit-identical to the single-stream batcher for ANY num_workers
- *  — only parse throughput changes.  num_workers <= 1 falls back to the
- *  plain single-stream path; buffer_bytes 0 = default (64 MiB). */
+ *  — only parse throughput changes.  num_workers 0..1 falls back to the
+ *  plain single-stream path; num_workers < 0 forces a |num_workers|-worker
+ *  pool (live-retunable even from 1 worker — see DmlcTpuParserCreateEx);
+ *  buffer_bytes 0 = default (64 MiB). */
 int DmlcTpuStagedBatcherCreateEx(const char* uri, unsigned part,
                                  unsigned num_parts, const char* format,
                                  uint64_t batch_size, uint64_t nnz_bucket,
@@ -181,6 +197,20 @@ int DmlcTpuStagedBatcherNextOwned(DmlcTpuStagedBatcherHandle handle,
 void DmlcTpuStagedBatchFree(void* batch);
 int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle);
 int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle);
+/*! \brief retune the batcher's sharded parse pool live — the autotuner's
+ *  mid-epoch knob path (semantics as DmlcTpuParserSetPoolKnobs; batches
+ *  stay bit-identical because packing is a pure function of the row
+ *  stream).  *out_applied = 0 when the batcher wraps a single-stream
+ *  parser (created with num_workers <= 1): those can only be retuned by
+ *  rebuilding at an epoch boundary. */
+int DmlcTpuStagedBatcherSetPoolKnobs(DmlcTpuStagedBatcherHandle handle,
+                                     int num_workers, uint64_t buffer_bytes,
+                                     uint64_t chunk_bytes, int* out_applied);
+/*! \brief read back the pool's current knob values (*out_applied = 0 and
+ *  outputs untouched for single-stream parsers) */
+int DmlcTpuStagedBatcherGetPoolKnobs(DmlcTpuStagedBatcherHandle handle,
+                                     int* num_workers, uint64_t* buffer_bytes,
+                                     uint64_t* chunk_bytes, int* out_applied);
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle);
 
 /* ---- RecordBatcher: RecordIO → packed fixed-shape device batches --------- */

@@ -370,6 +370,10 @@ DMLCTPU_STAGE_GAUGE(ShardBufferedBytes, "shard.buffered_bytes")
 // claimed by workers vs drained by the consumer.
 DMLCTPU_STAGE_GAUGE(ShardNextPart, "shard.next_part")
 DMLCTPU_STAGE_GAUGE(ShardEmitPart, "shard.emit_part")
+// Live pool knobs (SetPoolKnobs): current worker target + buffer cap, so
+// the autotuner's decisions are visible in /metrics and flight records.
+DMLCTPU_STAGE_GAUGE(ShardPoolWorkers, "shard.pool_workers")
+DMLCTPU_STAGE_GAUGE(ShardPoolBufferBytes, "shard.pool_buffer_bytes")
 // StagedBatcher: arena pack/pad.  busy_us excludes time blocked in the
 // upstream parser's Next() (that is input_wait_us), so the pair cleanly
 // splits "packing is slow" from "packing is starved".

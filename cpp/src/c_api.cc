@@ -69,21 +69,51 @@ struct RecordBatcherCtx {
   uint64_t bytes_cap = 0;
 };
 
-// num_workers > 1 → parallel sharded parse pool; otherwise the plain
-// single-stream parser, so the single-worker path stays bit-identical to
-// the V1 entry points
+// num_workers > 1 → parallel sharded parse pool; num_workers < 0 → sharded
+// pool with |num_workers| workers even when that is 1 (autotuner arming: a
+// 1-worker pool emits the same stream as the single-stream reader but stays
+// live-retunable via SetPoolKnobs); otherwise the plain single-stream
+// parser, so the default single-worker path stays bit-identical to the V1
+// entry points
 template <typename IndexType>
 std::unique_ptr<dmlctpu::Parser<IndexType, float>> MakeParser(
     const char* uri, unsigned part, unsigned num_parts, const char* format,
     int num_workers, int reorder, uint64_t buffer_bytes) {
-  if (num_workers > 1) {
+  if (num_workers > 1 || num_workers < 0) {
+    int nw = num_workers < 0 ? std::max(-num_workers, 1) : num_workers;
     size_t buf = buffer_bytes != 0
         ? static_cast<size_t>(buffer_bytes)
         : dmlctpu::data::ShardedParser<IndexType, float>::kDefaultBufferBytes;
     return std::make_unique<dmlctpu::data::ShardedParser<IndexType, float>>(
-        uri, part, num_parts, format, num_workers, reorder != 0, buf);
+        uri, part, num_parts, format, nw, reorder != 0, buf);
   }
   return dmlctpu::Parser<IndexType, float>::Create(uri, part, num_parts, format);
+}
+
+// retune when the parser is a sharded pool; single-stream parsers report
+// applied = 0 and the call is a no-op (the autotuner treats those knobs as
+// next-epoch-only)
+template <typename IndexType>
+int SetPoolKnobsOn(dmlctpu::Parser<IndexType, float>* p, int num_workers,
+                   uint64_t buffer_bytes, uint64_t chunk_bytes) {
+  auto* sharded =
+      dynamic_cast<dmlctpu::data::ShardedParser<IndexType, float>*>(p);
+  if (sharded == nullptr) return 0;
+  sharded->SetPoolKnobs(num_workers, static_cast<size_t>(buffer_bytes),
+                        static_cast<size_t>(chunk_bytes));
+  return 1;
+}
+
+template <typename IndexType>
+int GetPoolKnobsOn(dmlctpu::Parser<IndexType, float>* p, int* num_workers,
+                   uint64_t* buffer_bytes, uint64_t* chunk_bytes) {
+  auto* sharded =
+      dynamic_cast<dmlctpu::data::ShardedParser<IndexType, float>*>(p);
+  if (sharded == nullptr) return 0;
+  *num_workers = sharded->pool_workers();
+  *buffer_bytes = static_cast<uint64_t>(sharded->pool_buffer_bytes());
+  *chunk_bytes = static_cast<uint64_t>(sharded->pool_chunk_bytes());
+  return 1;
 }
 
 }  // namespace
@@ -511,6 +541,17 @@ int64_t DmlcTpuParserBytesRead(DmlcTpuParserHandle handle) {
   return static_cast<int64_t>(static_cast<ParserCtx*>(handle)->parser->BytesRead());
 }
 
+int DmlcTpuParserSetPoolKnobs(DmlcTpuParserHandle handle, int num_workers,
+                              uint64_t buffer_bytes, uint64_t chunk_bytes,
+                              int* out_applied) {
+  return Guard([&] {
+    auto* ctx = static_cast<ParserCtx*>(handle);
+    *out_applied = SetPoolKnobsOn<uint64_t>(ctx->parser.get(), num_workers,
+                                            buffer_bytes, chunk_bytes);
+    return 0;
+  });
+}
+
 void DmlcTpuParserFree(DmlcTpuParserHandle handle) {
   delete static_cast<ParserCtx*>(handle);
 }
@@ -750,6 +791,28 @@ int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle) {
 
 int64_t DmlcTpuStagedBatcherBytesRead(DmlcTpuStagedBatcherHandle handle) {
   return static_cast<int64_t>(static_cast<BatcherCtx*>(handle)->batcher->BytesRead());
+}
+
+int DmlcTpuStagedBatcherSetPoolKnobs(DmlcTpuStagedBatcherHandle handle,
+                                     int num_workers, uint64_t buffer_bytes,
+                                     uint64_t chunk_bytes, int* out_applied) {
+  return Guard([&] {
+    auto* ctx = static_cast<BatcherCtx*>(handle);
+    *out_applied = SetPoolKnobsOn<uint32_t>(
+        ctx->batcher->parser(), num_workers, buffer_bytes, chunk_bytes);
+    return 0;
+  });
+}
+
+int DmlcTpuStagedBatcherGetPoolKnobs(DmlcTpuStagedBatcherHandle handle,
+                                     int* num_workers, uint64_t* buffer_bytes,
+                                     uint64_t* chunk_bytes, int* out_applied) {
+  return Guard([&] {
+    auto* ctx = static_cast<BatcherCtx*>(handle);
+    *out_applied = GetPoolKnobsOn<uint32_t>(
+        ctx->batcher->parser(), num_workers, buffer_bytes, chunk_bytes);
+    return 0;
+  });
 }
 
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle) {

@@ -66,6 +66,15 @@ Parser<IndexType, DType>* CreateTextParser(const std::string& path,
     parseahead = std::atoi(pa->second.c_str()) != 0;
     parser_args.erase("parseahead");
   }
+  // ?chunkbytes= raises the split's chunk-read size (HintChunkSize is
+  // grow-only) — bigger chunks amortize per-chunk IO and parse dispatch;
+  // the autotuner threads this through the sharded pool per part
+  auto cb = parser_args.find("chunkbytes");
+  if (cb != parser_args.end()) {
+    long long n = std::atoll(cb->second.c_str());
+    if (n > 0) source->HintChunkSize(static_cast<size_t>(n));
+    parser_args.erase("chunkbytes");
+  }
   auto base = std::make_unique<ParserCls<IndexType, DType>>(std::move(source),
                                                             parser_args, nthread);
   if (!parseahead || !io::UsePipelineThreads()) {

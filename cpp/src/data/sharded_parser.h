@@ -36,6 +36,13 @@
 // bit-identical to a fault-free epoch no matter where the failure landed.
 // Each round trip counts shard.part_retries; fault point
 // "shard.worker.chunk" injects a transient chunk-parse failure here.
+//
+// Live retuning (doc/autotune.md): SetPoolKnobs retargets the worker count
+// and the buffer cap while the pool runs.  Growing spawns threads
+// immediately (they join the claim cursor); shrinking is lazy — surplus
+// workers retire at their next part boundary, so an in-flight part always
+// finishes and the emitted stream stays bit-identical (the stream is a pure
+// function of the part order, never of which thread parsed a part).
 #ifndef DMLCTPU_SRC_DATA_SHARDED_PARSER_H_
 #define DMLCTPU_SRC_DATA_SHARDED_PARSER_H_
 
@@ -87,8 +94,8 @@ class ShardedParser : public Parser<IndexType, DType> {
         format_(format),
         part_(part),
         num_parts_(num_parts),
-        num_workers_(std::max(num_workers, 1)),
         reorder_(reorder),
+        worker_target_(std::max(num_workers, 1)),
         buffer_bytes_(std::max<size_t>(buffer_bytes, 1u << 20u)) {
     TCHECK_LT(part, num_parts) << "part index must be < num_parts";
     io::URISpec spec(uri, part, num_parts);
@@ -124,7 +131,11 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   bool Next() override {
-    if (workers_.empty()) Start();  // direct use without a BeforeFirst
+    // atomic flag, not workers_.empty(): SetPoolKnobs may be growing the
+    // vector from another thread while the consumer sits in Next()
+    if (!pool_started_.load(std::memory_order_acquire)) {
+      Start();  // direct use without a BeforeFirst
+    }
     while (true) {
       while (blk_ptr_ < cur_blocks_.size()) {
         if (cur_blocks_[blk_ptr_].Size() == 0) {
@@ -145,6 +156,60 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   unsigned virtual_parts() const { return virtual_parts_; }
+
+  /*! \brief retune the pool live.  num_workers <= 0 / buffer_bytes == 0 /
+   *  chunk_bytes == 0 leave the respective knob unchanged; workers and the
+   *  buffer clamp to their floors (1 worker, 1 MiB).  chunk_bytes raises
+   *  the chunk-read size of inner parsers created from here on (parts
+   *  already parsing finish at their current size; HintChunkSize is
+   *  grow-only).  Safe against a concurrently-draining consumer: growth
+   *  spawns into the running pool, shrink retires workers lazily at part
+   *  boundaries, and a bigger buffer cap wakes blocked producers. */
+  void SetPoolKnobs(int num_workers, size_t buffer_bytes,
+                    size_t chunk_bytes = 0) {
+    std::lock_guard<std::mutex> plk(pool_mu_);
+    int spawn = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (buffer_bytes != 0) {
+        buffer_bytes_ = std::max<size_t>(buffer_bytes, 1u << 20u);
+      }
+      if (chunk_bytes != 0) chunk_bytes_ = chunk_bytes;
+      if (num_workers > 0) {
+        worker_target_ = std::max(num_workers, 1);
+        // grow a live pool now; with no live workers (between epochs) the
+        // next Start() simply spawns the new target
+        if (!workers_.empty() && !stop_ && !error_ &&
+            live_workers_ < worker_target_ &&
+            next_claim_ < virtual_parts_) {
+          spawn = worker_target_ - live_workers_;
+          live_workers_ = worker_target_;
+        }
+      }
+      telemetry::stage::ShardPoolWorkers().Set(worker_target_);
+      telemetry::stage::ShardPoolBufferBytes().Set(
+          static_cast<int64_t>(buffer_bytes_));
+    }
+    for (int i = 0; i < spawn; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+    // a raised buffer cap (or a retargeted pool) may unblock either side
+    cv_produce_.notify_all();
+    cv_consume_.notify_all();
+  }
+
+  int pool_workers() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return worker_target_;
+  }
+  size_t pool_buffer_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return buffer_bytes_;
+  }
+  size_t pool_chunk_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return chunk_bytes_;
+  }
 
  private:
   struct PartQueue {
@@ -188,9 +253,20 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   void Start() {
-    for (int i = 0; i < num_workers_; ++i) {
+    std::lock_guard<std::mutex> plk(pool_mu_);
+    int target;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      target = worker_target_;
+      live_workers_ = target;
+      telemetry::stage::ShardPoolWorkers().Set(target);
+      telemetry::stage::ShardPoolBufferBytes().Set(
+          static_cast<int64_t>(buffer_bytes_));
+    }
+    for (int i = 0; i < target; ++i) {
       workers_.emplace_back([this] { WorkerLoop(); });
     }
+    pool_started_.store(true, std::memory_order_release);
   }
 
   void Stop() {
@@ -200,10 +276,13 @@ class ShardedParser : public Parser<IndexType, DType> {
     }
     cv_produce_.notify_all();
     cv_consume_.notify_all();
+    // pool_mu_ serializes against SetPoolKnobs growing workers_ mid-join
+    std::lock_guard<std::mutex> plk(pool_mu_);
     for (auto& t : workers_) {
       if (t.joinable()) t.join();
     }
     workers_.clear();
+    pool_started_.store(false, std::memory_order_release);
   }
 
   void WorkerLoop() {
@@ -212,7 +291,15 @@ class ShardedParser : public Parser<IndexType, DType> {
         unsigned j;
         {
           std::lock_guard<std::mutex> lk(mu_);
-          if (stop_ || error_ || next_claim_ >= virtual_parts_) return;
+          // lazy shrink: surplus workers retire between parts, so the part
+          // in flight always completes and the stream stays deterministic.
+          // The retire decision and the live-count move share ONE lock
+          // hold — concurrent retirees can never overshoot the target.
+          if (live_workers_ > worker_target_ || stop_ || error_ ||
+              next_claim_ >= virtual_parts_) {
+            --live_workers_;
+            break;
+          }
           j = next_claim_++;
           telemetry::stage::ShardNextPart().Set(next_claim_);
           parts_[j];  // publish the (empty) queue so the consumer can see it
@@ -229,10 +316,11 @@ class ShardedParser : public Parser<IndexType, DType> {
       {
         std::lock_guard<std::mutex> lk(mu_);
         if (!error_) error_ = std::current_exception();
+        --live_workers_;
       }
-      cv_consume_.notify_all();
       cv_produce_.notify_all();
     }
+    cv_consume_.notify_all();
   }
 
   /*! \brief parse part j, re-parsing from the top on failure; chunks the
@@ -243,9 +331,17 @@ class ShardedParser : public Parser<IndexType, DType> {
     const int max_attempts = ShardMaxAttempts();
     retry::Backoff backoff(retry::IoPolicy());
     size_t skip = 0;
+    // pin the chunk-size knob for ALL attempts of this part: a re-parse
+    // replays already-popped chunks by count, which is only correct when
+    // every attempt reproduces identical chunk boundaries
+    size_t chunk_bytes;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      chunk_bytes = chunk_bytes_;
+    }
     for (int attempt = 1;; ++attempt) {
       try {
-        ParseOnePart(j, skip);
+        ParseOnePart(j, skip, chunk_bytes);
         return;
       } catch (const Error& e) {
         bool can_retry;
@@ -277,7 +373,7 @@ class ShardedParser : public Parser<IndexType, DType> {
   void RollbackPartLocked(PartQueue* pq) {
     for (auto& [blocks, cost] : pq->q) {
       buffered_bytes_ -= cost;
-      if (free_pool_.size() < static_cast<size_t>(2 * num_workers_)) {
+      if (free_pool_.size() < static_cast<size_t>(2 * worker_target_)) {
         for (auto& b : blocks) b.Clear();
         free_pool_.push_back(std::move(blocks));
       }
@@ -287,14 +383,22 @@ class ShardedParser : public Parser<IndexType, DType> {
         static_cast<int64_t>(buffered_bytes_));
   }
 
-  void ParseOnePart(unsigned j, size_t skip_chunks = 0) {
+  void ParseOnePart(unsigned j, size_t skip_chunks = 0,
+                    size_t chunk_bytes = 0) {
     telemetry::ScopedSpan span("shard.part");
     telemetry::ScopedAccum part_timer(telemetry::stage::ShardPartUs());
     telemetry::stage::ShardParts().Add(1);
     // nthread=1: worker threads ARE the parse parallelism; parseahead=0
     // skips the inner parse-ahead thread so CallParseNext hands back owned
-    // containers with zero copies
-    std::string inner_uri = InjectArgs(uri_, "nthread=1&parseahead=0");
+    // containers with zero copies.  chunkbytes (live knob, pinned per part
+    // by the caller) raises the inner split's chunk-read size — each part
+    // picks up the value current at its parse start, so a mid-epoch retune
+    // cannot perturb the emitted stream (rows are chunk-independent).
+    std::string extra = "nthread=1&parseahead=0";
+    if (chunk_bytes != 0) {
+      extra += "&chunkbytes=" + std::to_string(chunk_bytes);
+    }
+    std::string inner_uri = InjectArgs(uri_, extra);
     auto parser = Parser<IndexType, DType>::Create(
         inner_uri.c_str(), part_ * virtual_parts_ + j,
         num_parts_ * virtual_parts_, format_.c_str());
@@ -331,7 +435,7 @@ class ShardedParser : public Parser<IndexType, DType> {
         if (stop_ || error_) return;
         telemetry::stage::ShardBytes().Add(delta);
         bytes_read_.fetch_add(delta, std::memory_order_relaxed);
-        if (free_pool_.size() < static_cast<size_t>(2 * num_workers_)) {
+        if (free_pool_.size() < static_cast<size_t>(2 * worker_target_)) {
           for (auto& b : blocks) b.Clear();
           free_pool_.push_back(std::move(blocks));
         }
@@ -454,7 +558,7 @@ class ShardedParser : public Parser<IndexType, DType> {
    *  (caller holds mu_); Clear() keeps each container's capacity */
   void RecycleCurBlocks() {
     if (cur_blocks_.empty()) return;
-    if (free_pool_.size() < static_cast<size_t>(2 * num_workers_)) {
+    if (free_pool_.size() < static_cast<size_t>(2 * worker_target_)) {
       for (auto& b : cur_blocks_) b.Clear();
       free_pool_.push_back(std::move(cur_blocks_));
     }
@@ -465,11 +569,17 @@ class ShardedParser : public Parser<IndexType, DType> {
   const std::string format_;
   const unsigned part_;
   const unsigned num_parts_;
-  const int num_workers_;
   const bool reorder_;
-  const size_t buffer_bytes_;
+  // live-retunable knobs (SetPoolKnobs), guarded by mu_
+  int worker_target_;
+  size_t buffer_bytes_;
+  size_t chunk_bytes_ = 0;  // 0 = the split's own default
+  int live_workers_ = 0;
   unsigned virtual_parts_ = 0;
 
+  // serializes workers_ mutation (Start / Stop / SetPoolKnobs growth);
+  // always taken before mu_, never while holding it
+  std::mutex pool_mu_;
   std::mutex mu_;
   std::condition_variable cv_produce_;
   std::condition_variable cv_consume_;
@@ -479,6 +589,7 @@ class ShardedParser : public Parser<IndexType, DType> {
   size_t buffered_bytes_ = 0;
   bool stop_ = false;
   std::exception_ptr error_;
+  std::atomic<bool> pool_started_{false};
   std::vector<std::thread> workers_;
   std::vector<Blocks> free_pool_;  // consumed containers awaiting reuse (mu_)
   std::atomic<size_t> bytes_read_{0};

@@ -208,6 +208,9 @@ class StagedBatcherT {
   void BeforeFirst() { iter_.BeforeFirst(); }
   size_t BytesRead() const { return parser_->BytesRead(); }
   std::shared_ptr<StagedArenaPool> pool() const { return pool_; }
+  /*! \brief the underlying parser, e.g. to retune a sharded pool live
+   *  (ShardedParser::SetPoolKnobs is safe against the pack thread) */
+  Parser<IndexType, float>* parser() const { return parser_.get(); }
 
  private:
   static constexpr size_t kIterDepth = 4;

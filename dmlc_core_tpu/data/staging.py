@@ -432,6 +432,16 @@ def _declare_batcher_sig():
     L.DmlcTpuStagedBatcherBytesRead.argtypes = [ctypes.c_void_p]
     L.DmlcTpuStagedBatcherBytesRead.restype = ctypes.c_int64
     L.DmlcTpuStagedBatcherFree.argtypes = [ctypes.c_void_p]
+    # live pool retuning (hasattr: tolerate an older .so during rebuilds —
+    # set_knobs then degrades to next-epoch-only Python knobs)
+    if hasattr(L, "DmlcTpuStagedBatcherSetPoolKnobs"):
+        L.DmlcTpuStagedBatcherSetPoolKnobs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int)]
+        L.DmlcTpuStagedBatcherGetPoolKnobs.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int)]
     L._staged_batcher_declared = True
     return L
 
@@ -547,7 +557,7 @@ class RecordStagingIter:
                  bytes_cap: int = 1 << 22, part: int = 0, num_parts: int = 1,
                  sharding=None, prefetch: int = 2, num_workers: int = 1,
                  reorder: bool = True, prefetch_depth: Optional[int] = None,
-                 recover: bool = False):
+                 recover: bool = False, autotune: Optional[bool] = None):
         self._lib = _declare_record_batcher_sig()
         self._handle = ctypes.c_void_p()
         self._recover = bool(recover)
@@ -564,6 +574,11 @@ class RecordStagingIter:
         self._bytes_cap = bytes_cap
         self._num_workers = max(int(num_workers), 1)
         self._reorder = reorder
+        if autotune is None:
+            from dmlc_core_tpu import autotune as _at
+            autotune = _at.armed()
+        self._autotune = bool(autotune)
+        self._tuner = None  # lazily attached AutoTuner (see __iter__)
         self._virtual_parts = 0  # resolved lazily on the first parallel epoch
         # Unified byte accounting: every native RecordBatcher — the main
         # handle AND each per-virtual-part parallel cursor — publishes chunk
@@ -661,12 +676,19 @@ class RecordStagingIter:
         return self._virtual_parts
 
     def _open_part(self, j: int):
-        """One virtual part's packed host batches, on a pool worker thread."""
+        """One of THIS worker's virtual parts, on a pool worker thread."""
+        yield from self._open_global_part(self._part * self._virtual_parts + j)
+
+    def _open_global_part(self, g: int):
+        """One GLOBAL virtual part's packed host batches (g in
+        [0, num_parts*V)); shard handoff parses peers' parts by global id
+        through the same per-part cursor the pool's re-parse machinery
+        uses, so a stolen shard replays deterministically."""
         L = self._lib
-        V = self._virtual_parts
+        V = self._resolve_virtual_parts()
         h = ctypes.c_void_p()
         check(L.DmlcTpuRecordBatcherCreateEx(
-            self._uri.encode(), self._part * V + j, self._num_parts * V,
+            self._uri.encode(), int(g), self._num_parts * V,
             self._records_cap, self._bytes_cap, 1 if self._recover else 0,
             ctypes.byref(h)))
         try:
@@ -677,6 +699,26 @@ class RecordStagingIter:
             # bytes flow through the shared "record.bytes" telemetry counter
             # as the cursor reads; nothing to tally here
             L.DmlcTpuRecordBatcherFree(h)
+
+    def host_batches_coordinated(self, epoch: int = 0, client=None,
+                                 steal: bool = True) -> Iterator[dict]:
+        """Host-side batches under tracker-coordinated shard ownership.
+
+        Registers this worker's virtual parts on the tracker's shard board,
+        claims each before parsing, and — once its own list is drained —
+        steals pending shards from hosts the tracker has flagged
+        (straggler / restarted / stale), keeping job-wide exactly-once
+        visitation (see tracker.metrics.coordinated_parts).  ``client``
+        defaults to the env contract (DMLC_TRACKER_METRICS_PORT); without a
+        tracker this is plain in-order part iteration.
+        """
+        from dmlc_core_tpu.tracker import metrics as _tracker_metrics
+        V = self._resolve_virtual_parts()
+        if client is None:
+            client = _tracker_metrics.shard_client_from_env(rank=self._part)
+        shards = list(range(self._part * V, (self._part + 1) * V))
+        yield from _tracker_metrics.coordinated_parts(
+            int(epoch), shards, self._open_global_part, client, steal=steal)
 
     def _produce_host(self, emit) -> None:
         """Drive the native read+pack, emitting host batch dicts."""
@@ -713,7 +755,8 @@ class RecordStagingIter:
                 f"bytes_cap={cap_b}; lower bytes_cap below "
                 f"{np.iinfo(np.int32).max // nprocs}")
 
-        native = _staged_iter(self._produce_host, self._prefetch)
+        native = _staged_iter(self._produce_host, self._prefetch,
+                              depth_gauge="record.queue_depth")
 
         def pack(local, out):
             out[0] = local["num_records"]
@@ -739,9 +782,39 @@ class RecordStagingIter:
             self.batches_staged += 1
             yield batch
 
+    # ---- retuning -----------------------------------------------------------
+    @property
+    def knobs(self) -> dict:
+        """Current pipeline knobs (the autotuner's view of this iterator)."""
+        return {"num_workers": self._num_workers,
+                "prefetch_depth": self._prefetch}
+
+    def set_knobs(self, num_workers: Optional[int] = None,
+                  prefetch_depth: Optional[int] = None,
+                  **_ignored) -> dict:
+        """Retune pipeline knobs; both take effect at the next epoch (the
+        record path's Python worker pool and staging queues are rebuilt per
+        epoch).  Unknown knobs (buffer_mb/chunk_bytes — parse-side only)
+        are accepted and ignored so one autotuner policy drives both
+        iterator kinds.  Returns the new knob dict with ``pool_live=False``
+        (no native pool on this path)."""
+        if num_workers is not None:
+            self._num_workers = max(int(num_workers), 1)
+        if prefetch_depth is not None:
+            self._prefetch = max(int(prefetch_depth), 1)
+        return dict(self.knobs, pool_live=False)
+
     def __iter__(self) -> Iterator[RecordBatch]:
         with _observability_scope():
-            yield from self._iter_epoch()
+            from dmlc_core_tpu import autotune as _at
+            tuner = _at.maybe_attach(self)
+            if tuner is None:
+                yield from self._iter_epoch()
+                return
+            with tuner.epoch():
+                for batch in self._iter_epoch():
+                    yield batch
+                    tuner.on_batch()
 
     def _iter_epoch(self) -> Iterator[RecordBatch]:
         if self._sharding is not None and jax.process_count() > 1:
@@ -802,6 +875,10 @@ class DeviceStagingIter:
     reorder : deterministic part-ordered re-emission (True, default) or
         arrival order (False; order not reproducible across runs).
     buffer_mb : cap on parsed-but-unconsumed bytes in the worker pool.
+    autotune : arm the stall-attribution autotuner (dmlc_core_tpu.autotune)
+        on this iterator; None (default) follows DMLCTPU_AUTOTUNE.  Armed
+        iterators always build the sharded pool — even at num_workers=1 —
+        so every knob stays live-retunable mid-epoch.
     """
 
     def __init__(self, uri: str, batch_size: int = 4096, nnz_bucket: int = 1 << 16,
@@ -810,13 +887,24 @@ class DeviceStagingIter:
                  nnz_max: int = 0, log_every: int = 0,
                  with_qid: bool = False, num_workers: int = 1,
                  reorder: bool = True, buffer_mb: int = 64,
-                 prefetch_depth: Optional[int] = None):
+                 prefetch_depth: Optional[int] = None,
+                 autotune: Optional[bool] = None):
         self._lib = _declare_batcher_sig()
         self._handle = ctypes.c_void_p()
+        if autotune is None:
+            from dmlc_core_tpu import autotune as _at
+            autotune = _at.armed()
+        self._autotune = bool(autotune)
+        nw = int(num_workers)
+        if self._autotune and nw <= 1:
+            # a 1-worker sharded pool emits the same stream as the single
+            # -stream reader but stays live-retunable; negative num_workers
+            # forces the pool (see DmlcTpuStagedBatcherCreateEx docs)
+            nw = -1
         check(self._lib.DmlcTpuStagedBatcherCreateEx(
             uri.encode(), part, num_parts, format.encode(),
             batch_size, nnz_bucket, nnz_max, int(with_field), int(with_qid),
-            int(num_workers), int(reorder), int(buffer_mb) << 20,
+            nw, int(reorder), int(buffer_mb) << 20,
             ctypes.byref(self._handle)))
         self._batch_size = batch_size
         self._nnz_max = nnz_max
@@ -824,6 +912,9 @@ class DeviceStagingIter:
         self._prefetch = max(prefetch_depth if prefetch_depth is not None
                              else prefetch, 1)
         self._num_workers = max(int(num_workers), 1)
+        self._buffer_mb = int(buffer_mb)
+        self._chunk_bytes = 0  # 0 = the input split's default read size
+        self._tuner = None  # lazily attached AutoTuner (see __iter__)
         self._reorder = reorder
         self._with_field = with_field
         self._with_qid = with_qid
@@ -878,6 +969,51 @@ class DeviceStagingIter:
                  prefetch_depth=self._prefetch, bytes_read=self.bytes_read,
                  batches_staged=self.batches_staged)
         return c
+
+    # ---- live retuning ------------------------------------------------------
+    @property
+    def knobs(self) -> dict:
+        """Current pipeline knobs (the autotuner's view of this iterator)."""
+        return {"num_workers": self._num_workers,
+                "buffer_mb": self._buffer_mb,
+                "prefetch_depth": self._prefetch,
+                "chunk_bytes": self._chunk_bytes}
+
+    def set_knobs(self, num_workers: Optional[int] = None,
+                  buffer_mb: Optional[int] = None,
+                  prefetch_depth: Optional[int] = None,
+                  chunk_bytes: Optional[int] = None) -> dict:
+        """Retune pipeline knobs on a live iterator.
+
+        ``num_workers`` / ``buffer_mb`` / ``chunk_bytes`` reach the native
+        sharded pool immediately when one exists (worker growth spawns now,
+        shrink retires at the next part boundary; the emitted stream stays
+        bit-identical either way).  ``prefetch_depth`` takes effect at the
+        next epoch (the staging queues are built per epoch).  Returns the
+        new knob dict plus ``pool_live``: False means the native side is a
+        single-stream parser (built with num_workers=1 and autotune unarmed)
+        so only the Python-side knobs moved.
+        """
+        if prefetch_depth is not None:
+            self._prefetch = max(int(prefetch_depth), 1)
+        nw = int(num_workers) if num_workers is not None else 0
+        bb = (int(buffer_mb) << 20) if buffer_mb is not None else 0
+        cb = int(chunk_bytes) if chunk_bytes is not None else 0
+        live = False
+        if nw > 0 or bb > 0 or cb > 0:
+            if hasattr(self._lib, "DmlcTpuStagedBatcherSetPoolKnobs"):
+                applied = ctypes.c_int(0)
+                check(self._lib.DmlcTpuStagedBatcherSetPoolKnobs(
+                    self._handle, nw, ctypes.c_uint64(bb),
+                    ctypes.c_uint64(cb), ctypes.byref(applied)))
+                live = bool(applied.value)
+            if nw > 0:
+                self._num_workers = max(nw, 1)
+            if bb > 0:
+                self._buffer_mb = int(buffer_mb)
+            if cb > 0:
+                self._chunk_bytes = cb
+        return dict(self.knobs, pool_live=live)
 
     # ---- staging ------------------------------------------------------------
     def _stage(self, w: dict) -> PaddedBatch:
@@ -995,7 +1131,8 @@ class DeviceStagingIter:
                     if not emit(self._wrap_owned(c)):
                         return
 
-        native = _staged_iter(produce, self._prefetch)
+        native = _staged_iter(produce, self._prefetch,
+                              depth_gauge="pack.queue_depth")
 
         # payload: [num_rows, max_index, row_ptr[B+1]]
         def pack(local, out):
@@ -1067,7 +1204,15 @@ class DeviceStagingIter:
         and, when launched under a tracker, reports its counters to the
         tracker's metrics channel."""
         with _observability_scope():
-            yield from self._iter_epoch()
+            from dmlc_core_tpu import autotune as _at
+            tuner = _at.maybe_attach(self)
+            if tuner is None:
+                yield from self._iter_epoch()
+                return
+            with tuner.epoch():
+                for batch in self._iter_epoch():
+                    yield batch
+                    tuner.on_batch()
 
     def _iter_epoch(self) -> Iterator[PaddedBatch]:
         self._epoch_t0 = time.monotonic()

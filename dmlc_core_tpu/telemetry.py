@@ -33,7 +33,7 @@ __all__ = [
     "counters_delta", "snapshot_restarted", "merge_snapshots",
     "histogram_quantile", "trace_start", "trace_stop", "trace_dump_json",
     "trace_dump", "record_span", "span", "stall_attribution",
-    "format_stall_table", "capture_logs",
+    "format_stall_table", "window", "Window", "capture_logs",
     "watchdog", "watchdog_from_env", "watchdog_running",
     "watchdog_stall_count", "flight_record", "last_flight_record",
 ]
@@ -283,6 +283,85 @@ def stall_attribution(before: dict, after: dict,
         "restarted": snapshot_restarted(before, after),
         "io": io,
     }
+
+
+class Window:
+    """One measured telemetry interval (see :func:`window`).
+
+    Inside the ``with`` body only ``before`` is set; on exit the window is
+    closed and carries ``after``, ``wall_s``, the clamped counter ``delta``,
+    the full :func:`stall_attribution` result, and the ``restarted`` flag
+    (True when a counter moved backwards mid-window — treat the deltas as a
+    lower bound and do not let them drive tuning decisions).
+    """
+
+    __slots__ = ("before", "after", "wall_s", "delta", "attribution",
+                 "restarted", "_t0")
+
+    def __init__(self) -> None:
+        self._t0 = time.monotonic()
+        self.before: dict = {}
+        self.after: Optional[dict] = None
+        self.wall_s: Optional[float] = None
+        self.delta: Dict[str, int] = {}
+        self.attribution: Optional[dict] = None
+        self.restarted = False
+
+    @property
+    def closed(self) -> bool:
+        return self.after is not None
+
+    @property
+    def bound_stage(self) -> Optional[str]:
+        return self.attribution["bound_stage"] if self.attribution else None
+
+    def bytes_processed(self) -> int:
+        """Pipeline bytes moved in the window: the max of the per-path byte
+        counters (shard/parse/record), which never double-counts — the
+        sharded pool's inner parsers feed both shard.bytes and parse.bytes
+        with the same bytes."""
+        return max(self.delta.get("shard.bytes", 0),
+                   self.delta.get("parse.bytes", 0),
+                   self.delta.get("record.bytes", 0))
+
+    def mb_per_s(self) -> float:
+        """Window throughput in MB/s (0.0 for an unclosed/instant window)."""
+        if not self.wall_s or self.wall_s <= 0:
+            return 0.0
+        return self.bytes_processed() / (1 << 20) / self.wall_s
+
+    def close(self) -> None:
+        """Close the window now (idempotent; the context manager calls it)."""
+        if self.after is not None:
+            return
+        self.wall_s = time.monotonic() - self._t0
+        self.after = snapshot()
+        self.delta = counters_delta(self.before, self.after)
+        self.attribution = stall_attribution(self.before, self.after,
+                                             wall_s=self.wall_s)
+        self.restarted = self.attribution["restarted"]
+
+    def open(self) -> "Window":
+        self.before = snapshot()
+        self._t0 = time.monotonic()
+        return self
+
+
+@contextlib.contextmanager
+def window() -> Iterator[Window]:
+    """Snapshot-pair context manager: one :class:`Window` measuring the
+    body.  Replaces the hand-rolled before/after snapshot plumbing in
+    bench.py, the watchdog tests, and the autotuner::
+
+        with telemetry.window() as w:
+            run_epoch()
+        print(w.mb_per_s(), w.attribution["table"])
+    """
+    w = Window().open()
+    try:
+        yield w
+    finally:
+        w.close()
 
 
 def format_stall_table(attr: dict) -> str:

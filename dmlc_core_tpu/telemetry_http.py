@@ -11,6 +11,9 @@ One small ThreadingHTTPServer per process serving:
 * ``/flight`` — the most recent watchdog flight record, or a fresh one
   (``?fresh=1`` forces a fresh build even when a stall was recorded).
 * ``/snapshot`` — the raw registry snapshot JSON (what the tracker pushes).
+* ``/autotune`` — the autotuner's structured state: armed flag, per-tuner
+  knob/progress summaries, and the bounded decision log (JSON; see
+  doc/autotune.md).
 
 Workers serve their own process registry; the tracker passes a ``provider``
 returning ``(labels, snapshot)`` pairs so job-wide metrics come out as one
@@ -141,9 +144,13 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/snapshot":
                 self._send(200, json.dumps(telemetry.snapshot()),
                            "application/json")
+            elif url.path == "/autotune":
+                from . import autotune  # lazy: most servers never need it
+                self._send(200, json.dumps(autotune.state()),
+                           "application/json")
             else:
-                self._send(404, "not found: try /metrics /trace /flight\n",
-                           "text/plain")
+                self._send(404, "not found: try /metrics /trace /flight "
+                           "/snapshot /autotune\n", "text/plain")
         except Exception as exc:  # a scrape must never kill the server
             try:
                 self._send(500, f"error: {exc}\n", "text/plain")

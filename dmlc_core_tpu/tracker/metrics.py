@@ -19,6 +19,17 @@ Payload: ``{"rank", "host", "pid", "restarted", "snapshot"}`` where
 are monotonic), so the tracker can lose any number of them and the next one
 heals the view; a worker restart shows up as counters moving backwards and
 tags the host ``restarted``.
+
+The same channel closes the loop at the job level: a payload may carry an
+optional ``shard_req`` (register / claim / steal / done against the
+tracker's :class:`ShardBoard`), answered with one JSON reply AFTER the int
+ack — old workers never send one and never read one, so the extension is
+wire-compatible both ways.  The board serializes shard ownership for an
+epoch's virtual parts, and ``steal`` hands pending shards of flagged hosts
+(persistent stragglers by the ``format_job_table`` median rule, restarted
+hosts, stale hosts) to healthy claimants — work stealing driven by the
+stall-attribution flags, with exactly-once job-wide visitation because
+every shard start goes through one claim point.
 """
 from __future__ import annotations
 
@@ -42,6 +53,8 @@ METRICS_PORT_ENV = "DMLC_TRACKER_METRICS_PORT"
 __all__ = [
     "MetricsAggregator", "MetricsPusher", "push_once", "ensure_pusher",
     "stop_pusher", "METRICS_MAGIC", "METRICS_PORT_ENV",
+    "ShardBoard", "ShardClient", "shard_client_from_env",
+    "coordinated_parts",
 ]
 
 
@@ -77,6 +90,123 @@ def _write_str(sock: socket.socket, value: str) -> None:
 
 # ---- tracker side -----------------------------------------------------------
 
+def _stage_medians(attrs: List[dict]) -> Dict[str, float]:
+    """Fleet median busy share per stage, over hosts' attribution dicts."""
+    by_stage: Dict[str, List[float]] = {}
+    for a in attrs:
+        for stage, share in a["bound"].items():
+            by_stage.setdefault(stage, []).append(share)
+    median: Dict[str, float] = {}
+    for stage, shares in by_stage.items():
+        s = sorted(shares)
+        mid = len(s) // 2
+        median[stage] = s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2
+    return median
+
+
+class ShardBoard:
+    """Job-wide shard ownership ledger: the tracker-side half of mid-epoch
+    work stealing.
+
+    Shards are opaque ints (the staging layer uses GLOBAL virtual-part ids,
+    ``rank * V + j``).  Per epoch the board records who *owns* each shard,
+    who *started* it, and who finished it.  The visitation guarantee is
+    structural: a shard is parsed only after a successful ``claim`` or a
+    ``steal``, both of which are serialized here, and a started shard is
+    never reassigned — so across the whole job every shard is started by
+    exactly one live lineage.  (A restarted owner may re-claim a shard it
+    itself started — deterministic re-parse of its own lost work, the same
+    replay contract as the pool's part retry.)  Only the newest few epochs
+    are retained.
+    """
+
+    def __init__(self, keep_epochs: int = 4):
+        self._lock = threading.Lock()
+        self._keep = max(int(keep_epochs), 1)
+        # epoch -> {"owners": {shard: rank}, "started": {shard: rank},
+        #           "done": {shard: rank}, "stolen": [handoff records]}
+        self._epochs: Dict[int, dict] = {}
+
+    def _epoch(self, epoch: int) -> dict:
+        st = self._epochs.get(epoch)
+        if st is None:
+            st = {"owners": {}, "started": {}, "done": {}, "stolen": []}
+            self._epochs[epoch] = st
+            while len(self._epochs) > self._keep:
+                del self._epochs[min(self._epochs)]
+        return st
+
+    @staticmethod
+    def _pending(st: dict) -> int:
+        return sum(1 for s in st["owners"] if s not in st["started"])
+
+    def register(self, rank: int, epoch: int, shards: List[int]) -> dict:
+        """Declare this rank's shard set for an epoch (idempotent; first
+        registrant of a shard becomes its owner)."""
+        with self._lock:
+            st = self._epoch(epoch)
+            for s in shards:
+                st["owners"].setdefault(int(s), int(rank))
+            return {"ok": True, "pending": self._pending(st)}
+
+    def claim(self, rank: int, epoch: int, shard: int) -> dict:
+        """Ask to start a shard.  ``ok=False`` means it was handed to
+        someone else while this owner lagged — skip it, the thief parses
+        it."""
+        with self._lock:
+            st = self._epoch(epoch)
+            owner = st["owners"].get(shard)
+            started = st["started"].get(shard)
+            if (owner is not None and owner != rank) or \
+                    (started is not None and started != rank):
+                return {"ok": False, "pending": self._pending(st)}
+            st["owners"][shard] = int(rank)
+            st["started"][shard] = int(rank)
+            return {"ok": True, "pending": self._pending(st)}
+
+    def steal(self, rank: int, epoch: int, flagged) -> dict:
+        """Hand one pending shard of a flagged host to ``rank`` (claimed
+        atomically).  ``shard=None`` when nothing is stealable; ``pending``
+        lets the caller decide whether to poll again or end the epoch."""
+        with self._lock:
+            st = self._epoch(epoch)
+            for s in sorted(st["owners"]):
+                owner = st["owners"][s]
+                if owner == rank or owner not in flagged:
+                    continue
+                if s in st["started"]:
+                    continue
+                st["owners"][s] = int(rank)
+                st["started"][s] = int(rank)
+                st["stolen"].append({"shard": int(s), "from": int(owner),
+                                     "to": int(rank), "t": time.time()})
+                return {"shard": int(s), "from": int(owner),
+                        "pending": self._pending(st)}
+            return {"shard": None, "pending": self._pending(st)}
+
+    def done(self, rank: int, epoch: int, shard: int) -> dict:
+        with self._lock:
+            st = self._epoch(epoch)
+            st["done"][shard] = int(rank)
+            return {"ok": True, "pending": self._pending(st)}
+
+    def state(self) -> dict:
+        """JSON-ready per-epoch progress + handoff history (job_snapshot)."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for e, st in sorted(self._epochs.items()):
+                out[str(e)] = {
+                    "shards": len(st["owners"]),
+                    "started": len(st["started"]),
+                    "done": len(st["done"]),
+                    "pending": self._pending(st),
+                    "stolen": list(st["stolen"]),
+                    "owners": {str(s): r
+                               for s, r in sorted(st["owners"].items())},
+                }
+            return out
+
+
 class MetricsAggregator:
     """Accepts worker snapshot pushes and merges them into a job view."""
 
@@ -92,6 +222,7 @@ class MetricsAggregator:
         self._lock = threading.Lock()
         # rank -> {"host","pid","snapshot","restarted","last_update"}
         self._hosts: Dict[int, dict] = {}
+        self.board = ShardBoard()
         self._closed = False
         self._thread = threading.Thread(
             target=self._serve, name="dmlctpu-metrics-aggregator", daemon=True)
@@ -138,6 +269,53 @@ class MetricsAggregator:
                 "last_update": time.time(),
             }
         _write_int(fd, 0)
+        # optional shard-board RPC: one JSON reply after the ack (absent
+        # for plain pushes, so the classic protocol is untouched)
+        req = payload.get("shard_req")
+        if req is not None:
+            _write_str(fd, json.dumps(self._handle_shard_req(rank, req)))
+
+    def _handle_shard_req(self, rank: int, req: dict) -> dict:
+        op = req.get("op")
+        epoch = int(req.get("epoch", 0))
+        if op == "register":
+            return self.board.register(rank, epoch, req.get("shards", []))
+        if op == "claim":
+            return self.board.claim(rank, epoch, int(req["shard"]))
+        if op == "steal":
+            reply = self.board.steal(rank, epoch, self.flagged_ranks())
+            if reply.get("shard") is not None:
+                telemetry.counter_add("tracker.shards_stolen", 1)
+                LOGGER.info("epoch %d: shard %d handed off rank %d -> %d",
+                            epoch, reply["shard"], reply["from"], rank)
+            return reply
+        if op == "done":
+            return self.board.done(rank, epoch, int(req["shard"]))
+        return {"error": f"unknown shard op {op!r}"}
+
+    def flagged_ranks(self, stale_s: float = 30.0) -> set:
+        """Ranks whose pending shards are up for grabs: persistent
+        stragglers (the format_job_table median rule over lifetime
+        counters), restarted hosts, and hosts whose last push went stale."""
+        now = time.time()
+        with self._lock:
+            hosts = {r: dict(h) for r, h in self._hosts.items()}
+        empty: dict = {"counters": {}}
+        attrs = {r: telemetry.stall_attribution(empty, h["snapshot"])
+                 for r, h in hosts.items()}
+        median = _stage_medians(list(attrs.values()))
+        flagged = set()
+        for r, h in hosts.items():
+            if h["restarted"] or (now - h["last_update"]) > stale_s:
+                flagged.add(r)
+                continue
+            st = attrs[r]["bound_stage"]
+            if st is not None:
+                share = attrs[r]["bound"].get(st, 0.0)
+                med = median.get(st, 0.0)
+                if share >= 1.5 * med and share - med >= 10.0:
+                    flagged.add(r)
+        return flagged
 
     # ---- job view -----------------------------------------------------------
 
@@ -170,6 +348,7 @@ class MetricsAggregator:
                 "enabled": False, "counters": {}, "gauges": {},
                 "histograms": {}}
         view["restarted"] = any(h["restarted"] for h in hosts.values())
+        view["shards"] = self.board.state()
         return view
 
     def format_job_table(self, stale_s: float = 30.0) -> str:
@@ -184,16 +363,9 @@ class MetricsAggregator:
         hosts: Dict[int, dict] = view["hosts"]  # type: ignore[assignment]
         if not hosts:
             return "(no worker telemetry yet)"
-        # fleet median busy share per stage
-        by_stage: Dict[str, List[float]] = {}
-        for h in hosts.values():
-            for stage, share in h["attribution"]["bound"].items():
-                by_stage.setdefault(stage, []).append(share)
-        median: Dict[str, float] = {}
-        for stage, shares in by_stage.items():
-            s = sorted(shares)
-            mid = len(s) // 2
-            median[stage] = s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2
+        # fleet median busy share per stage (same rule drives shard
+        # handoff eligibility — see flagged_ranks)
+        median = _stage_medians([h["attribution"] for h in hosts.values()])
 
         def share_of(item):
             attr = item[1]["attribution"]
@@ -271,6 +443,110 @@ def push_once(tracker_uri: str, metrics_port: int, rank: int,
         _write_str(sock, payload)
         if _read_int(sock) != 0:
             raise ConnectionError("tracker rejected metrics push")
+
+
+class ShardClient:
+    """Worker-side handle on the tracker's shard board.
+
+    Every call is one metrics push whose payload carries a ``shard_req``
+    and reads the board's JSON reply after the ack — so each board
+    interaction ALSO refreshes this worker's snapshot on the tracker, and
+    the straggler flags that gate stealing are never staler than the last
+    claim.
+    """
+
+    def __init__(self, tracker_uri: str, metrics_port: int, rank: int,
+                 timeout: float = 10.0):
+        self.tracker_uri = tracker_uri
+        self.metrics_port = int(metrics_port)
+        self.rank = int(rank)
+        self.timeout = float(timeout)
+
+    def _call(self, req: dict) -> dict:
+        payload = json.dumps({
+            "rank": self.rank,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "restarted": False,
+            "snapshot": telemetry.snapshot(),
+            "shard_req": req,
+        })
+        with socket.create_connection((self.tracker_uri, self.metrics_port),
+                                      timeout=self.timeout) as sock:
+            sock.settimeout(self.timeout)
+            _write_int(sock, METRICS_MAGIC)
+            if _read_int(sock) != METRICS_MAGIC:
+                raise ConnectionError("metrics channel handshake failed")
+            _write_str(sock, payload)
+            if _read_int(sock) != 0:
+                raise ConnectionError("tracker rejected metrics push")
+            return json.loads(_read_str(sock))
+
+    def register(self, epoch: int, shards: List[int]) -> dict:
+        return self._call({"op": "register", "epoch": int(epoch),
+                           "shards": [int(s) for s in shards]})
+
+    def claim(self, epoch: int, shard: int) -> bool:
+        return bool(self._call({"op": "claim", "epoch": int(epoch),
+                                "shard": int(shard)}).get("ok"))
+
+    def steal(self, epoch: int) -> dict:
+        return self._call({"op": "steal", "epoch": int(epoch)})
+
+    def done(self, epoch: int, shard: int) -> dict:
+        return self._call({"op": "done", "epoch": int(epoch),
+                           "shard": int(shard)})
+
+
+def shard_client_from_env(rank: Optional[int] = None) -> Optional[ShardClient]:
+    """A :class:`ShardClient` from the tracker env contract, or None when
+    no metrics channel was negotiated (standalone runs)."""
+    port = os.environ.get(METRICS_PORT_ENV)
+    if not port:
+        return None
+    return ShardClient(
+        tracker_uri=os.environ.get("DMLC_TRACKER_URI", "127.0.0.1"),
+        metrics_port=int(port),
+        rank=_env_rank() if rank is None else int(rank))
+
+
+def coordinated_parts(epoch: int, shards: List[int], open_part,
+                      client: Optional[ShardClient], steal: bool = True,
+                      poll_s: float = 0.2):
+    """Drive one worker's epoch through the tracker shard board.
+
+    Yields whatever ``open_part(shard)`` yields, for (a) every owned shard
+    the board confirms, then (b) shards stolen from flagged hosts until the
+    epoch has no pending shards left.  With ``client=None`` (standalone)
+    it degrades to plain in-order iteration.  The epoch's job-wide
+    visitation is exactly-once by construction: every ``open_part`` call
+    here follows a successful serialized claim/steal (see ShardBoard).
+    """
+    if client is None:
+        for s in shards:
+            yield from open_part(int(s))
+        return
+    client.register(epoch, shards)
+    for s in shards:
+        if not client.claim(epoch, int(s)):
+            # handed off while this worker lagged; the thief parses it
+            telemetry.counter_add("shard.claim_denied", 1)
+            continue
+        yield from open_part(int(s))
+        client.done(epoch, int(s))
+    if not steal:
+        return
+    while True:
+        r = client.steal(epoch)
+        s = r.get("shard")
+        if s is not None:
+            telemetry.counter_add("shard.steal_gained", 1)
+            yield from open_part(int(s))
+            client.done(epoch, int(s))
+            continue
+        if int(r.get("pending", 0)) <= 0:
+            return  # every shard started somewhere; epoch complete
+        time.sleep(poll_s)  # owners still mid-claim; poll for flags
 
 
 class MetricsPusher:

@@ -145,8 +145,17 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # readers.
   DMLCTPU_FAULTS="shard.worker.chunk=err@0.02;seed=3" \
     python -m pytest tests/test_staging.py -x -q -m "not slow"
+
+  # Autotune tier: the whole staging suite with the stall-attribution
+  # controller armed and deciding every 4 batches.  Every epoch then runs
+  # live SetPoolKnobs retunes (worker growth/retire, buffer and chunk
+  # moves) against the sharded pool mid-stream; any pool deadlock hangs
+  # the suite and any stream perturbation fails the staging assertions —
+  # proving armed tuning is transparent to what the model sees.
+  DMLCTPU_AUTOTUNE=1 DMLCTPU_AUTOTUNE_WINDOW=4 \
+    python -m pytest tests/test_staging.py -x -q -m "not slow"
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier")
 echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"

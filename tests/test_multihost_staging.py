@@ -829,3 +829,83 @@ def test_two_process_tracker_metrics_aggregation(tmp_path):
             assert "dmlctpu_parse_rows_total" in text
     finally:
         agg.close()
+
+
+_SHARD_HANDOFF_CHILD = r"""
+import json, sys, time
+pid, _coord, mport, recfile = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
+                               sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.data import RecordStagingIter
+from dmlc_core_tpu.tracker.metrics import ShardClient, push_once
+
+client = ShardClient("127.0.0.1", int(mport), rank=pid)
+it = RecordStagingIter(recfile, records_cap=4, bytes_cap=512,
+                       part=pid, num_parts=2)
+if pid == 0:
+    # the straggler: report a restart (a persistent flag on the tracker,
+    # one of the handoff drivers) and parse each claimed shard slowly
+    push_once("127.0.0.1", int(mport), rank=0, restarted=True)
+else:
+    # let the straggler register its shard set before this worker can
+    # finish its own and reach the steal loop
+    time.sleep(0.5)
+
+ids, nrec = [], 0
+for w in it.host_batches_coordinated(epoch=7, client=client):
+    offs, n = w["offsets"], int(w["num_records"])
+    for k in range(n):
+        o = int(offs[k])
+        ids.append(int(w["bytes"][o]) * 256 + int(w["bytes"][o + 1]))
+    nrec += n
+    if pid == 0:
+        time.sleep(0.25)
+print("RESULT " + json.dumps({
+    "pid": pid, "records": nrec, "ids": sorted(ids),
+    "enabled": telemetry.enabled(),
+    "steals": telemetry.counter_get("shard.steal_gained"),
+    "denied": telemetry.counter_get("shard.claim_denied")}), flush=True)
+"""
+
+
+def test_two_process_straggler_shard_handoff(tmp_path):
+    """The work-stealing acceptance: two workers split one recordio file
+    via tracker-coordinated shard ownership; worker 0 is a flagged
+    straggler (restart-reported, 0.25 s per batch), worker 1 drains its own
+    shards and must steal >= 1 pending shard from worker 0 — and the UNION
+    of records parsed by the two workers must be the file's record set
+    exactly once (bit-identical total visitation through the handoff)."""
+    import sys as _sys
+    _sys.path.insert(0, str(REPO))
+    from dmlc_core_tpu.io import RecordIOWriter
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator
+
+    n_records = 200
+    f = tmp_path / "handoff.rec"
+    with RecordIOWriter(str(f)) as w:
+        for j in range(n_records):
+            # 2-byte unique id prefix so visitation is checkable per record
+            w.write(bytes([j // 256, j % 256]) + b"p" * (8 + j % 24))
+
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        results, _ = _run_two(_SHARD_HANDOFF_CHILD, str(agg.port), str(f),
+                              label="handoff process")
+        assert set(results) == {0, 1}
+        r0, r1 = results[0], results[1]
+        # exactly-once job-wide visitation, bit-identical record ids
+        assert r0["records"] + r1["records"] == n_records
+        assert sorted(r0["ids"] + r1["ids"]) == list(range(n_records))
+        # the flagged straggler lost at least one shard to the healthy host
+        view = agg.job_snapshot()
+        board = view["shards"]["7"]
+        assert board["pending"] == 0
+        assert len(board["stolen"]) >= 1, (board, r0, r1)
+        assert all(h["from"] == 0 and h["to"] == 1 for h in board["stolen"])
+        if r1["enabled"]:  # worker-side counters mirror the board
+            assert r1["steals"] == len(board["stolen"])
+        assert 0 in agg.flagged_ranks()  # the restart flag is persistent
+    finally:
+        agg.close()

@@ -228,6 +228,54 @@ def test_counters_delta_clamps_worker_restart():
     assert attr["restarted"] is True
 
 
+def test_window_measures_an_epoch(libsvm_file):
+    with telemetry.window() as w:
+        assert not w.closed and isinstance(w.before, dict)
+        assert drain(libsvm_file) == 2000
+    assert w.closed and w.wall_s > 0
+    assert w.restarted is False
+    assert w.attribution is not None and "bound_stage" in w.attribution
+    if telemetry.enabled():
+        assert w.delta["parse.rows"] == 2000
+        assert w.bytes_processed() > 0
+        assert w.mb_per_s() > 0
+    else:
+        assert w.delta == {} and w.mb_per_s() == 0.0
+
+
+def test_window_restart_mid_window_clamps_and_flags(monkeypatch):
+    # a worker restart mid-window re-registers counters from zero; the
+    # closed window must clamp the backwards deltas, raise the restarted
+    # flag, and carry it into the attribution so a consumer (the autotuner)
+    # can refuse the poisoned sample instead of acting on a garbage rate
+    snaps = iter([
+        {"counters": {"parse.rows": 1000, "parse.busy_us": 9_000_000,
+                      "h2d.busy_us": 50}},
+        {"counters": {"parse.rows": 40, "parse.busy_us": 70_000,
+                      "h2d.busy_us": 90}},
+    ])
+    monkeypatch.setattr(telemetry, "snapshot", lambda: next(snaps))
+    with telemetry.window() as w:
+        pass
+    assert w.restarted is True
+    assert w.delta["parse.rows"] == 0          # backwards counters clamp...
+    assert w.delta["parse.busy_us"] == 0
+    assert w.delta["h2d.busy_us"] == 40        # ...honest ones still count
+    assert w.attribution["restarted"] is True
+
+
+def test_stall_attribution_across_restart_keeps_surviving_stages():
+    # the clamped stage contributes nothing; attribution falls to whatever
+    # really moved in the interval instead of a giant negative artifact
+    before = {"counters": {"parse.busy_us": 5_000_000, "parse.rows": 100}}
+    after = {"counters": {"parse.busy_us": 1_000, "parse.rows": 2,
+                          "h2d.busy_us": 2_000_000}}
+    attr = telemetry.stall_attribution(before, after, wall_s=1.0)
+    assert attr["restarted"] is True
+    assert attr["stages"]["parse"]["busy_s"] == 0.0
+    assert attr["bound_stage"] == "h2d"
+
+
 def test_merge_snapshots_and_conservative_quantile():
     h_a = {"count": 1, "sum": 3, "buckets": [0] * 32}
     h_a["buckets"][2] = 1          # one observation of 3 (upper bound 4)

@@ -259,3 +259,107 @@ client.shutdown()
     assert proc.returncode == 0, proc.stderr
     ranks = sorted(p.name for p in out_dir.iterdir())
     assert ranks == ["rank-0", "rank-1", "rank-2"]
+
+
+# ---- tracker metrics: shard board + straggler flagging ----------------------
+
+def _pushed_host(parse_busy_us, pack_busy_us, h2d_busy_us,
+                 restarted=False, age_s=0.0):
+    """A host record in the shape _handle stores after a push."""
+    import time
+    return {"host": "h", "pid": 1, "restarted": restarted,
+            "last_update": time.time() - age_s,
+            "snapshot": {"counters": {"parse.busy_us": parse_busy_us,
+                                      "pack.busy_us": pack_busy_us,
+                                      "h2d.busy_us": h2d_busy_us}}}
+
+
+def test_flagged_ranks_median_rule_three_hosts():
+    """The straggler rule needs a fleet: a host whose bound-stage share is
+    >=1.5x the fleet median (and 10+ points above it) gets flagged; hosts
+    matching the median do not."""
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        # two healthy hosts: parse 40% / pack 30% / h2d 30%
+        agg._hosts[0] = _pushed_host(4_000_000, 3_000_000, 3_000_000)
+        agg._hosts[1] = _pushed_host(4_000_000, 3_000_000, 3_000_000)
+        # straggler: parse-bound at 80% (median stays 40)
+        agg._hosts[2] = _pushed_host(8_000_000, 1_000_000, 1_000_000)
+        assert agg.flagged_ranks() == {2}
+    finally:
+        agg.close()
+
+
+def test_flagged_ranks_restart_and_staleness():
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        agg._hosts[0] = _pushed_host(4_000_000, 3_000_000, 3_000_000)
+        agg._hosts[1] = _pushed_host(4_000_000, 3_000_000, 3_000_000,
+                                     restarted=True)
+        agg._hosts[2] = _pushed_host(4_000_000, 3_000_000, 3_000_000,
+                                     age_s=120.0)
+        assert agg.flagged_ranks(stale_s=30.0) == {1, 2}
+    finally:
+        agg.close()
+
+
+def test_shard_board_claim_steal_visitation():
+    """Started shards are never reassigned; steals only take pending shards
+    of flagged owners; the epoch ends with every shard started exactly
+    once."""
+    from dmlc_core_tpu.tracker.metrics import ShardBoard
+    b = ShardBoard()
+    b.register(0, 5, [0, 1, 2])
+    b.register(1, 5, [3, 4, 5])
+    assert b.claim(0, 5, 0)["ok"]
+    got = b.steal(1, 5, flagged={0})
+    assert got["shard"] in (1, 2) and got["from"] == 0
+    # the stolen (started-by-1) shard is gone for rank 0
+    assert not b.claim(0, 5, got["shard"])["ok"]
+    second = b.steal(1, 5, flagged={0})
+    assert second["shard"] in (1, 2) and second["shard"] != got["shard"]
+    assert b.steal(1, 5, flagged={0})["shard"] is None  # nothing pending
+    # a restarted owner may re-claim a shard it itself started
+    assert b.claim(0, 5, 0)["ok"]
+    for s in (3, 4, 5):
+        assert b.claim(1, 5, s)["ok"]
+    for rank, s in ((0, 0), (1, got["shard"]), (1, second["shard"]),
+                    (1, 3), (1, 4), (1, 5)):
+        b.done(rank, 5, s)
+    st = b.state()["5"]
+    assert st["pending"] == 0 and st["started"] == 6 and st["done"] == 6
+    assert [h["shard"] for h in st["stolen"]] == [got["shard"],
+                                                 second["shard"]]
+
+
+def test_shard_board_keeps_newest_epochs():
+    from dmlc_core_tpu.tracker.metrics import ShardBoard
+    b = ShardBoard(keep_epochs=2)
+    for e in range(4):
+        b.register(0, e, [0])
+    assert sorted(b.state()) == ["2", "3"]
+
+
+def test_shard_client_wire_roundtrip():
+    """The shard_req extension rides one metrics push: ack first (classic
+    protocol untouched), then the board's JSON reply."""
+    from dmlc_core_tpu.tracker.metrics import (MetricsAggregator,
+                                               ShardClient)
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        c0 = ShardClient("127.0.0.1", agg.port, rank=0)
+        c1 = ShardClient("127.0.0.1", agg.port, rank=1)
+        assert c0.register(0, [0, 1])["ok"]
+        assert c1.register(0, [2])["ok"]
+        assert c0.claim(0, 0)
+        assert not c1.claim(0, 0)       # started by rank 0 -> denied
+        agg._hosts[0]["restarted"] = True  # flag rank 0 for the steal
+        got = c1.steal(0)
+        assert got["shard"] == 1 and got["from"] == 0
+        c1.done(0, 1)
+        snap = agg.job_snapshot()
+        assert snap["shards"]["0"]["stolen"][0]["to"] == 1
+    finally:
+        agg.close()
