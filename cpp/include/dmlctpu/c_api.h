@@ -269,6 +269,71 @@ int DmlcTpuFsListDirectory(const char* uri, int recursive, const char** out);
 /* single-path stat into the same format (one line) */
 int DmlcTpuFsPathInfo(const char* uri, const char** out);
 
+/* ---- binned epoch cache (cpp/src/data/binned_cache.h) -------------------
+ * Quantized columnar cache: opaque per-virtual-part block records behind a
+ * self-describing header (meta JSON + part map), RecordIO framed.  The
+ * Python layer (dmlc_core_tpu/data/binned_cache.py) packs/unpacks block
+ * payloads and owns content-level invalidation; this API owns framing,
+ * crash-consistent header patching, per-part seeks, and recover mode. */
+typedef void* DmlcTpuBinnedCacheWriterHandle;
+typedef void* DmlcTpuBinnedCacheReaderHandle;
+int DmlcTpuBinnedCacheWriterCreate(const char* uri, const char* meta_json,
+                                   DmlcTpuBinnedCacheWriterHandle* out);
+/* append one block for virtual part part_id; rows/nnz are accounting for
+ * the part map (readers validate per-part completeness against them) */
+int DmlcTpuBinnedCacheWriterWriteBlock(DmlcTpuBinnedCacheWriterHandle handle,
+                                       uint32_t part_id, uint64_t rows,
+                                       uint64_t nnz, const void* data,
+                                       uint64_t size);
+/* install finalized quantile cuts (f32 [num_features, num_cuts] row-major)
+ * so WriteRaw can compute bin codes natively during the build pass */
+int DmlcTpuBinnedCacheWriterSetCuts(DmlcTpuBinnedCacheWriterHandle handle,
+                                    const float* cuts, uint64_t num_features,
+                                    uint64_t num_cuts);
+/* bin + pack + append one block from raw CSR arrays (label/weight f32[rows],
+ * row_ptr i32[rows+1], index i32[nnz], value f32[nnz]; qid i32[rows] or
+ * NULL).  Bin codes replicate QuantileBinner.transform_entries bit-exactly;
+ * the presence mask is (v != 0) && !isnan(v). */
+int DmlcTpuBinnedCacheWriterWriteRaw(DmlcTpuBinnedCacheWriterHandle handle,
+                                     uint32_t part_id, uint32_t seq,
+                                     uint64_t rows, uint64_t nnz,
+                                     const float* label, const float* weight,
+                                     const int32_t* row_ptr,
+                                     const int32_t* index, const float* value,
+                                     const int32_t* qid);
+/* write the part map and patch the header sentinels (LAST, so a crash
+ * before this leaves an invalid cache that readers reject) */
+int DmlcTpuBinnedCacheWriterClose(DmlcTpuBinnedCacheWriterHandle handle);
+void DmlcTpuBinnedCacheWriterFree(DmlcTpuBinnedCacheWriterHandle handle);
+/* open never fails on a bad cache: *out is a handle whose Valid reports 0
+ * and whose Error says why (missing/torn/truncated/version skew).
+ * recover != 0 resyncs past corrupt block spans (record.corrupt_skipped). */
+int DmlcTpuBinnedCacheReaderCreate(const char* uri, int recover,
+                                   DmlcTpuBinnedCacheReaderHandle* out);
+int DmlcTpuBinnedCacheReaderValid(DmlcTpuBinnedCacheReaderHandle handle,
+                                  int* out);
+/* 1 when no file existed at all (first build, not a rebuild) */
+int DmlcTpuBinnedCacheReaderMissing(DmlcTpuBinnedCacheReaderHandle handle,
+                                    int* out);
+/* why Valid == 0; pointer valid while the handle lives */
+int DmlcTpuBinnedCacheReaderError(DmlcTpuBinnedCacheReaderHandle handle,
+                                  const char** out);
+int DmlcTpuBinnedCacheReaderMetaJson(DmlcTpuBinnedCacheReaderHandle handle,
+                                     const char** out);
+int DmlcTpuBinnedCacheReaderPartMapJson(DmlcTpuBinnedCacheReaderHandle handle,
+                                        const char** out);
+/* next block record: 1 = *data/*size borrowed until the next call on this
+ * handle, 0 = end of blocks, -1 = error */
+int DmlcTpuBinnedCacheReaderNextBlock(DmlcTpuBinnedCacheReaderHandle handle,
+                                      const void** data, uint64_t* size);
+/* jump the block cursor to a part's first-record offset (part map) */
+int DmlcTpuBinnedCacheReaderSeekTo(DmlcTpuBinnedCacheReaderHandle handle,
+                                   uint64_t offset);
+int DmlcTpuBinnedCacheReaderBeforeFirst(DmlcTpuBinnedCacheReaderHandle handle);
+int64_t DmlcTpuBinnedCacheReaderCorruptSkipped(
+    DmlcTpuBinnedCacheReaderHandle handle);
+void DmlcTpuBinnedCacheReaderFree(DmlcTpuBinnedCacheReaderHandle handle);
+
 /* ---- telemetry (dmlctpu/telemetry.h) ------------------------------------- */
 /* *out = 1 when telemetry was compiled in (DMLCTPU_TELEMETRY=1), else 0.
  * With it compiled out every call below degrades to a cheap no-op:

@@ -401,6 +401,13 @@ DMLCTPU_STAGE_COUNTER(IoRetryWaitUs, "io.retry_wait_us")
 DMLCTPU_STAGE_COUNTER(RecordCorruptSkipped, "record.corrupt_skipped")
 DMLCTPU_STAGE_COUNTER(ShardPartRetries, "shard.part_retries")
 DMLCTPU_STAGE_COUNTER(FaultInjected, "fault.injected")
+// Epoch caches (binned_cache.h writer/reader + DiskRowIter validation):
+// bytes written during a build pass, bytes served from cache hits, and
+// caches rejected by validation (truncated/torn/stale header) — a rebuild
+// storm shows up in /metrics and the job table instead of only TLOG lines.
+DMLCTPU_STAGE_COUNTER(CacheBuildBytes, "cache.build_bytes")
+DMLCTPU_STAGE_COUNTER(CacheHitBytes, "cache.hit_bytes")
+DMLCTPU_STAGE_COUNTER(CacheRebuilds, "cache.rebuilds")
 
 #undef DMLCTPU_STAGE_COUNTER
 #undef DMLCTPU_STAGE_GAUGE

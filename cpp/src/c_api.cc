@@ -7,6 +7,7 @@
 #include <string>
 #include <vector>
 
+#include "./data/binned_cache.h"
 #include "./data/record_batcher.h"
 #include "./data/sharded_parser.h"
 #include "./data/staged_batcher.h"
@@ -67,6 +68,13 @@ struct RecordBatcherCtx {
   dmlctpu::data::RecordBatch* borrowed = nullptr;
   uint64_t records_cap = 0;
   uint64_t bytes_cap = 0;
+};
+struct BinnedCacheWriterCtx {
+  std::unique_ptr<dmlctpu::data::BinnedCacheWriter> writer;
+};
+struct BinnedCacheReaderCtx {
+  std::unique_ptr<dmlctpu::data::BinnedCacheReader> reader;
+  std::string block;  // backs the borrowed NextBlock view until the next call
 };
 
 // num_workers > 1 → parallel sharded parse pool; num_workers < 0 → sharded
@@ -473,6 +481,161 @@ int DmlcTpuFsPathInfo(const char* uri, const char** out) {
     *out = fs_listing.c_str();
     return 0;
   });
+}
+
+int DmlcTpuBinnedCacheWriterCreate(const char* uri, const char* meta_json,
+                                   DmlcTpuBinnedCacheWriterHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<BinnedCacheWriterCtx>();
+    ctx->writer = std::make_unique<dmlctpu::data::BinnedCacheWriter>(
+        uri, meta_json != nullptr ? meta_json : "");
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheWriterWriteBlock(DmlcTpuBinnedCacheWriterHandle handle,
+                                       uint32_t part_id, uint64_t rows,
+                                       uint64_t nnz, const void* data,
+                                       uint64_t size) {
+  return Guard([&] {
+    auto* ctx = static_cast<BinnedCacheWriterCtx*>(handle);
+    ctx->writer->WriteBlock(part_id, rows, nnz, data,
+                            static_cast<size_t>(size));
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheWriterSetCuts(DmlcTpuBinnedCacheWriterHandle handle,
+                                    const float* cuts, uint64_t num_features,
+                                    uint64_t num_cuts) {
+  return Guard([&] {
+    static_cast<BinnedCacheWriterCtx*>(handle)->writer->SetCuts(
+        cuts, num_features, num_cuts);
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheWriterWriteRaw(DmlcTpuBinnedCacheWriterHandle handle,
+                                     uint32_t part_id, uint32_t seq,
+                                     uint64_t rows, uint64_t nnz,
+                                     const float* label, const float* weight,
+                                     const int32_t* row_ptr,
+                                     const int32_t* index, const float* value,
+                                     const int32_t* qid) {
+  return Guard([&] {
+    static_cast<BinnedCacheWriterCtx*>(handle)->writer->WriteRawBlock(
+        part_id, seq, rows, nnz, label, weight, row_ptr, index, value, qid);
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheWriterClose(DmlcTpuBinnedCacheWriterHandle handle) {
+  return Guard([&] {
+    static_cast<BinnedCacheWriterCtx*>(handle)->writer->Close();
+    return 0;
+  });
+}
+
+void DmlcTpuBinnedCacheWriterFree(DmlcTpuBinnedCacheWriterHandle handle) {
+  delete static_cast<BinnedCacheWriterCtx*>(handle);
+}
+
+int DmlcTpuBinnedCacheReaderCreate(const char* uri, int recover,
+                                   DmlcTpuBinnedCacheReaderHandle* out) {
+  return Guard([&] {
+    auto ctx = std::make_unique<BinnedCacheReaderCtx>();
+    ctx->reader = std::make_unique<dmlctpu::data::BinnedCacheReader>(
+        uri, recover != 0);
+    *out = ctx.release();
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderValid(DmlcTpuBinnedCacheReaderHandle handle,
+                                  int* out) {
+  return Guard([&] {
+    *out = static_cast<BinnedCacheReaderCtx*>(handle)->reader->valid() ? 1 : 0;
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderMissing(DmlcTpuBinnedCacheReaderHandle handle,
+                                    int* out) {
+  return Guard([&] {
+    *out =
+        static_cast<BinnedCacheReaderCtx*>(handle)->reader->missing() ? 1 : 0;
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderError(DmlcTpuBinnedCacheReaderHandle handle,
+                                  const char** out) {
+  return Guard([&] {
+    *out = static_cast<BinnedCacheReaderCtx*>(handle)->reader->error().c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderMetaJson(DmlcTpuBinnedCacheReaderHandle handle,
+                                     const char** out) {
+  return Guard([&] {
+    *out =
+        static_cast<BinnedCacheReaderCtx*>(handle)->reader->meta_json().c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderPartMapJson(DmlcTpuBinnedCacheReaderHandle handle,
+                                        const char** out) {
+  return Guard([&] {
+    *out = static_cast<BinnedCacheReaderCtx*>(handle)
+               ->reader->part_map_json()
+               .c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderNextBlock(DmlcTpuBinnedCacheReaderHandle handle,
+                                      const void** data, uint64_t* size) {
+  return Guard([&] {
+    auto* ctx = static_cast<BinnedCacheReaderCtx*>(handle);
+    if (!ctx->reader->NextBlock(&ctx->block)) return 0;
+    *data = ctx->block.data();
+    *size = static_cast<uint64_t>(ctx->block.size());
+    return 1;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderSeekTo(DmlcTpuBinnedCacheReaderHandle handle,
+                                   uint64_t offset) {
+  return Guard([&] {
+    static_cast<BinnedCacheReaderCtx*>(handle)->reader->SeekTo(offset);
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderBeforeFirst(
+    DmlcTpuBinnedCacheReaderHandle handle) {
+  return Guard([&] {
+    static_cast<BinnedCacheReaderCtx*>(handle)->reader->BeforeFirst();
+    return 0;
+  });
+}
+
+int64_t DmlcTpuBinnedCacheReaderCorruptSkipped(
+    DmlcTpuBinnedCacheReaderHandle handle) {
+  int64_t got = -1;
+  int rc = Guard([&] {
+    got = static_cast<int64_t>(
+        static_cast<BinnedCacheReaderCtx*>(handle)->reader->corrupt_skipped());
+    return 0;
+  });
+  return rc == 0 ? got : -1;
+}
+
+void DmlcTpuBinnedCacheReaderFree(DmlcTpuBinnedCacheReaderHandle handle) {
+  delete static_cast<BinnedCacheReaderCtx*>(handle);
 }
 
 int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,

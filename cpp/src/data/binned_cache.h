@@ -1,0 +1,419 @@
+// binned_cache.h — quantized columnar epoch cache (ROADMAP item 4): parse
+// text once, stream uint8 bin ids forever.
+//
+// The first epoch parses + bins rows and writes them through
+// BinnedCacheWriter; every later epoch streams the cache back through
+// BinnedCacheReader, bypassing text parse and binning entirely.  Layout:
+//
+//   [u64 magic][u64 version][u64 total_bytes][u64 part_map_offset]
+//   [u64 meta_len][meta JSON bytes]
+//   <RecordIO block records, grouped by virtual part id>
+//   <RecordIO part-map JSON record>           (at part_map_offset)
+//
+// total_bytes / part_map_offset are written as kPayloadUnknown sentinels
+// before the build pass and patched LAST (same crash-consistency discipline
+// as DiskRowIter::BuildCache): a build cut short — crash, ENOSPC, the
+// cache.write.short fault point — leaves the sentinels in place and the
+// next open reports invalid, so the caller rebuilds instead of serving a
+// torn cache.  total_bytes is additionally validated against the file size
+// on disk, catching truncated copies of an intact build.
+//
+// Block payload bytes are opaque here (the Python layer packs
+// label/weight/row_ptr/index/ebin/emask columns — see
+// dmlc_core_tpu/data/binned_cache.py); this layer owns framing, the part
+// map {part id -> first-record offset, record/row/nnz counts} that lets a
+// ShardBoard thief seek straight to a stolen part, RecordIO recover-mode
+// resync past corrupt spans, and the cache.build_bytes / cache.hit_bytes
+// telemetry.
+#ifndef DMLCTPU_SRC_DATA_BINNED_CACHE_H_
+#define DMLCTPU_SRC_DATA_BINNED_CACHE_H_
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "dmlctpu/endian.h"
+#include "dmlctpu/fault.h"
+#include "dmlctpu/io/filesystem.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/recordio.h"
+#include "dmlctpu/stream.h"
+#include "dmlctpu/telemetry.h"
+
+namespace dmlctpu {
+namespace data {
+
+constexpr uint64_t kBinnedCacheMagic = 0x68636e6962757074ull;  // "tpubinch"
+constexpr uint64_t kBinnedCacheVersion = 1;
+constexpr uint64_t kBinnedCachePayloadUnknown = ~0ull;
+
+/*! \brief fixed per-block prefix inside every block record's payload.
+ *  The column arrays follow back-to-back in this order:
+ *    f32 label[num_rows] | f32 weight[num_rows] | i32 row_ptr[num_rows+1]
+ *    | i32 qid[num_rows] (iff flags bit 0) | i32 index[nnz]
+ *    | u8 ebin[nnz] | u8 emask_bits[(nnz+7)/8]
+ *  Raw host-endian arrays (the cache is a same-machine artifact; the
+ *  Python meta records byte order and a foreign-endian open rebuilds). */
+struct BinnedBlockHeader {
+  uint32_t part_id = 0;
+  uint32_t seq = 0;
+  uint64_t num_rows = 0;
+  uint64_t nnz = 0;
+  uint32_t flags = 0;  // bit 0: qid column present
+  uint32_t pad0 = 0;
+};
+static_assert(sizeof(BinnedBlockHeader) == 32, "block header layout");
+
+/*! \brief exact replica of QuantileBinner.transform_entries (gbdt.py): a
+ *  fixed-round binary search equal to searchsorted(cuts, v, side="right"),
+ *  +1 for the reserved missing bin; NaN maps to 0.  Bit-identical to the
+ *  jax path because every step is an exact f32 comparison. */
+inline uint8_t BinEntryCode(const float* cuts, uint32_t num_cuts, float v) {
+  if (std::isnan(v)) return 0;
+  uint32_t lo = 0, hi = num_cuts;
+  while (lo < hi) {
+    uint32_t mid = (lo + hi) / 2;
+    if (cuts[mid] <= v) {
+      lo = mid + 1;
+    } else {
+      hi = mid;
+    }
+  }
+  return static_cast<uint8_t>(lo + 1);
+}
+
+/*! \brief write-through wrapper counting bytes that reach the inner stream.
+ *  RecordIO escapes in-payload magic words (the framed size is
+ *  data-dependent), so part offsets must count what was actually written,
+ *  not a formula over payload sizes. */
+class ByteCountingStream : public Stream {
+ public:
+  ByteCountingStream(Stream* inner, uint64_t* count)
+      : inner_(inner), count_(count) {}
+  size_t Read(void* ptr, size_t size) override {
+    return inner_->Read(ptr, size);
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    size_t n = inner_->Write(ptr, size);
+    *count_ += n;
+    return n;
+  }
+
+ private:
+  Stream* inner_;
+  uint64_t* count_;
+};
+
+/*! \brief Streaming writer for the binned epoch cache.
+ *
+ *  WriteBlock appends one opaque block record and files it under its
+ *  virtual part id; Close writes the part-map record and then patches the
+ *  header's total_bytes/part_map_offset sentinels as the LAST operation.
+ *  Blocks for one part need not be contiguous in call order, but the part
+ *  map records only each part's FIRST record offset — per-part seeks
+ *  assume the builder writes each part's records consecutively (the
+ *  Python build loop drives parts in order, so this holds).
+ */
+class BinnedCacheWriter {
+ public:
+  BinnedCacheWriter(const std::string& uri, const std::string& meta_json)
+      : uri_(uri) {
+    stream_ = Stream::Create(uri.c_str(), "w");
+    uint64_t header[5] = {kBinnedCacheMagic, kBinnedCacheVersion,
+                          kBinnedCachePayloadUnknown,
+                          kBinnedCachePayloadUnknown,
+                          static_cast<uint64_t>(meta_json.size())};
+    for (uint64_t v : header) stream_->WriteObj(v);
+    stream_->Write(meta_json.data(), meta_json.size());
+    cursor_ = 5 * sizeof(uint64_t) + meta_json.size();
+    counting_ = std::make_unique<ByteCountingStream>(stream_.get(), &cursor_);
+    writer_ = std::make_unique<RecordIOWriter>(counting_.get());
+  }
+
+  ~BinnedCacheWriter() {
+    // destructor never patches: an unclosed writer leaves the sentinel
+    // header in place so the torn cache reads as invalid
+    stream_.reset();
+  }
+
+  /*! \brief Append one block for virtual part \p part_id.
+   *  \p rows / \p nnz are accounting only (surfaced in the part map so
+   *  readers can validate per-part completeness without decoding blocks).
+   */
+  void WriteBlock(uint32_t part_id, uint64_t rows, uint64_t nnz,
+                  const void* data, size_t size) {
+    TCHECK(stream_ != nullptr) << "BinnedCacheWriter already closed";
+    DMLCTPU_FAULT_POINT(fp_short, "cache.write.short");
+    if (fp_short.Fire() != fault::Mode::kNone) {
+      // simulate a crash mid-frame: half the payload lands with no record
+      // framing completed, then the handle dies with the header sentinel
+      // still in place — exactly what a power cut mid-build leaves behind
+      stream_->Write(data, size / 2);
+      stream_.reset();
+      throw Error("injected cache.write.short: cache build truncated at "
+                  "part " + std::to_string(part_id));
+    }
+    uint64_t offset = cursor_;
+    writer_->WriteRecord(data, size);  // counting_ advances cursor_
+    auto& e = parts_[part_id];
+    if (e.records == 0) e.offset = offset;
+    e.records += 1;
+    e.rows += rows;
+    e.nnz += nnz;
+    telemetry::stage::CacheBuildBytes().Add(static_cast<int64_t>(size));
+  }
+
+  /*! \brief Install the finalized quantile cuts (f32 [num_features,
+   *  num_cuts], row-major) so WriteRawBlock can bin natively. */
+  void SetCuts(const float* cuts, uint64_t num_features, uint64_t num_cuts) {
+    cuts_.assign(cuts, cuts + num_features * num_cuts);
+    num_features_ = num_features;
+    num_cuts_ = static_cast<uint32_t>(num_cuts);
+  }
+
+  /*! \brief Bin + pack + append one block from raw CSR arrays.
+   *
+   *  The hot path of the build epoch: computes per-entry bin codes
+   *  (BinEntryCode — bit-identical to QuantileBinner.transform_entries)
+   *  and presence masks ((v != 0) && !isnan(v), the _entry_arrays rule)
+   *  in one tight native pass, so the Python build loop never touches
+   *  per-entry data.  \p qid may be null (flags bit 0 cleared). */
+  void WriteRawBlock(uint32_t part_id, uint32_t seq, uint64_t num_rows,
+                     uint64_t nnz, const float* label, const float* weight,
+                     const int32_t* row_ptr, const int32_t* index,
+                     const float* value, const int32_t* qid) {
+    TCHECK(!cuts_.empty()) << "WriteRawBlock before SetCuts";
+    BinnedBlockHeader hdr;
+    hdr.part_id = part_id;
+    hdr.seq = seq;
+    hdr.num_rows = num_rows;
+    hdr.nnz = nnz;
+    hdr.flags = qid != nullptr ? 1u : 0u;
+    size_t mask_bytes = (nnz + 7) / 8;
+    size_t total = sizeof(hdr) + num_rows * 4 * 2 + (num_rows + 1) * 4 +
+                   (qid != nullptr ? num_rows * 4 : 0) + nnz * 4 + nnz +
+                   mask_bytes;
+    pack_buf_.resize(total);
+    char* p = pack_buf_.data();
+    auto put = [&p](const void* src, size_t n) {
+      std::memcpy(p, src, n);
+      p += n;
+    };
+    put(&hdr, sizeof(hdr));
+    put(label, num_rows * 4);
+    put(weight, num_rows * 4);
+    put(row_ptr, (num_rows + 1) * 4);
+    if (qid != nullptr) put(qid, num_rows * 4);
+    put(index, nnz * 4);
+    uint8_t* ebin = reinterpret_cast<uint8_t*>(p);
+    uint8_t* mask = ebin + nnz;
+    std::memset(mask, 0, mask_bytes);
+    const float* cuts = cuts_.data();
+    const uint32_t C = num_cuts_;
+    const int64_t F = static_cast<int64_t>(num_features_);
+    for (uint64_t k = 0; k < nnz; ++k) {
+      int64_t fi = index[k];
+      float v = value[k];
+      // stray indices bin against feature 0 (inert: their emask bit stays
+      // meaningful and the trainer masks by index range anyway)
+      const float* row =
+          cuts + (fi >= 0 && fi < F ? fi : 0) * static_cast<int64_t>(C);
+      ebin[k] = BinEntryCode(row, C, v);
+      if (v != 0.0f && !std::isnan(v)) mask[k / 8] |= uint8_t(1u << (k % 8));
+    }
+    WriteBlock(part_id, num_rows, nnz, pack_buf_.data(), total);
+  }
+
+  /*! \brief Write the part map, close the stream, patch the header. */
+  void Close() {
+    TCHECK(stream_ != nullptr) << "BinnedCacheWriter already closed";
+    uint64_t part_map_offset = cursor_;
+    writer_->WriteRecord(PartMapJson());
+    writer_.reset();
+    counting_.reset();
+    stream_->Close();  // surface ENOSPC/flush failures here, not in ~Stream
+    stream_.reset();
+    // patch total_bytes + part_map_offset LAST: any earlier failure leaves
+    // the sentinels and the next open rebuilds (DiskRowIter discipline)
+    std::FILE* fp = std::fopen(uri_.c_str(), "r+b");
+    TCHECK(fp != nullptr) << "cannot reopen " << uri_ << " to patch header";
+    std::fseek(fp, 0, SEEK_END);
+    uint64_t patched[2] = {static_cast<uint64_t>(std::ftell(fp)),
+                           part_map_offset};
+    if (kIONeedsByteSwap) ByteSwap(patched, sizeof(patched[0]), 2);
+    std::fseek(fp, 2 * sizeof(uint64_t), SEEK_SET);
+    std::fwrite(patched, sizeof(patched[0]), 2, fp);
+    std::fclose(fp);
+  }
+
+  std::string PartMapJson() const {
+    std::string out = "{\"parts\":[";
+    bool first = true;
+    for (const auto& kv : parts_) {
+      if (!first) out += ",";
+      first = false;
+      out += "{\"id\":" + std::to_string(kv.first) +
+             ",\"offset\":" + std::to_string(kv.second.offset) +
+             ",\"records\":" + std::to_string(kv.second.records) +
+             ",\"rows\":" + std::to_string(kv.second.rows) +
+             ",\"nnz\":" + std::to_string(kv.second.nnz) + "}";
+    }
+    out += "]}";
+    return out;
+  }
+
+ private:
+  struct PartEntry {
+    uint64_t offset = 0;
+    uint64_t records = 0;
+    uint64_t rows = 0;
+    uint64_t nnz = 0;
+  };
+
+  std::string uri_;
+  std::unique_ptr<Stream> stream_;
+  std::unique_ptr<ByteCountingStream> counting_;
+  std::unique_ptr<RecordIOWriter> writer_;
+  uint64_t cursor_ = 0;
+  std::map<uint32_t, PartEntry> parts_;  // ordered: part map sorted by id
+  std::vector<float> cuts_;              // flat [num_features_, num_cuts_]
+  uint64_t num_features_ = 0;
+  uint32_t num_cuts_ = 0;
+  std::string pack_buf_;  // reused across WriteRawBlock calls
+};
+
+/*! \brief Reader/validator for the binned epoch cache.
+ *
+ *  Construction never throws on a bad cache: valid() turns false and
+ *  error() says why (missing file, foreign magic, version skew, sentinel
+ *  header from a torn build, size mismatch from truncation, unreadable
+ *  part map) so the caller can count a rebuild and re-run the build pass.
+ *  Content-level invalidation — binner config, cuts digest, source bytes —
+ *  is the caller's job via meta_json().
+ */
+class BinnedCacheReader {
+ public:
+  explicit BinnedCacheReader(const std::string& uri, bool recover = false)
+      : uri_(uri), recover_(recover) {
+    fi_ = SeekStream::CreateForRead(uri.c_str(), /*allow_null=*/true);
+    if (fi_ == nullptr) {
+      missing_ = true;
+      error_ = "cache missing: " + uri;
+      return;
+    }
+    uint64_t magic = 0, version = 0, meta_len = 0;
+    if (!fi_->ReadObj(&magic) || magic != kBinnedCacheMagic) {
+      error_ = "not a binned cache (bad magic): " + uri;
+      return;
+    }
+    if (!fi_->ReadObj(&version) || version != kBinnedCacheVersion) {
+      error_ = "binned cache version skew (" + std::to_string(version) +
+               " != " + std::to_string(kBinnedCacheVersion) + "): " + uri;
+      return;
+    }
+    if (!fi_->ReadObj(&total_bytes_) || !fi_->ReadObj(&part_map_offset_) ||
+        !fi_->ReadObj(&meta_len)) {
+      error_ = "binned cache header short read: " + uri;
+      return;
+    }
+    io::URI parsed(uri.c_str());
+    uint64_t actual = static_cast<uint64_t>(
+        io::FileSystem::GetInstance(parsed)->GetPathInfo(parsed).size);
+    if (total_bytes_ == kBinnedCachePayloadUnknown ||
+        part_map_offset_ == kBinnedCachePayloadUnknown ||
+        total_bytes_ != actual) {
+      error_ = "binned cache " + uri + " is truncated or torn (" +
+               std::to_string(actual) + " bytes on disk, header promises " +
+               (total_bytes_ == kBinnedCachePayloadUnknown
+                    ? std::string("<unfinished build>")
+                    : std::to_string(total_bytes_)) + ")";
+      return;
+    }
+    meta_json_.resize(meta_len);
+    for (uint64_t got = 0; got < meta_len;) {
+      size_t n = fi_->Read(meta_json_.data() + got, meta_len - got);
+      if (n == 0) {
+        error_ = "binned cache meta short read: " + uri;
+        return;
+      }
+      got += n;
+    }
+    data_begin_ = 5 * sizeof(uint64_t) + meta_len;
+    if (part_map_offset_ < data_begin_ || part_map_offset_ > total_bytes_) {
+      error_ = "binned cache part map offset out of range: " + uri;
+      return;
+    }
+    // the part map is load-bearing for per-part seeks: read it strictly
+    // (never recover) so a corrupt map invalidates the whole cache
+    fi_->Seek(part_map_offset_);
+    RecordIOReader map_reader(fi_.get(), /*recover=*/false);
+    if (!map_reader.NextRecord(&part_map_json_)) {
+      error_ = "binned cache part map unreadable: " + uri;
+      return;
+    }
+    valid_ = true;
+    BeforeFirst();
+  }
+
+  bool valid() const { return valid_; }
+  /*! \brief true when there was no file at all (first build, not a rebuild) */
+  bool missing() const { return missing_; }
+  const std::string& error() const { return error_; }
+  const std::string& meta_json() const { return meta_json_; }
+  const std::string& part_map_json() const { return part_map_json_; }
+
+  void BeforeFirst() {
+    if (!valid_) return;
+    fi_->Seek(data_begin_);
+    reader_ = std::make_unique<RecordIOReader>(fi_.get(), recover_);
+  }
+
+  /*! \brief Seek the block cursor to an absolute record offset (a part's
+   *  first-record offset from the part map: the thief's read path). */
+  void SeekTo(uint64_t offset) {
+    TCHECK(valid_) << "SeekTo on an invalid cache: " << error_;
+    TCHECK(offset >= data_begin_ && offset < part_map_offset_)
+        << "block offset " << offset << " outside the data region ["
+        << data_begin_ << ", " << part_map_offset_ << ")";
+    fi_->Seek(offset);
+    reader_ = std::make_unique<RecordIOReader>(fi_.get(), recover_);
+  }
+
+  /*! \brief Next block record; false at the part-map boundary / EOF.
+   *  In recover mode corrupt spans are resynced past (counted in
+   *  corrupt_skipped + record.corrupt_skipped) and the caller's per-part
+   *  record accounting detects the loss. */
+  bool NextBlock(std::string* out) {
+    if (!valid_ || fi_->Tell() >= part_map_offset_) return false;
+    if (!reader_->NextRecord(out)) return false;
+    telemetry::stage::CacheHitBytes().Add(static_cast<int64_t>(out->size()));
+    return true;
+  }
+
+  uint64_t corrupt_skipped() const {
+    return reader_ != nullptr ? reader_->corrupt_skipped() : 0;
+  }
+
+ private:
+  std::string uri_;
+  bool recover_ = false;
+  bool valid_ = false;
+  bool missing_ = false;
+  std::string error_;
+  std::string meta_json_;
+  std::string part_map_json_;
+  std::unique_ptr<SeekStream> fi_;
+  std::unique_ptr<RecordIOReader> reader_;
+  uint64_t total_bytes_ = 0;
+  uint64_t part_map_offset_ = 0;
+  uint64_t data_begin_ = 0;
+};
+
+}  // namespace data
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_BINNED_CACHE_H_

@@ -14,6 +14,7 @@
 #include "dmlctpu/io/filesystem.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/stream.h"
+#include "dmlctpu/telemetry.h"
 #include "dmlctpu/threaded_iter.h"
 #include "dmlctpu/timer.h"
 
@@ -56,6 +57,9 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
     uint64_t magic, ncol, payload;
     if (!fi->ReadObj(&magic) || magic != kCacheMagic || !fi->ReadObj(&ncol) ||
         !fi->ReadObj(&payload)) {
+      // the file EXISTS but is not a readable cache header (foreign or
+      // stale format): that is a rebuild, not a first build — count it
+      telemetry::stage::CacheRebuilds().Add(1);
       return false;
     }
     // validate the recorded payload size against the file on disk: a build
@@ -65,6 +69,10 @@ class DiskRowIter : public RowBlockIter<IndexType, DType> {
     io::URI uri(cache_file_.c_str());
     size_t actual = io::FileSystem::GetInstance(uri)->GetPathInfo(uri).size;
     if (payload == kPayloadUnknown || header_end + payload != actual) {
+      // count the rejection process-wide: the TLOG line reaches the log
+      // sink, the counter reaches /metrics and the job table, so a rebuild
+      // storm is visible without scraping logs
+      telemetry::stage::CacheRebuilds().Add(1);
       TLOG(Warning) << "cache " << cache_file_ << " is truncated or stale ("
                     << actual << " bytes on disk, header promises "
                     << header_end << "+" << payload << "); rebuilding";

@@ -4,11 +4,15 @@
 // BOM, CRLF, NOEOL, plus RowBlockIter (in-memory and disk-cached) and
 // multi-rank parser union.
 #include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
 #include <map>
 #include <set>
 #include <string>
 #include <vector>
 
+#include "../src/data/binned_cache.h"
 #include "../src/data/libsvm_parser.h"
 #include "../src/data/record_batcher.h"
 #include "../src/data/sharded_parser.h"
@@ -845,8 +849,10 @@ TESTCASE(record_batcher_recover_skips_corrupt_span) {
   std::vector<std::string> want = records;
   want.erase(want.begin() + 7);
   EXPECT_TRUE(got == want);
-  EXPECT_TRUE(telemetry::stage::RecordCorruptSkipped().Value() >
-              skipped_before);
+  if (telemetry::Enabled()) {  // stubbed counters pin to 0 in that tier
+    EXPECT_TRUE(telemetry::stage::RecordCorruptSkipped().Value() >
+                skipped_before);
+  }
 }
 
 TESTCASE(sharded_parser_reparse_keeps_stream_bit_identical) {
@@ -880,7 +886,9 @@ TESTCASE(sharded_parser_reparse_keeps_stream_bit_identical) {
     EXPECT_TRUE(SameContent(ref, got));
   }
   fault::DisarmAll();
-  EXPECT_TRUE(telemetry::stage::ShardPartRetries().Value() > retries_before);
+  if (telemetry::Enabled()) {  // stubbed counters pin to 0 in that tier
+    EXPECT_TRUE(telemetry::stage::ShardPartRetries().Value() > retries_before);
+  }
   // disarmed epoch still clean
   data::ShardedParser<uint32_t, float> clean(f, 0, 1, "libsvm", 3);
   EXPECT_TRUE(SameContent(ref, DrainParser<uint32_t, float>(&clean)));
@@ -899,6 +907,247 @@ TESTCASE(staged_batcher_single_row_over_cap_throws) {
   data::StagedBatcher b(std::move(parser), 4, 4, false, /*nnz_max=*/5);
   data::OwnedStagedBatch ob;
   EXPECT_THROWS(while (b.NextOwned(&ob)) ob.Reset());
+}
+
+// ---- the binned epoch cache (binned_cache.h) -------------------------------
+
+namespace {
+
+std::string SlurpFile(const std::string& path) {
+  std::FILE* fp = std::fopen(path.c_str(), "rb");
+  TCHECK(fp != nullptr) << "cannot open " << path;
+  std::fseek(fp, 0, SEEK_END);
+  long n = std::ftell(fp);  // NOLINT(runtime/int) — ftell's type
+  std::fseek(fp, 0, SEEK_SET);
+  std::string out(static_cast<size_t>(n), '\0');
+  size_t got = std::fread(out.data(), 1, out.size(), fp);
+  std::fclose(fp);
+  TCHECK(got == out.size());
+  return out;
+}
+
+// per-part first-record offsets from the part-map JSON, in id order (the
+// writer's std::map keeps the map sorted)
+std::vector<uint64_t> PartOffsets(const std::string& part_map_json) {
+  std::vector<uint64_t> out;
+  const std::string key = "\"offset\":";
+  for (size_t pos = part_map_json.find(key); pos != std::string::npos;
+       pos = part_map_json.find(key, pos + 1)) {
+    out.push_back(std::strtoull(part_map_json.c_str() + pos + key.size(),
+                                nullptr, 10));
+  }
+  return out;
+}
+
+}  // namespace
+
+TESTCASE(binned_cache_write_raw_roundtrip) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/epoch.bincache";
+  // cuts f32 [3 features, 3 cuts]
+  const float cuts[9] = {0.f, 1.f, 2.f, 10.f, 20.f, 30.f, -1.f, 0.f, 1.f};
+  uint64_t build0 = telemetry::stage::CacheBuildBytes().Value();
+  {
+    data::BinnedCacheWriter w(f, "{\"k\":1}");
+    w.SetCuts(cuts, 3, 3);
+    // part 0: 2 rows, 3 entries — a normal value, a NaN (code 0, mask
+    // clear), and a stray feature id binned against feature 0
+    const float label0[2] = {1.f, 2.f};
+    const float weight0[2] = {1.f, 0.5f};
+    const int32_t rp0[3] = {0, 2, 3};
+    const int32_t idx0[3] = {0, 1, 99};
+    const float val0[3] = {0.5f, std::nanf(""), 0.f};
+    w.WriteRawBlock(0, 0, 2, 3, label0, weight0, rp0, idx0, val0, nullptr);
+    // part 1: 1 row with a qid column
+    const float label1[1] = {3.f};
+    const float weight1[1] = {1.f};
+    const int32_t rp1[2] = {0, 1};
+    const int32_t idx1[1] = {2};
+    const float val1[1] = {0.75f};
+    const int32_t qid1[1] = {7};
+    w.WriteRawBlock(1, 0, 1, 1, label1, weight1, rp1, idx1, val1, qid1);
+    w.Close();
+  }
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheBuildBytes().Value() > build0);
+
+  data::BinnedCacheReader r(f);
+  EXPECT_TRUE(r.valid());
+  EXPECT_TRUE(!r.missing());
+  EXPECT_TRUE(r.meta_json() == "{\"k\":1}");
+  auto offsets = PartOffsets(r.part_map_json());
+  EXPECT_EQV(offsets.size(), 2u);
+
+  uint64_t hit0 = telemetry::stage::CacheHitBytes().Value();
+  std::string blk;
+  EXPECT_TRUE(r.NextBlock(&blk));  // part 0, in build order
+  data::BinnedBlockHeader hdr;
+  std::memcpy(&hdr, blk.data(), sizeof(hdr));
+  EXPECT_EQV(hdr.part_id, 0u);
+  EXPECT_EQV(hdr.num_rows, 2u);
+  EXPECT_EQV(hdr.nnz, 3u);
+  EXPECT_EQV(hdr.flags, 0u);
+  const char* p = blk.data() + sizeof(hdr);
+  const float* label = reinterpret_cast<const float*>(p);
+  EXPECT_EQV(label[0], 1.f);
+  EXPECT_EQV(label[1], 2.f);
+  const int32_t* rp = reinterpret_cast<const int32_t*>(p + 2 * 4 + 2 * 4);
+  EXPECT_EQV(rp[0], 0);
+  EXPECT_EQV(rp[2], 3);
+  const uint8_t* ebin =
+      reinterpret_cast<const uint8_t*>(p + 2 * 4 * 2 + 3 * 4 + 3 * 4);
+  // 0.5 under {0,1,2} -> searchsorted-right 1 -> code 2; NaN -> 0;
+  // stray id 99 bins value 0.0 against feature 0 -> code 2
+  EXPECT_EQV(ebin[0], 2u);
+  EXPECT_EQV(ebin[1], 0u);
+  EXPECT_EQV(ebin[2], 2u);
+  const uint8_t* mask = ebin + 3;
+  EXPECT_EQV(mask[0], 0x01u);  // only entry 0 is nonzero & non-NaN
+
+  EXPECT_TRUE(r.NextBlock(&blk));  // part 1
+  std::memcpy(&hdr, blk.data(), sizeof(hdr));
+  EXPECT_EQV(hdr.part_id, 1u);
+  EXPECT_EQV(hdr.flags, 1u);
+  p = blk.data() + sizeof(hdr);
+  const int32_t* qid = reinterpret_cast<const int32_t*>(p + 4 + 4 + 2 * 4);
+  EXPECT_EQV(qid[0], 7);
+  const uint8_t* ebin1 =
+      reinterpret_cast<const uint8_t*>(p + 4 * 3 + 2 * 4 + 4);
+  // 0.75 under feature 2's cuts {-1,0,1} -> 2 below -> code 3
+  EXPECT_EQV(ebin1[0], 3u);
+  EXPECT_TRUE(!r.NextBlock(&blk));  // stops at the part-map record
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheHitBytes().Value() > hit0);
+
+  // the part map seeks land on each part's first record
+  r.SeekTo(offsets[1]);
+  EXPECT_TRUE(r.NextBlock(&blk));
+  std::memcpy(&hdr, blk.data(), sizeof(hdr));
+  EXPECT_EQV(hdr.part_id, 1u);
+  r.SeekTo(offsets[0]);
+  EXPECT_TRUE(r.NextBlock(&blk));
+  std::memcpy(&hdr, blk.data(), sizeof(hdr));
+  EXPECT_EQV(hdr.part_id, 0u);
+}
+
+TESTCASE(binned_cache_torn_or_foreign_is_invalid) {
+  TemporaryDirectory tmp;
+  {  // no file at all: missing (a first build, not a rebuild)
+    data::BinnedCacheReader r(tmp.path + "/absent.bincache");
+    EXPECT_TRUE(!r.valid());
+    EXPECT_TRUE(r.missing());
+  }
+  {  // an unclosed build leaves the sentinel header: torn, not missing
+    std::string f = tmp.path + "/torn.bincache";
+    {
+      data::BinnedCacheWriter w(f, "{}");
+      std::string payload(64, 'b');
+      w.WriteBlock(0, 4, 16, payload.data(), payload.size());
+      // destroyed without Close(): sentinels stay in place
+    }
+    data::BinnedCacheReader r(f);
+    EXPECT_TRUE(!r.valid());
+    EXPECT_TRUE(!r.missing());
+    EXPECT_TRUE(r.error().find("truncated or torn") != std::string::npos);
+  }
+  {  // foreign bytes: bad magic
+    std::string f = tmp.path + "/foreign.bincache";
+    WriteFile(f, "this is not a binned cache at all, not even close");
+    data::BinnedCacheReader r(f);
+    EXPECT_TRUE(!r.valid());
+    EXPECT_TRUE(r.error().find("magic") != std::string::npos);
+  }
+}
+
+TESTCASE(binned_cache_truncated_copy_is_invalid) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/whole.bincache";
+  {
+    data::BinnedCacheWriter w(f, "{}");
+    std::string payload(128, 'c');
+    w.WriteBlock(0, 8, 32, payload.data(), payload.size());
+    w.Close();
+  }
+  EXPECT_TRUE(data::BinnedCacheReader(f).valid());
+  // a truncated COPY of an intact build: header magic + patched sizes are
+  // present, but total_bytes no longer matches the file on disk
+  std::string cut = SlurpFile(f);
+  std::string g = tmp.path + "/cut.bincache";
+  WriteFile(g, cut.substr(0, cut.size() - 5));
+  data::BinnedCacheReader r(g);
+  EXPECT_TRUE(!r.valid());
+  EXPECT_TRUE(r.error().find("truncated") != std::string::npos);
+}
+
+TESTCASE(binned_cache_corrupt_block_recover_resync) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/resync.bincache";
+  {
+    data::BinnedCacheWriter w(f, "{}");
+    for (uint32_t part = 0; part < 3; ++part) {
+      for (int k = 0; k < 2; ++k) {
+        std::string payload(48 + part * 8 + k, 'a' + static_cast<char>(part));
+        w.WriteBlock(part, 1, 4, payload.data(), payload.size());
+      }
+    }
+    w.Close();
+  }
+  auto offsets = PartOffsets(data::BinnedCacheReader(f).part_map_json());
+  EXPECT_EQV(offsets.size(), 3u);
+  std::string raw = SlurpFile(f);
+  raw[offsets[1]] ^= 0x5a;  // break part 1's first record magic
+  WriteFile(f, raw);
+
+  {  // strict: the corrupt span is fatal mid-stream
+    data::BinnedCacheReader strict(f);
+    EXPECT_TRUE(strict.valid());  // header + part map are intact
+    std::string blk;
+    EXPECT_THROWS(while (strict.NextBlock(&blk)) {});
+  }
+  {  // recover: resync past the corrupt record, serve every other block
+    data::BinnedCacheReader rec(f, /*recover=*/true);
+    EXPECT_TRUE(rec.valid());
+    std::string blk;
+    size_t n = 0;
+    while (rec.NextBlock(&blk)) ++n;
+    EXPECT_EQV(n, 5u);
+    EXPECT_TRUE(rec.corrupt_skipped() >= 1);
+    // per-part seeks away from the damage still work: part 2's first block
+    // (WriteBlock payloads are verbatim — the fill char identifies the part)
+    rec.SeekTo(offsets[2]);
+    EXPECT_TRUE(rec.NextBlock(&blk));
+    EXPECT_EQV(blk, std::string(48 + 2 * 8, 'c'));
+  }
+}
+
+TESTCASE(binned_cache_write_short_fault_leaves_invalid_cache) {
+  if (!fault::Enabled()) {
+    std::string err;
+    EXPECT_TRUE(!fault::ArmSpec("cache.write.short=err@1.0", &err));
+    return;
+  }
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/crash.bincache";
+  std::string err;
+  EXPECT_TRUE(fault::ArmSpec("cache.write.short=err@1.0:n=1;seed=3", &err));
+  {
+    data::BinnedCacheWriter w(f, "{}");
+    std::string payload(96, 'd');
+    EXPECT_THROWS(w.WriteBlock(0, 2, 8, payload.data(), payload.size()));
+  }
+  fault::DisarmAll();
+  {  // the torn file reads invalid -> the caller rebuilds
+    data::BinnedCacheReader r(f);
+    EXPECT_TRUE(!r.valid());
+    EXPECT_TRUE(!r.missing());
+  }
+  {  // the rebuild over the same path succeeds
+    data::BinnedCacheWriter w(f, "{}");
+    std::string payload(96, 'd');
+    w.WriteBlock(0, 2, 8, payload.data(), payload.size());
+    w.Close();
+  }
+  EXPECT_TRUE(data::BinnedCacheReader(f).valid());
 }
 
 TESTMAIN()

@@ -14,8 +14,9 @@ Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
 from . import (checkpoint, data, faultinject, io, models, ops, parallel,
                telemetry, timer)
 from ._native import NativeError, version as native_version
-from .data import (DeviceStagingIter, PaddedBatch, Parser, RecordBatch,
-                   RecordStagingIter, RowBlock)
+from .data import (BinnedBatch, BinnedRowIter, BinnedStagingIter,
+                   DeviceStagingIter, PaddedBatch, Parser, RecordBatch,
+                   RecordStagingIter, RowBlock, build_bin_cache)
 from .io import (FileInfo, InputSplit, RecordIOReader, RecordIOWriter,
                  listdir, open_seek_stream, open_stream, path_info)
 
@@ -26,6 +27,7 @@ __all__ = [
     "NativeError", "native_version",
     "DeviceStagingIter", "PaddedBatch", "Parser", "RowBlock",
     "RecordBatch", "RecordStagingIter",
+    "BinnedBatch", "BinnedRowIter", "BinnedStagingIter", "build_bin_cache",
     "InputSplit", "RecordIOReader", "RecordIOWriter",
     "FileInfo", "open_stream", "open_seek_stream", "listdir", "path_info",
 ]

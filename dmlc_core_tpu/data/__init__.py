@@ -2,6 +2,9 @@
 from .rowblock import RowBlock, Parser
 from .staging import (PaddedBatch, DeviceStagingIter, RecordBatch,
                       RecordStagingIter)
+from .binned_cache import (BinnedBatch, BinnedRowIter, BinnedStagingIter,
+                           build_bin_cache)
 
 __all__ = ["RowBlock", "Parser", "PaddedBatch", "DeviceStagingIter",
-           "RecordBatch", "RecordStagingIter"]
+           "RecordBatch", "RecordStagingIter", "BinnedBatch",
+           "BinnedRowIter", "BinnedStagingIter", "build_bin_cache"]

@@ -888,7 +888,11 @@ class DeviceStagingIter:
                  with_qid: bool = False, num_workers: int = 1,
                  reorder: bool = True, buffer_mb: int = 64,
                  prefetch_depth: Optional[int] = None,
-                 autotune: Optional[bool] = None):
+                 autotune: Optional[bool] = None,
+                 bin_cache=None, binner=None):
+        if bin_cache is not None and binner is None:
+            raise ValueError("bin_cache= needs binner= (a QuantileBinner; "
+                             "see doc/binned_cache.md)")
         self._lib = _declare_batcher_sig()
         self._handle = ctypes.c_void_p()
         if autotune is None:
@@ -906,9 +910,21 @@ class DeviceStagingIter:
             batch_size, nnz_bucket, nnz_max, int(with_field), int(with_qid),
             nw, int(reorder), int(buffer_mb) << 20,
             ctypes.byref(self._handle)))
+        self._uri = uri
+        self._part = part
+        self._num_parts = num_parts
+        self._format = format
         self._batch_size = batch_size
+        self._nnz_bucket = nnz_bucket
         self._nnz_max = nnz_max
         self._sharding = sharding
+        # binned epoch cache fast path (doc/binned_cache.md): with
+        # bin_cache= set, __iter__ serves pre-binned BinnedBatch pytrees
+        # from the quantized columnar cache (built on first use) instead of
+        # parsing text — epoch 2+ does zero parse and zero binning work
+        self._bin_cache = bin_cache
+        self._binner = binner
+        self._binned = None  # lazily-built BinnedStagingIter delegate
         self._prefetch = max(prefetch_depth if prefetch_depth is not None
                              else prefetch, 1)
         self._num_workers = max(int(num_workers), 1)
@@ -1202,7 +1218,14 @@ class DeviceStagingIter:
         (a background thread) run ahead of the consumer.  The epoch runs
         under the env-configured stall watchdog (telemetry.watchdog_from_env)
         and, when launched under a tracker, reports its counters to the
-        tracker's metrics channel."""
+        tracker's metrics channel.
+
+        With ``bin_cache=`` set, yields pre-binned ``BinnedBatch`` pytrees
+        served from the epoch cache (built/validated on first use) instead
+        of parsing text — see doc/binned_cache.md."""
+        if self._bin_cache is not None:
+            yield from self._binned_delegate()
+            return
         with _observability_scope():
             from dmlc_core_tpu import autotune as _at
             tuner = _at.maybe_attach(self)
@@ -1213,6 +1236,22 @@ class DeviceStagingIter:
                 for batch in self._iter_epoch():
                     yield batch
                     tuner.on_batch()
+
+    def _binned_delegate(self):
+        """The cache-hit fast path: a lazily-built BinnedStagingIter over
+        the same dataset/knobs serves every epoch from the quantized
+        columnar cache (first use builds it)."""
+        if self._binned is None:
+            from .binned_cache import BinnedStagingIter
+            cache = None if self._bin_cache is True else self._bin_cache
+            self._binned = BinnedStagingIter(
+                self._uri, self._binner, cache=cache,
+                batch_size=self._batch_size, nnz_bucket=self._nnz_bucket,
+                nnz_max=self._nnz_max, part=self._part,
+                num_parts=self._num_parts, format=self._format,
+                sharding=self._sharding, prefetch_depth=self._prefetch,
+                with_qid=self._with_qid, buffer_mb=self._buffer_mb)
+        yield from self._binned
 
     def _iter_epoch(self) -> Iterator[PaddedBatch]:
         self._epoch_t0 = time.monotonic()

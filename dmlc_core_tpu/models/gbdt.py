@@ -32,6 +32,7 @@ bandwidth-bound fused op and the simpler schedule wins.
 from __future__ import annotations
 
 import functools
+import hashlib
 from typing import Optional, Tuple
 
 import jax
@@ -152,6 +153,17 @@ class QuantileBinner:
         cuts[lens == 0] = 0.0  # feature never present: degenerate cuts
         self.cuts = jnp.asarray(np.maximum.accumulate(cuts, axis=1))
         return self
+
+    def cuts_digest(self) -> str:
+        """Short content digest of the fitted cuts — the identity the
+        binned epoch cache (data/binned_cache.py) keys its pre-computed
+        bin codes on."""
+        if self.cuts is None:
+            raise RuntimeError("cuts_digest before fit")
+        a = np.ascontiguousarray(np.asarray(self.cuts, np.float32))
+        h = hashlib.sha256(a.tobytes())
+        h.update(repr(a.shape).encode())
+        return h.hexdigest()[:16]
 
     def transform_entries(self, index: jax.Array, value: jax.Array
                           ) -> jax.Array:
@@ -1468,6 +1480,31 @@ class GBDT:
         emask = (v != 0) & ~jnp.isnan(v)
         return batch.row_ids(), batch.index, emask
 
+    @staticmethod
+    def _entry_bins(batch, binner: QuantileBinner):
+        """(row_id, findex, ebin, emask) for a staged batch of either kind.
+
+        A pre-binned ``BinnedBatch`` (data/binned_cache.py) ships its
+        ``ebin``/``emask`` straight from the epoch cache — the trainer
+        skips its own per-entry binning pass — after checking the batch's
+        ``cuts_digest`` against the binner's (mixing bin vocabularies
+        would silently train a wrong forest).  A value-carrying
+        ``PaddedBatch`` goes through ``transform_entries`` as before.
+        """
+        if hasattr(batch, "ebin"):
+            digest = getattr(batch, "cuts_digest", "")
+            if digest and binner.cuts is not None \
+                    and digest != binner.cuts_digest():
+                raise ValueError(
+                    f"pre-binned batch was built under cuts {digest} but "
+                    f"the binner holds {binner.cuts_digest()}; rebuild the "
+                    "cache or pass the matching binner")
+            return (batch.row_ids(), batch.index,
+                    batch.ebin.astype(jnp.int32), batch.emask)
+        rid, fi, emask = GBDT._entry_arrays(batch)
+        return (rid.astype(jnp.int32), fi.astype(jnp.int32),
+                binner.transform_entries(fi, batch.value), emask)
+
     def fit_batch(self, batch, binner: QuantileBinner,
                   weight: Optional[jax.Array] = None,
                   eval_set=None, early_stopping_rounds: int = 0) -> dict:
@@ -1489,15 +1526,13 @@ class GBDT:
                              "both the GBDT and the QuantileBinner")
         label = batch.label.astype(jnp.float32)
         w = (batch.weight if weight is None else weight).astype(jnp.float32)
-        row_id, findex, emask = self._entry_arrays(batch)
-        ebin = binner.transform_entries(findex, batch.value)
+        row_id, findex, ebin, emask = self._entry_bins(batch, binner)
         eval_margin = eval_label = eval_weight = None
         if eval_set is not None:
             # eval_set: a held-out PaddedBatch (weight-0 rows excluded
             # from the eval loss via its own weight vector)
             ev = eval_set
-            ev_rid, ev_fi, ev_mask = self._entry_arrays(ev)
-            ev_bin = binner.transform_entries(ev_fi, ev.value)
+            ev_rid, ev_fi, ev_bin, ev_mask = self._entry_bins(ev, binner)
             eval_label = ev.label.astype(jnp.float32)
             eval_weight = ev.weight
             eval_margin = (lambda f, t, d, leaf:
@@ -1604,9 +1639,7 @@ class GBDT:
                 yield offsets[i], b
 
         def batch_entries(b):
-            rid, fi, emask = self._entry_arrays(b)
-            return (rid.astype(jnp.int32), fi.astype(jnp.int32),
-                    binner.transform_entries(fi, b.value), emask)
+            return self._entry_bins(b, binner)
 
         def build_tree(grad, hess, col_mask, ck):
             gh_row = jnp.stack([grad, hess], axis=-1)      # [rows, 2]
@@ -1687,8 +1720,7 @@ class GBDT:
         eval_margin = eval_label = eval_weight = None
         if eval_set is not None:
             ev = eval_set
-            ev_rid, ev_fi, ev_mask = self._entry_arrays(ev)
-            ev_bin = binner.transform_entries(ev_fi, ev.value)
+            ev_rid, ev_fi, ev_bin, ev_mask = self._entry_bins(ev, binner)
             eval_label = ev.label.astype(jnp.float32)
             eval_weight = ev.weight
             eval_margin = (lambda f, t, d, leaf:
@@ -1727,8 +1759,7 @@ class GBDT:
             # silently wrong, so mirror fit_batch's guard
             raise ValueError("margins_batch requires missing_aware=True on "
                              "both the GBDT and the QuantileBinner")
-        row_id, findex, emask = self._entry_arrays(batch)
-        ebin = binner.transform_entries(findex, batch.value)
+        row_id, findex, ebin, emask = self._entry_bins(batch, binner)
         default_right = params.get("default_right")
         if default_right is None:
             default_right = jnp.zeros_like(params["feature"])
@@ -1743,8 +1774,7 @@ class GBDT:
         if not (self.missing_aware and binner.missing_aware):
             raise ValueError("margins_multi_batch requires "
                              "missing_aware=True on both sides")
-        row_id, findex, emask = self._entry_arrays(batch)
-        ebin = binner.transform_entries(findex, batch.value)
+        row_id, findex, ebin, emask = self._entry_bins(batch, binner)
         default_right = params.get("default_right")
         if default_right is None:
             default_right = jnp.zeros_like(params["feature"])

@@ -240,6 +240,11 @@ def stall_attribution(before: dict, after: dict,
     seconds — a flaky source then shows up as "io-bound" instead of being
     silently folded into the reading stage's busy time.  The raw interval
     totals are always in the result's ``io`` dict.
+
+    Likewise a ``cache`` stage joins the table when the interval served
+    batches from the binned epoch cache (``cache.busy_us`` /
+    ``cache.wait_us`` / ``cache.hit_bytes`` moved) — a cache-hit epoch
+    then attributes its read time instead of showing an idle parse stage.
     """
     d = counters_delta(before, after)
     us = lambda k: d.get(k, 0) / 1e6  # noqa: E731
@@ -262,6 +267,15 @@ def stall_attribution(before: dict, after: dict,
         # pseudo-stage only when retries actually happened, so quiet runs
         # keep the classic four-stage table
         stages["io"] = {"busy_s": io["retry_wait_s"], "wait_s": 0.0}
+
+    # binned epoch cache (doc/binned_cache.md): when the interval served
+    # from cache (hit bytes or read time moved), the cache read stage joins
+    # the table in place of the parse work it replaced; text-parse epochs
+    # keep the classic table
+    cache_busy, cache_wait = us("cache.busy_us"), us("cache.wait_us")
+    if cache_busy or cache_wait or d.get("cache.hit_bytes", 0):
+        stages["cache"] = {"busy_s": round(cache_busy, 6),
+                           "wait_s": round(cache_wait, 6)}
 
     sharded = d.get("shard.parts", 0) > 0
     candidates = [n for n in stages if not (sharded and n == "parse")]

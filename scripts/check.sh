@@ -154,8 +154,15 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # proving armed tuning is transparent to what the model sees.
   DMLCTPU_AUTOTUNE=1 DMLCTPU_AUTOTUNE_WINDOW=4 \
     python -m pytest tests/test_staging.py -x -q -m "not slow"
+
+  # Bincache tier: the binned epoch cache suite WITHOUT the slow-marker
+  # filter, so the two-process stolen-shard test runs here too — it proves
+  # a tracker-stolen shard is served from the thief's cache read path, and
+  # the invalidation matrix proves every header-contract mutation costs
+  # exactly one counted rebuild with a bit-identical stream after.
+  python -m pytest tests/test_binned_cache.py -x -q
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier")
 echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"

@@ -1,0 +1,432 @@
+"""Binned epoch cache: build-once / stream-forever contract.
+
+The cache-hit epoch must be indistinguishable from the text-parse epoch at
+the array level (doc/binned_cache.md): same batch composition, same padding,
+bin codes bit-identical to ``QuantileBinner.transform_entries``, and the
+fitted forest identical whether the trainer consumed text or cache.  Around
+that sits the invalidation contract — every header-digest field mutation
+triggers exactly ONE counted rebuild — plus RecordIO recover resync over
+mid-file corruption and tracker-coordinated shard handoff served from the
+thief's cache read path.
+"""
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu._native import NativeError
+from dmlc_core_tpu.data import (BinnedRowIter, BinnedStagingIter,
+                                DeviceStagingIter, build_bin_cache)
+from dmlc_core_tpu.data.binned_cache import bin_entries_np, cuts_digest_of
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+
+REPO = Path(__file__).resolve().parent.parent
+
+FEATURES = 40
+
+
+def _write_libsvm(path, rows, seed=0, features=FEATURES, max_nnz=7):
+    """Labels are the row index, so job-wide visitation is checkable."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(rows):
+        nnz = int(rng.integers(1, max_nnz + 1))
+        idx = np.sort(rng.choice(features, size=nnz, replace=False))
+        feats = " ".join(f"{j}:{rng.normal():.5f}" for j in idx)
+        lines.append(f"{i} {feats}")
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
+def _binner(**kw):
+    kw.setdefault("num_bins", 16)
+    kw.setdefault("missing_aware", True)
+    kw.setdefault("sketch_size", 64)
+    kw.setdefault("sketch_seed", 3)
+    return QuantileBinner(**kw)
+
+
+def _iter(path, binner, **kw):
+    kw.setdefault("batch_size", 256)
+    kw.setdefault("nnz_bucket", 1024)
+    return BinnedStagingIter(str(path), binner, **kw)
+
+
+def _bits(b):
+    """Content signature of one BinnedBatch (every array, bit-exact)."""
+    parts = [np.asarray(x).tobytes()
+             for x in (b.label, b.weight, b.row_ptr, b.index, b.ebin,
+                       b.emask, b.num_rows)]
+    if b.qid is not None:
+        parts.append(np.asarray(b.qid).tobytes())
+    return tuple(parts)
+
+
+@pytest.fixture
+def data(tmp_path):
+    p = tmp_path / "rows.libsvm"
+    _write_libsvm(p, 1200, seed=7)
+    return p
+
+
+# ---- the tentpole contract: cache epoch == text epoch -----------------------
+
+
+def test_repeat_epoch_bit_identical_to_text_path(data):
+    binner = _binner()
+    it = _iter(data, binner)
+    build_rebuilds = telemetry.counter_get("cache.rebuilds")
+    first = list(it)          # builds (sketch + write), then serves
+    assert telemetry.counter_get("cache.rebuilds") == build_rebuilds
+    hit0 = telemetry.counter_get("cache.hit_bytes")
+    repeat = list(it)         # pure cache hit
+    assert telemetry.counter_get("cache.hit_bytes") > hit0
+    assert [_bits(b) for b in first] == [_bits(b) for b in repeat]
+
+    text = list(DeviceStagingIter(str(data), batch_size=256, nnz_bucket=1024,
+                                  autotune=False))
+    assert len(repeat) == len(text)
+    for cb, tb in zip(repeat, text):
+        for f in ("label", "weight", "row_ptr", "index", "num_rows"):
+            np.testing.assert_array_equal(np.asarray(getattr(cb, f)),
+                                          np.asarray(getattr(tb, f)), f)
+        idx = np.asarray(tb.index)
+        val = np.asarray(tb.value)
+        ref_bin = np.asarray(binner.transform_entries(idx, tb.value))
+        np.testing.assert_array_equal(np.asarray(cb.ebin).astype(np.int32),
+                                      ref_bin, "ebin vs transform_entries")
+        np.testing.assert_array_equal(np.asarray(cb.emask),
+                                      (val != 0) & ~np.isnan(val), "emask")
+        np.testing.assert_array_equal(
+            np.asarray(cb.ebin), bin_entries_np(np.asarray(binner.cuts),
+                                                idx, val))
+        assert cb.cuts_digest == cuts_digest_of(binner.cuts)
+
+
+def test_nnz_max_spill_matches_text_path(data):
+    binner = _binner()
+    it = _iter(data, binner, batch_size=64, nnz_max=96)
+    got = list(it)
+    text = list(DeviceStagingIter(str(data), batch_size=64, nnz_bucket=1024,
+                                  nnz_max=96, autotune=False))
+    assert len(got) == len(text)
+    spilled = False
+    for cb, tb in zip(got, text):
+        for f in ("label", "weight", "row_ptr", "index", "num_rows"):
+            np.testing.assert_array_equal(np.asarray(getattr(cb, f)),
+                                          np.asarray(getattr(tb, f)), f)
+        assert cb.index.shape == (96,)  # every batch pads to exactly nnz_max
+        spilled |= 0 < int(cb.num_rows) < 64
+    assert spilled, "nnz budget never forced a row spill; weak test data"
+
+
+def test_oversized_row_raises(tmp_path):
+    p = tmp_path / "wide.libsvm"
+    _write_libsvm(p, 40, seed=1, max_nnz=30)
+    it = _iter(p, _binner(), nnz_max=16)
+    with pytest.raises(ValueError, match="nnz_max"):
+        list(it)
+
+
+def test_forest_bit_identical_text_vs_cache(tmp_path):
+    p = tmp_path / "train.libsvm"
+    rng = np.random.default_rng(11)
+    lines = []
+    for _ in range(400):
+        nnz = int(rng.integers(1, 7))
+        idx = np.sort(rng.choice(20, size=nnz, replace=False))
+        lut = {int(j): float(rng.uniform(-1, 1)) for j in idx}
+        y = int((lut.get(0, 0.0) > 0) ^ (lut.get(1, 0.0) > 0.2))
+        lines.append(f"{y} " + " ".join(f"{j}:{v:.5f}"
+                                        for j, v in lut.items()))
+    p.write_text("\n".join(lines) + "\n")
+
+    binner = _binner()
+    binned = _iter(p, binner, batch_size=128)
+    binned.ensure_cache()  # fits the binner via the sketch pass
+    kw = dict(num_features=20, num_bins=16, num_trees=2, max_depth=2,
+              missing_aware=True)
+    text_src = lambda: iter(DeviceStagingIter(  # noqa: E731
+        str(p), batch_size=128, nnz_bucket=1024, autotune=False))
+    f_text = GBDT(**kw).fit_streamed(text_src, binner)
+    f_bin = GBDT(**kw).fit_streamed(lambda: iter(binned), binner)
+    assert f_text.keys() == f_bin.keys()
+    for k in ("feature", "threshold", "default_right", "leaf", "base"):
+        np.testing.assert_array_equal(np.asarray(f_text[k]),
+                                      np.asarray(f_bin[k]), k)
+
+
+def test_trainer_rejects_foreign_cuts_digest(data):
+    binner = _binner()
+    it = _iter(data, binner)
+    batch = next(iter(it))
+    other = _binner()
+    other.cuts = np.asarray(binner.cuts) + 1.0
+    with pytest.raises(ValueError, match="cuts"):
+        GBDT(num_features=FEATURES, num_bins=16,
+             missing_aware=True)._entry_bins(batch, other)
+
+
+# ---- cuts adoption ----------------------------------------------------------
+
+
+def test_unfitted_binner_adopts_cached_cuts(data):
+    b0 = _binner()
+    it0 = _iter(data, b0)
+    ref = [_bits(b) for b in it0]
+
+    b1 = _binner()  # same config, never fitted
+    assert b1.cuts is None
+    before = telemetry.counter_get("cache.rebuilds")
+    got = [_bits(b) for b in _iter(data, b1)]
+    assert telemetry.counter_get("cache.rebuilds") == before  # pure hit
+    np.testing.assert_array_equal(np.asarray(b1.cuts), np.asarray(b0.cuts))
+    assert got == ref
+
+
+# ---- invalidation: every digest field, exactly one rebuild ------------------
+
+
+def _mutants(base_path):
+    """(name, make_binner, mutate_source) per invalidation-contract field."""
+    def grow_source():
+        with open(base_path, "a") as f:
+            f.write("0 1:0.5\n")
+
+    def shifted_cuts():
+        b = _binner()
+        fit = _binner()
+        rng = np.random.default_rng(99)
+        fit.fit_sparse(rng.integers(0, FEATURES, 500),
+                       rng.normal(size=500).astype(np.float32) * 3 + 1,
+                       num_features=FEATURES)
+        b.cuts = fit.cuts
+        return b
+
+    return [
+        ("num_bins", lambda: _binner(num_bins=8), None),
+        ("sketch_seed", lambda: _binner(sketch_seed=9), None),
+        ("sketch_size", lambda: _binner(sketch_size=128), None),
+        ("source_bytes", _binner, grow_source),
+        ("cuts_digest", shifted_cuts, None),
+    ]
+
+
+def test_invalidation_matrix_exactly_one_rebuild_each(data):
+    list(_iter(data, _binner()))  # base build
+    for name, make_binner, mutate in _mutants(data):
+        if mutate is not None:
+            mutate()
+        it = _iter(data, make_binner())
+        before = telemetry.counter_get("cache.rebuilds")
+        first = [_bits(b) for b in it]
+        assert telemetry.counter_get("cache.rebuilds") == before + 1, \
+            f"{name}: mutation must cost exactly one rebuild"
+        again = [_bits(b) for b in it]
+        assert telemetry.counter_get("cache.rebuilds") == before + 1, \
+            f"{name}: the rebuilt cache must then serve hits"
+        assert first == again, f"{name}: post-rebuild epochs diverged"
+        assert first, name
+
+
+def test_first_build_is_not_a_rebuild(tmp_path):
+    p = tmp_path / "fresh.libsvm"
+    _write_libsvm(p, 200, seed=2)
+    before = telemetry.counter_get("cache.rebuilds")
+    assert len(list(_iter(p, _binner()))) > 0
+    assert telemetry.counter_get("cache.rebuilds") == before
+
+
+# ---- mid-file corruption: strict fatal, recover resyncs ---------------------
+
+
+def _build_direct(path, tmp_path, num_parts=1):
+    binner = _binner()
+    cache = tmp_path / "direct.bincache"
+    build_bin_cache(str(path), str(cache), binner, num_parts=num_parts,
+                    batch_size=64, nnz_bucket=1024)
+    return cache, binner
+
+
+def test_midfile_corruption_recover_resync(data, tmp_path):
+    cache, _ = _build_direct(data, tmp_path)
+    row = BinnedRowIter(str(cache))
+    expected = {(b["part_id"], b["seq"]) for b in row}
+    assert len(expected) >= 8  # many blocks: 1200 rows / 64-row build batches
+
+    # break the FIRST record of a middle part: its RecordIO magic word
+    victim_part = sorted(row.part_map)[len(row.part_map) // 2]
+    off = int(row.part_map[victim_part]["offset"])
+    raw = bytearray(cache.read_bytes())
+    raw[off] ^= 0x5A
+    cache.write_bytes(bytes(raw))
+
+    with pytest.raises(NativeError):  # strict: corrupt span is fatal
+        list(BinnedRowIter(str(cache)))
+
+    before = telemetry.counter_get("record.corrupt_skipped")
+    rec = BinnedRowIter(str(cache), recover=True)
+    got = {(b["part_id"], b["seq"]) for b in rec}
+    assert telemetry.counter_get("record.corrupt_skipped") > before
+    # the corrupt block is lost, every other block is still served (the
+    # resync may overshoot into a neighbour part, whose own seek re-serves
+    # it, so compare as sets)
+    assert (victim_part, 0) not in got
+    assert got >= expected - {(victim_part, 0)}
+
+
+def test_truncated_cache_is_invalid_and_rebuilt(data):
+    b = _binner()
+    it = _iter(data, b)
+    ref = [_bits(x) for x in it]
+    cache = Path(it._cache_path)
+    cache.write_bytes(cache.read_bytes()[:-64])  # truncated copy
+
+    with pytest.raises(ValueError, match="truncated"):
+        BinnedRowIter(str(cache))
+    before = telemetry.counter_get("cache.rebuilds")
+    got = [_bits(x) for x in _iter(data, b)]
+    assert telemetry.counter_get("cache.rebuilds") == before + 1
+    assert got == ref
+
+
+# ---- host-level BinnedRowIter -----------------------------------------------
+
+
+def test_rowiter_roundtrip_and_part_subset(data, tmp_path):
+    cache, binner = _build_direct(data, tmp_path, num_parts=1)
+    row = BinnedRowIter(str(cache))
+    assert row.meta["num_bins"] == 16
+    assert row.meta["cuts_digest"] == cuts_digest_of(binner.cuts)
+    blocks = list(row)
+    assert sum(b["num_rows"] for b in blocks) == 1200
+    # labels are row ids: exactly-once, in part order
+    labels = np.concatenate([b["label"] for b in blocks]).astype(int)
+    assert sorted(labels.tolist()) == list(range(1200))
+    for b in blocks:
+        assert b["row_ptr"][0] == 0
+        assert b["row_ptr"][-1] == b["nnz"] == b["index"].shape[0]
+        assert b["ebin"].dtype == np.uint8
+
+    first = sorted(row.part_map)[0]
+    sub = list(BinnedRowIter(str(cache), parts=[first]))
+    assert {b["part_id"] for b in sub} == {first}
+    assert sum(b["num_rows"] for b in sub) \
+        == int(row.part_map[first]["rows"])
+
+
+# ---- staging.py knob: bin_cache= on DeviceStagingIter -----------------------
+
+
+def test_device_staging_iter_bin_cache_knob(data, tmp_path):
+    binner = _binner()
+    cache = tmp_path / "knob.bincache"
+    direct = list(_iter(data, binner, cache=str(cache)))
+    via_knob = list(DeviceStagingIter(str(data), batch_size=256,
+                                      nnz_bucket=1024, bin_cache=str(cache),
+                                      binner=binner, autotune=False))
+    assert [_bits(b) for b in via_knob] == [_bits(b) for b in direct]
+    assert all(hasattr(b, "ebin") for b in via_knob)
+
+    with pytest.raises(ValueError, match="binner"):
+        DeviceStagingIter(str(data), bin_cache=str(cache))
+
+
+# ---- two-process shard handoff served from the thief's cache ----------------
+
+_HANDOFF_CHILD = r"""
+import json, sys, time
+pid, mport, uri, cache = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                          sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.data import BinnedStagingIter
+from dmlc_core_tpu.models import QuantileBinner
+from dmlc_core_tpu.tracker.metrics import ShardClient, push_once
+
+binner = QuantileBinner(num_bins=16, missing_aware=True, sketch_size=64,
+                        sketch_seed=3)
+it = BinnedStagingIter(uri, binner, cache=cache, batch_size=256,
+                       nnz_bucket=1024, part=pid, num_parts=2)
+client = ShardClient("127.0.0.1", mport, rank=pid)
+if pid == 0:
+    # the straggler: flag a restart (a steal driver) and serve slowly
+    push_once("127.0.0.1", mport, rank=0, restarted=True)
+else:
+    time.sleep(0.5)  # let the straggler register its shard set first
+
+rebuilds0 = telemetry.counter_get("cache.rebuilds")
+hits0 = telemetry.counter_get("cache.hit_bytes")
+labels, parts = [], set()
+for blk in it.host_blocks_coordinated(epoch=3, client=client):
+    labels.extend(int(v) for v in blk["label"])
+    parts.add(blk["part_id"])
+    if pid == 0:
+        time.sleep(0.3)
+print("RESULT " + json.dumps({
+    "pid": pid, "labels": sorted(labels), "parts": sorted(parts),
+    "rebuilds": telemetry.counter_get("cache.rebuilds") - rebuilds0,
+    "hit_bytes": telemetry.counter_get("cache.hit_bytes") - hits0,
+    "steals": telemetry.counter_get("shard.steal_gained"),
+    "enabled": telemetry.enabled()}), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_stolen_shard_served_from_cache(tmp_path):
+    """Satellite acceptance: a stolen shard is served from the THIEF's
+    cache read path — two processes share one pre-built cache keyed by
+    virtual part id, worker 0 is a flagged straggler, worker 1 steals, and
+    the union of row labels is the dataset exactly once."""
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator
+
+    n_rows = 2000
+    uri = tmp_path / "shared.libsvm"
+    _write_libsvm(uri, n_rows, seed=13)
+    cache = tmp_path / "shared.bincache"
+    build_bin_cache(str(uri), str(cache), _binner(), num_parts=2,
+                    batch_size=256, nnz_bucket=1024)
+
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _HANDOFF_CHILD, str(p), str(agg.port),
+             str(uri), str(cache)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=str(REPO)) for p in (0, 1)]
+        results = {}
+        for p, proc in enumerate(procs):
+            try:
+                out, err = proc.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(f"handoff process {p} hung")
+            assert proc.returncode == 0, f"process {p} failed:\n{err[-2000:]}"
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    results[p] = json.loads(line[len("RESULT "):])
+        assert set(results) == {0, 1}
+        r0, r1 = results[0], results[1]
+        # a shared, matching cache: neither worker rebuilt it
+        assert r0["rebuilds"] == 0 and r1["rebuilds"] == 0
+        # exactly-once job-wide visitation through the handoff
+        assert sorted(r0["labels"] + r1["labels"]) == list(range(n_rows))
+        # the flagged straggler lost >= 1 shard to the healthy worker...
+        board = agg.job_snapshot()["shards"]["3"]
+        assert board["pending"] == 0
+        assert len(board["stolen"]) >= 1, (board, r0["parts"], r1["parts"])
+        assert all(h["from"] == 0 and h["to"] == 1 for h in board["stolen"])
+        stolen_ids = {h["shard"] for h in board["stolen"]}
+        # ...and served every stolen part from ITS OWN cache read path
+        assert stolen_ids <= set(r1["parts"])
+        if r1["enabled"]:
+            assert r1["hit_bytes"] > 0
+            assert r1["steals"] >= len(stolen_ids)
+    finally:
+        agg.close()

@@ -265,6 +265,67 @@ def test_sharded_staging_reparse_is_bit_identical(libsvm_file):
     assert telemetry.counter_get("fault.injected") >= 2
 
 
+# ---- the binned-cache build under write faults ------------------------------
+
+
+def _drain_binned_bits(it):
+    return [tuple(np.asarray(x).tobytes() for x in
+                  (b.label, b.weight, b.row_ptr, b.index, b.ebin, b.emask))
+            for b in it]
+
+
+def _binned_iter(path, cache):
+    from dmlc_core_tpu.models import QuantileBinner
+    binner = QuantileBinner(num_bins=16, missing_aware=True, sketch_size=64,
+                            sketch_seed=3)
+    return dt.BinnedStagingIter(path, binner, cache=cache, batch_size=128,
+                                nnz_bucket=512)
+
+
+@faults_on
+def test_cache_write_short_one_shot_retries_build(libsvm_file, tmp_path):
+    """A single injected short write (crash mid-frame) must cost one failed
+    attempt, then the in-place retry builds a VALID cache and the epoch
+    stream is bit-identical to a fault-free run."""
+    ref = _drain_binned_bits(_binned_iter(libsvm_file,
+                                          str(tmp_path / "clean.bincache")))
+    cache = tmp_path / "faulted.bincache"
+    it = _binned_iter(libsvm_file, str(cache))
+    failed0 = telemetry.counter_get("cache.build_failed")
+    with faultinject.armed("cache.write.short=err@1.0:n=1;seed=7"):
+        got = _drain_binned_bits(it)
+    assert got == ref
+    assert telemetry.counter_get("cache.build_failed") == failed0 + 1
+    assert not it._fallback_text
+    assert cache.exists()  # the retry's build survived and was renamed in
+    # and the survivor serves plain hits from here on
+    rebuilds0 = telemetry.counter_get("cache.rebuilds")
+    assert _drain_binned_bits(it) == ref
+    assert telemetry.counter_get("cache.rebuilds") == rebuilds0
+
+
+@faults_on
+def test_cache_write_short_sustained_degrades_to_text(libsvm_file, tmp_path):
+    """With the fault sustained, both build attempts die; the epoch must
+    degrade to the text-parse path with a bit-identical batch stream, leave
+    no cache behind, and the NEXT epoch (fault gone) builds normally."""
+    ref = _drain_binned_bits(_binned_iter(libsvm_file,
+                                          str(tmp_path / "clean.bincache")))
+    cache = tmp_path / "doomed.bincache"
+    it = _binned_iter(libsvm_file, str(cache))
+    failed0 = telemetry.counter_get("cache.build_failed")
+    with faultinject.armed("cache.write.short=err@1.0;seed=7"):
+        got = _drain_binned_bits(it)
+    assert got == ref, "degraded text epoch diverged from the cached stream"
+    assert it._fallback_text
+    assert telemetry.counter_get("cache.build_failed") >= failed0 + 2
+    assert not cache.exists()  # tmp file cleaned up, nothing torn left over
+    # disarmed: the same iterator recovers by building for real
+    assert _drain_binned_bits(it) == ref
+    assert not it._fallback_text
+    assert cache.exists()
+
+
 # ---- tracker-side degradation -----------------------------------------------
 
 
