@@ -2,7 +2,8 @@
 //
 // A fault POINT is a named site in the native code (the name contract lives in
 // doc/robustness.md): "io.http.connect", "io.ranged.read", "io.opener.5xx",
-// "recordio.magic", "shard.worker.chunk".  Sites cache a Point& once
+// "recordio.magic", "shard.worker.chunk", "cache.write.short".  Sites cache a
+// Point& once
 // (DMLCTPU_FAULT_POINT) and call Fire() per potentially-faultable operation;
 // Fire() returns the armed Mode when THIS hit should fault, kNone otherwise.
 //
@@ -13,7 +14,8 @@
 //
 // Spec grammar: ';'-separated entries.  "seed=N" sets the decision seed;
 // every other entry is "<point>=<mode>@<rate>[:n=<count>][:after=<skip>]":
-//   mode   err | eof | 503 | corrupt   (what the site should simulate)
+//   mode   err | eof | 503 | corrupt   (what the site should simulate;
+//          "5xx" is accepted as an alias for 503)
 //   rate   probability in [0,1] that an eligible hit fires
 //   n      at most <count> injections for this point (default unlimited)
 //   after  first <skip> hits are always clean (default 0)
@@ -35,12 +37,9 @@
 #define DMLCTPU_FAULTS 1
 #endif
 
+#include <atomic>
 #include <cstdint>
 #include <string>
-
-#if DMLCTPU_FAULTS
-#include <atomic>
-#endif
 
 namespace dmlctpu {
 namespace fault {
@@ -107,6 +106,12 @@ std::string SnapshotJson();
 uint64_t InjectedTotal();
 
 #else  // DMLCTPU_FAULTS == 0: inline no-op stubs, call sites compile unchanged
+
+/*! \brief stub of the "anything armed" flag: pinned false, loads fold away. */
+inline std::atomic<bool>& ArmedFlag() {
+  static std::atomic<bool> flag{false};
+  return flag;
+}
 
 class Point {
  public:

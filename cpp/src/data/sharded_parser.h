@@ -487,6 +487,22 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
   /*! \brief pull the next Blocks into cur_blocks_; false at end of epoch */
+  /*! \brief true when PopNext's scan loop can make progress (caller holds
+   *  mu_): an error to rethrow, a block/done-part to act on, or the epoch is
+   *  over.  Mirrors the branch structure of PopNext exactly — keep in sync. */
+  bool ConsumerWakeLocked() const {
+    if (error_) return true;
+    if (reorder_) {
+      auto it = parts_.find(emit_part_);
+      if (it == parts_.end()) return emit_part_ >= virtual_parts_;
+      return !it->second.q.empty() || it->second.done;
+    }
+    for (const auto& kv : parts_) {
+      if (!kv.second.q.empty() || kv.second.done) return true;
+    }
+    return next_claim_ >= virtual_parts_ && parts_.empty();
+  }
+
   bool PopNext() {
     std::unique_lock<std::mutex> lk(mu_);
     for (;;) {
@@ -537,7 +553,7 @@ class ShardedParser : public Parser<IndexType, DType> {
         // consumer stall: nothing parsed and buffered for the emit part —
         // the parse side is the slow side
         telemetry::ScopedAccum wait(telemetry::stage::ShardConsumerWaitUs());
-        cv_consume_.wait(lk);
+        cv_consume_.wait(lk, [&] { return ConsumerWakeLocked(); });
       }
     }
   }

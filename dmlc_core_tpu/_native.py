@@ -123,7 +123,9 @@ def _load() -> ctypes.CDLL:
 _LIB = _load()
 
 # ---- signatures -------------------------------------------------------------
+_LIB.DmlcTpuGetLastError.argtypes = []
 _LIB.DmlcTpuGetLastError.restype = ctypes.c_char_p
+_LIB.DmlcTpuVersion.argtypes = []
 _LIB.DmlcTpuVersion.restype = ctypes.c_char_p
 
 _LIB.DmlcTpuParserCreate.argtypes = [
@@ -139,7 +141,11 @@ _LIB.DmlcTpuParserNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(RowBlockC)]
 _LIB.DmlcTpuParserBeforeFirst.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuParserBytesRead.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuParserBytesRead.restype = ctypes.c_int64
+_LIB.DmlcTpuParserSetPoolKnobs.argtypes = [
+    ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_int)]
 _LIB.DmlcTpuParserFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuParserFree.restype = None
 
 _LIB.DmlcTpuInputSplitCreate.argtypes = [
     ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint, ctypes.c_char_p,
@@ -153,11 +159,13 @@ _LIB.DmlcTpuInputSplitResetPartition.argtypes = [
 _LIB.DmlcTpuInputSplitTotalSize.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuInputSplitTotalSize.restype = ctypes.c_int64
 _LIB.DmlcTpuInputSplitFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuInputSplitFree.restype = None
 
 _LIB.DmlcTpuRecordIOWriterCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuRecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
 _LIB.DmlcTpuRecordIOWriterClose.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuRecordIOWriterFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuRecordIOWriterFree.restype = None
 _LIB.DmlcTpuRecordIOReaderCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuRecordIOReaderCreateEx.argtypes = [
     ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
@@ -166,6 +174,7 @@ _LIB.DmlcTpuRecordIOReaderNext.argtypes = [
 _LIB.DmlcTpuRecordIOReaderCorruptSkipped.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuRecordIOReaderCorruptSkipped.restype = ctypes.c_int64
 _LIB.DmlcTpuRecordIOReaderFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuRecordIOReaderFree.restype = None
 
 _LIB.DmlcTpuStreamCreate.argtypes = [
     ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
@@ -176,6 +185,7 @@ _LIB.DmlcTpuStreamWrite.argtypes = [
     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
 _LIB.DmlcTpuStreamClose.argtypes = [ctypes.c_void_p]
 _LIB.DmlcTpuStreamFree.argtypes = [ctypes.c_void_p]
+_LIB.DmlcTpuStreamFree.restype = None
 _LIB.DmlcTpuSeekStreamCreate.argtypes = [
     ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
 _LIB.DmlcTpuStreamSeek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]

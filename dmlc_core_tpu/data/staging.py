@@ -432,6 +432,7 @@ def _declare_batcher_sig():
     L.DmlcTpuStagedBatcherBytesRead.argtypes = [ctypes.c_void_p]
     L.DmlcTpuStagedBatcherBytesRead.restype = ctypes.c_int64
     L.DmlcTpuStagedBatcherFree.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuStagedBatcherFree.restype = None
     # live pool retuning (hasattr: tolerate an older .so during rebuilds —
     # set_knobs then degrades to next-epoch-only Python knobs)
     if hasattr(L, "DmlcTpuStagedBatcherSetPoolKnobs"):
@@ -520,6 +521,7 @@ def _declare_record_batcher_sig():
     L.DmlcTpuRecordBatcherBytesRead.argtypes = [ctypes.c_void_p]
     L.DmlcTpuRecordBatcherBytesRead.restype = ctypes.c_int64
     L.DmlcTpuRecordBatcherFree.argtypes = [ctypes.c_void_p]
+    L.DmlcTpuRecordBatcherFree.restype = None
     L._record_batcher_declared = True
     return L
 

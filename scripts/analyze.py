@@ -1,0 +1,15 @@
+#!/usr/bin/env python3
+"""Entry point for the cross-layer contract analyzer (doc/analysis.md).
+
+Thin shim so `python scripts/analyze.py` works from the repo root; the
+checkers live in the scripts/analyze/ package.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from analyze.main import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

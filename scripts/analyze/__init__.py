@@ -1,0 +1,13 @@
+"""Project-specific static analysis: prove the string-glued contracts.
+
+One module per checker (see doc/analysis.md):
+  capi             C-API surface vs ctypes bindings vs doc/api coverage
+  telemetry_names  metric name literals vs doc/observability.md vs Prometheus
+  knobs            fault points + DMLCTPU_* env knobs, both directions
+  stubparity       -DDMLCTPU_TELEMETRY=0 / -DDMLCTPU_FAULTS=0 stub parity
+  concurrency      seq_cst atomics + predicate-less cv waits in hot headers
+
+Entry point: `python scripts/analyze.py` (or scripts/lint.py, which
+delegates).  Every checker is `check(root: Path) -> list[Finding]` so the
+red-path tests in tests/test_analyze.py can point them at synthetic trees.
+"""

@@ -1,0 +1,167 @@
+"""Shared scaffolding for the contract checkers."""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One contract violation, anchored to a file:line."""
+
+    path: str       # repo-relative
+    line: int       # 1-based
+    checker: str    # short checker id ("capi", "knobs", ...)
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+def rel(root: Path, path: Path) -> str:
+    try:
+        return str(path.relative_to(root))
+    except ValueError:
+        return str(path)
+
+
+def read_text(path: Path) -> str:
+    return path.read_text(encoding="utf-8", errors="replace")
+
+
+def line_of(text: str, pos: int) -> int:
+    """1-based line number of character offset `pos` in `text`."""
+    return text.count("\n", 0, pos) + 1
+
+
+def strip_cxx_comments(text: str) -> str:
+    """Blank out //... and /*...*/ comments, preserving newlines (so the
+    stripped text keeps the original line numbering).  String literals are
+    honored: comment starters inside "..." are left alone."""
+    out = []
+    i, n = 0, len(text)
+    state = "code"  # code | line | block | string | char
+    while i < n:
+        c = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if state == "code":
+            if c == "/" and nxt == "/":
+                state = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                state = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                state = "string"
+            elif c == "'":
+                state = "char"
+            out.append(c)
+        elif state == "line":
+            if c == "\n":
+                state = "code"
+                out.append(c)
+            else:
+                out.append(" ")
+        elif state == "block":
+            if c == "*" and nxt == "/":
+                state = "code"
+                out.append("  ")
+                i += 2
+                continue
+            out.append(c if c == "\n" else " ")
+        elif state == "string":
+            if c == "\\":
+                out.append(c)
+                out.append(nxt)
+                i += 2
+                continue
+            if c == '"':
+                state = "code"
+            out.append(c)
+        else:  # char
+            if c == "\\":
+                out.append(c)
+                out.append(nxt)
+                i += 2
+                continue
+            if c == "'":
+                state = "code"
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def matching_paren(text: str, open_pos: int) -> int:
+    """Index of the ')' matching the '(' at `open_pos` (-1 if unbalanced)."""
+    depth = 0
+    for i in range(open_pos, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return -1
+
+
+def split_top_level(s: str, sep: str = ",") -> list[str]:
+    """Split on `sep` at paren/bracket nesting depth 0."""
+    parts, depth, cur = [], 0, []
+    for c in s:
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        if c == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    if cur or parts:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def iter_source_files(root: Path, subdirs: list[str], suffixes: tuple[str, ...],
+                      extra_files: list[str] = ()) -> list[Path]:
+    """Deterministic scan list: `subdirs` recursively + named top-level files,
+    skipping build output and VCS metadata."""
+    skip_parts = {".git", "build", "__pycache__", ".pytest_cache"}
+    files: list[Path] = []
+    for sub in subdirs:
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*")):
+            if p.is_file() and p.suffix in suffixes \
+                    and not (skip_parts & set(p.parts)):
+                files.append(p)
+    for name in extra_files:
+        p = root / name
+        if p.is_file():
+            files.append(p)
+    return files
+
+
+BACKTICK_RE = re.compile(r"`([^`\n]+)`")
+
+
+def table_backticks(md_text: str, section: str) -> list[tuple[int, str]]:
+    """(line, token) for every backticked token inside markdown table rows of
+    the section headed `section` (up to the next heading)."""
+    out: list[tuple[int, str]] = []
+    lines = md_text.splitlines()
+    in_section = False
+    for i, ln in enumerate(lines, 1):
+        if ln.startswith("#"):
+            in_section = ln.lstrip("# ").strip().lower() == section.lower()
+            continue
+        if in_section and ln.lstrip().startswith("|"):
+            for m in BACKTICK_RE.finditer(ln):
+                out.append((i, m.group(1)))
+    return out

@@ -1,0 +1,83 @@
+"""Checker 5: concurrency lint over the registered hot-path headers.
+
+Two rules, both about defaults that are silently wrong in code this hot:
+
+  * atomic member operations (.load/.store/.fetch_add/...) must pass an
+    explicit std::memory_order — the seq_cst default is a fence on every
+    call and is never what a profiled hot path means
+  * condition_variable `.wait(lk)` must take a predicate — a bare wait
+    returns on spurious wakeups AND deadlocks when the notify raced the
+    sleep; the timed `.wait_for`/`.wait_until` polls are exempt (they
+    cannot wedge)
+
+Both scans are multi-line aware: the argument span is the matched-paren
+range, so an order passed on a continuation line is seen.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .common import Finding, line_of, matching_paren, read_text, \
+    strip_cxx_comments
+
+# the hot-path headers under contract; extend when a new lock-free/queue
+# header lands (doc/analysis.md "extending the checkers")
+REGISTERED = [
+    "cpp/include/dmlctpu/telemetry.h",
+    "cpp/include/dmlctpu/lockfree_queue.h",
+    "cpp/include/dmlctpu/fault.h",
+    "cpp/src/data/sharded_parser.h",
+]
+
+ATOMIC_OP_RE = re.compile(
+    r"\.(load|store|exchange|fetch_add|fetch_sub|fetch_or|fetch_and|"
+    r"fetch_xor|compare_exchange_weak|compare_exchange_strong)\s*\(")
+CV_WAIT_RE = re.compile(r"\.wait\s*\(")
+
+
+def _scan(text: str, relpath: str) -> list[Finding]:
+    findings: list[Finding] = []
+    stripped = strip_cxx_comments(text)
+    for m in ATOMIC_OP_RE.finditer(stripped):
+        open_pos = m.end() - 1
+        close = matching_paren(stripped, open_pos)
+        if close < 0:
+            continue
+        args = stripped[open_pos:close + 1]
+        if "memory_order" not in args:
+            findings.append(Finding(
+                relpath, line_of(stripped, m.start()), "concurrency",
+                f"atomic .{m.group(1)}() without an explicit memory_order "
+                f"(defaults to seq_cst)"))
+    for m in CV_WAIT_RE.finditer(stripped):
+        open_pos = m.end() - 1
+        close = matching_paren(stripped, open_pos)
+        if close < 0:
+            continue
+        args = stripped[open_pos + 1:close]
+        depth = 0
+        has_top_comma = False
+        for c in args:
+            if c in "([{":
+                depth += 1
+            elif c in ")]}":
+                depth -= 1
+            elif c == "," and depth == 0:
+                has_top_comma = True
+                break
+        if not has_top_comma:
+            findings.append(Finding(
+                relpath, line_of(stripped, m.start()), "concurrency",
+                "condition-variable wait() without a predicate (spurious "
+                "wakeup / lost-notify hazard); use wait(lk, pred)"))
+    return findings
+
+
+def check(root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for relpath in REGISTERED:
+        path = root / relpath
+        if path.is_file():
+            findings += _scan(read_text(path), relpath)
+    return findings

@@ -1,0 +1,63 @@
+"""Driver: run every contract checker, print findings, exit nonzero on any.
+
+Usage:
+    python scripts/analyze.py [--root DIR] [checker ...]
+
+With no checker names, all five run.  Findings print one per line as
+`path:line: [checker] message`, sorted, followed by a summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import capi, concurrency, knobs, stubparity, telemetry_names
+
+CHECKERS = {
+    "capi": capi.check,
+    "telemetry": telemetry_names.check,
+    "knobs": knobs.check,
+    "stubparity": stubparity.check,
+    "concurrency": concurrency.check,
+}
+
+
+def run(root: Path, names: list[str] | None = None):
+    names = names or list(CHECKERS)
+    findings = []
+    for name in names:
+        findings += CHECKERS[name](root)
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analyze.py",
+        description="cross-layer contract analyzer (see doc/analysis.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: parent of scripts/)")
+    ap.add_argument("checkers", nargs="*", metavar="checker",
+                    help=f"subset to run (default: all of {list(CHECKERS)})")
+    args = ap.parse_args(argv)
+    bad = [c for c in args.checkers if c not in CHECKERS]
+    if bad:
+        ap.error(f"unknown checker(s) {bad}; pick from {list(CHECKERS)}")
+    root = Path(args.root).resolve() if args.root \
+        else Path(__file__).resolve().parents[2]
+
+    findings = run(root, args.checkers or None)
+    for f in findings:
+        print(f.render())
+    ran = args.checkers or list(CHECKERS)
+    if findings:
+        print(f"analyze: {len(findings)} finding(s) across "
+              f"{len(ran)} checker(s)")
+        return 1
+    print(f"analyze: OK ({', '.join(ran)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

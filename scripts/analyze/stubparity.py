@@ -1,0 +1,140 @@
+"""Checker 4: compile-out stub parity.
+
+telemetry.h and fault.h each promise that building with the macro at 0
+(-DDMLCTPU_TELEMETRY=0 / -DDMLCTPU_FAULTS=0) swaps every declaration for an
+inline no-op stub so call sites compile unchanged.  That promise is a
+parallel-text contract: the `#if MACRO` branch and the `#else` branch must
+declare the same public symbols.  This checker parses both branches of each
+registered header and diffs the symbol sets (free functions, class names,
+and public method names), in both directions.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from .common import Finding, read_text, strip_cxx_comments
+
+# header -> the compile-out macro whose #if/#else split it must keep in parity
+REGISTERED = {
+    "cpp/include/dmlctpu/telemetry.h": "DMLCTPU_TELEMETRY",
+    "cpp/include/dmlctpu/fault.h": "DMLCTPU_FAULTS",
+}
+
+CPP_KEYWORDS = {
+    "if", "for", "while", "switch", "return", "sizeof", "static_cast",
+    "reinterpret_cast", "const_cast", "dynamic_cast", "catch", "throw",
+    "new", "delete", "alignof", "decltype", "noexcept", "static_assert",
+    "defined",
+}
+
+
+@dataclass(frozen=True)
+class Symbol:
+    scope: str   # "" for free functions, else the enclosing class/struct
+    name: str
+
+    def render(self) -> str:
+        return f"{self.scope}::{self.name}" if self.scope else self.name
+
+
+def _branch_regions(lines: list[str], macro: str) -> tuple[tuple[int, int],
+                                                           tuple[int, int]]:
+    """((real_start, real_end), (stub_start, stub_end)) line index ranges of
+    the `#if MACRO` / `#else` / `#endif` split, or ((-1,-1),(-1,-1))."""
+    if_re = re.compile(r"^\s*#\s*if\s+" + re.escape(macro) + r"\s*$")
+    stack: list[tuple[bool, int, int]] = []  # (is_target, if_line, else_line)
+    for i, ln in enumerate(lines):
+        s = ln.strip()
+        if s.startswith("#if"):
+            stack.append((bool(if_re.match(ln)), i, -1))
+        elif s.startswith("#else") and stack:
+            top = stack[-1]
+            stack[-1] = (top[0], top[1], i)
+        elif s.startswith("#endif") and stack:
+            is_target, if_line, else_line = stack.pop()
+            if is_target and else_line >= 0:
+                return ((if_line + 1, else_line), (else_line + 1, i))
+    return ((-1, -1), (-1, -1))
+
+
+DECL_NAME_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+CLASS_RE = re.compile(r"^\s*(?:class|struct)\s+([A-Za-z_]\w*)")
+
+
+def _extract_symbols(lines: list[str]) -> set[Symbol]:
+    """Public symbols declared in a branch: free functions, class/struct
+    names, and public methods.  A line-oriented scan that tracks brace depth
+    and class scopes; private/protected members are not part of the parity
+    contract."""
+    symbols: set[Symbol] = set()
+    depth = 0
+    # (name, body_depth, access_public) for each open class/struct
+    scopes: list[list] = []
+    for ln in lines:
+        s = ln.strip()
+        if s.startswith("#"):
+            continue
+        start_depth = depth
+        opens, closes = ln.count("{"), ln.count("}")
+
+        if re.match(r"^\s*(public|protected|private)\s*:", ln) and scopes:
+            scopes[-1][2] = s.startswith("public")
+        else:
+            m = CLASS_RE.match(ln)
+            is_class_decl = bool(m) and not s.endswith(";")
+            if is_class_decl:
+                symbols.add(Symbol(scope="", name=m.group(1)))
+                # struct members default public, class members private
+                scopes.append([m.group(1), start_depth + 1,
+                               s.startswith("struct")])
+            elif not s.startswith(("friend", "using", "typedef", "template",
+                                   "namespace", "enum")):
+                in_scope = scopes[-1] if scopes else None
+                at_free = start_depth == 0 and not in_scope
+                at_member = (in_scope is not None
+                             and start_depth == in_scope[1] and in_scope[2])
+                if at_free or at_member:
+                    m2 = DECL_NAME_RE.search(ln)
+                    if m2 and m2.group(1) not in CPP_KEYWORDS:
+                        # skip calls/initializers: a declaration line starts
+                        # with a type or the (constructor) name itself
+                        before = ln[:m2.start()].strip()
+                        if not before.endswith((".", "->", "=", "(", ",")):
+                            symbols.add(Symbol(
+                                scope=in_scope[0] if at_member else "",
+                                name=m2.group(1)))
+        depth += opens - closes
+        while scopes and depth <= scopes[-1][1] - 1:
+            scopes.pop()
+    return symbols
+
+
+def check(root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for relpath, macro in REGISTERED.items():
+        path = root / relpath
+        if not path.is_file():
+            continue
+        text = strip_cxx_comments(read_text(path))
+        lines = text.splitlines()
+        (r0, r1), (s0, s1) = _branch_regions(lines, macro)
+        if r0 < 0:
+            findings.append(Finding(
+                relpath, 1, "stubparity",
+                f"no `#if {macro}` / #else / #endif split found"))
+            continue
+        real = _extract_symbols(lines[r0:r1])
+        stub = _extract_symbols(lines[s0:s1])
+        for sym in sorted(real - stub, key=lambda s: s.render()):
+            findings.append(Finding(
+                relpath, s0 + 1, "stubparity",
+                f"`{sym.render()}` is declared in the {macro}=1 branch but "
+                f"has no stub in the {macro}=0 branch"))
+        for sym in sorted(stub - real, key=lambda s: s.render()):
+            findings.append(Finding(
+                relpath, s0 + 1, "stubparity",
+                f"`{sym.render()}` exists only in the {macro}=0 stub branch "
+                f"(stale stub)"))
+    return findings

@@ -18,6 +18,15 @@ cd "$(dirname "$0")/.."
 FULL=0
 [[ "${1:-}" == "--full" ]] && FULL=1
 
+# Static contract tier (doc/analysis.md): sub-second, so it runs first —
+# a name drift across the ctypes/telemetry/fault/knob seams fails the gate
+# before anything compiles.
+if ! python scripts/analyze.py >/tmp/dmlctpu_check_analyze.log 2>&1; then
+  cat /tmp/dmlctpu_check_analyze.log >&2
+  echo "check.sh: CONTRACT ANALYZER FAILED (log: /tmp/dmlctpu_check_analyze.log)" >&2
+  exit 1
+fi
+
 # Build under the same lock _native.py's on-demand build takes: two
 # concurrent `cmake -B` configures of one tree corrupt each other's
 # CMakeFiles/ and both fail (seen: gate racing bench.py's device child).
@@ -64,6 +73,35 @@ for t in test_data test_telemetry; do
   fi
   if grep -q "WARNING: ThreadSanitizer" /tmp/dmlctpu_check_tsan_$t.log; then
     echo "check.sh: TSAN RACE REPORTED (log: /tmp/dmlctpu_check_tsan_$t.log)" >&2
+    exit 1
+  fi
+done
+
+# ASan+UBSan tier: same suites as TSan, one combined address+undefined
+# build (separate builds would double the compile cost on this 1-core box
+# and the two sanitizers compose).  -fno-sanitize-recover=all turns every
+# UBSan diagnostic into an abort so a report can never scroll by green;
+# the grep below catches ASan reports from forked children whose exit
+# status a suite might swallow.
+mkdir -p build/asan
+for t in test_data test_telemetry; do
+  asan_bin=build/asan/$t
+  if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+    cmake -S . -B build/asan -G Ninja -DDMLCTPU_ENABLE_SANITIZER=ON \
+          -DDMLCTPU_SANITIZER=address,undefined >/dev/null
+    ninja -C build/asan "$t" >/dev/null
+  else
+    g++ -O1 -g -std=c++20 -fsanitize=address,undefined \
+        -fno-sanitize-recover=all -fno-omit-frame-pointer -pthread \
+        -I cpp/include -I cpp cpp/tests/"$t".cc cpp/src/*.cc \
+        cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$asan_bin"
+  fi
+  if ! "$asan_bin" >/tmp/dmlctpu_check_asan_$t.log 2>&1; then
+    echo "check.sh: ASAN/UBSAN SUITE FAILED: $t (log: /tmp/dmlctpu_check_asan_$t.log)" >&2
+    exit 1
+  fi
+  if grep -Eq "ERROR: AddressSanitizer|runtime error:" /tmp/dmlctpu_check_asan_$t.log; then
+    echo "check.sh: ASAN/UBSAN REPORT (log: /tmp/dmlctpu_check_asan_$t.log)" >&2
     exit 1
   fi
 done
@@ -165,4 +203,4 @@ fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
 py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier")
-echo "check.sh: green (7 native suites + TSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"
+echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"

@@ -73,6 +73,10 @@ def lint_cpp() -> list[str]:
 
 def main() -> int:
     errors = lint_python() + lint_cpp()
+    # the cross-layer contract analyzer rides along (doc/analysis.md)
+    sys.path.insert(0, str(REPO / "scripts"))
+    from analyze.main import run as analyze_run
+    errors += [f.render() for f in analyze_run(REPO)]
     for e in errors:
         print(e)
     print(f"lint: {len(errors)} finding(s)")
