@@ -1053,10 +1053,13 @@ def run_autotune_convergence(data: Path, epochs: int = 3) -> dict:
 
 def run_bincache(data: Path) -> dict:
     """The binned-epoch-cache gate (doc/binned_cache.md): repeat (cache-hit)
-    epochs must beat the text-parse path by >=1.8x on epoch wall-clock, the
-    cache-building first epoch must cost <=10% over a plain text epoch, and
-    a small forest trained from the cache must be bit-identical to the
-    text-path forest.  The sketch pass that fits the binner is timed
+    epochs must beat the text-parse path by >=4x on epoch wall-clock (the
+    zero-copy hit path serves mmap-borrowed views, so a repeat epoch is
+    pure memory bandwidth + repack), host-side copies on the hit path must
+    stay under 10% of bytes served (cache.bytes_copied / cache.hit_bytes
+    < 0.1 -> copy_ok), the cache-building first epoch must cost <=10% over
+    a plain text epoch, and a small forest trained from the cache must be
+    bit-identical to the text-path forest.  The sketch pass that fits the binner is timed
     separately and kept OUT of the build gate: fit_streamed needs fitted
     cuts on the text path too, so both workflows pay it — the gate watches
     the marginal cost of writing the cache.  repeat_ok / build_ok are soft
@@ -1109,22 +1112,33 @@ def run_bincache(data: Path) -> dict:
     build = epoch_secs(binned)  # parse + native bin + cache write + stream
     rebuilds0 = telemetry.counter_get("cache.rebuilds")
     hit0 = telemetry.counter_get("cache.hit_bytes")
+    copied0 = telemetry.counter_get("cache.bytes_copied")
+    mmap0 = telemetry.counter_get("cache.mmap_opens")
     repeat = min(epoch_secs(binned) for _ in range(2))
     out["build_epoch_s"] = round(build, 3)
     out["repeat_epoch_s"] = round(repeat, 3)
     out["cache_mb"] = cache_path.stat().st_size >> 20 if cache_path.exists() \
         else None
-    out["cache_hit_mb"] = round(
-        (telemetry.counter_get("cache.hit_bytes") - hit0) / (1 << 20), 1)
+    hit_bytes = telemetry.counter_get("cache.hit_bytes") - hit0
+    copied_bytes = telemetry.counter_get("cache.bytes_copied") - copied0
+    out["cache_hit_mb"] = round(hit_bytes / (1 << 20), 1)
     out["cache_rebuilds"] = telemetry.counter_get("cache.rebuilds") - rebuilds0
+    out["zero_copy_opens"] = telemetry.counter_get("cache.mmap_opens") - mmap0
+    out["bytes_copied_per_byte_served"] = round(
+        copied_bytes / max(hit_bytes, 1), 4)
+    out["copy_ok"] = out["bytes_copied_per_byte_served"] < 0.1
+    if not out["copy_ok"]:
+        log(f"[bench] WARNING: cache hit path copied "
+            f"{out['bytes_copied_per_byte_served']:.3f} bytes per byte "
+            f"served (want < 0.1) — zero-copy backend not engaged?")
 
     speedup = text / max(repeat, 1e-9)
     overhead_pct = (build - text) / max(text, 1e-9) * 100.0
     out["repeat_speedup_vs_text"] = round(speedup, 2)
-    out["repeat_ok"] = speedup >= 1.8
+    out["repeat_ok"] = speedup >= 4.0
     if not out["repeat_ok"]:
         log(f"[bench] WARNING: binned repeat epoch only {speedup:.2f}x the "
-            f"text path (want >=1.8x): {repeat:.2f}s vs {text:.2f}s")
+            f"text path (want >=4x): {repeat:.2f}s vs {text:.2f}s")
     out["build_overhead_pct"] = round(overhead_pct, 1)
     out["build_ok"] = overhead_pct <= 10.0
     if not out["build_ok"]:
@@ -1585,6 +1599,8 @@ def main() -> None:
             "repeat_speedup_vs_text"),
         "bincache_forest_identical": (phases.get("bincache") or {}).get(
             "forest_identical"),
+        "bincache_copy_ratio": (phases.get("bincache") or {}).get(
+            "bytes_copied_per_byte_served"),
         "tpu_probe_ok": probe_summary["ok"],
         "detail": "full numbers on the DETAIL line above",
     }

@@ -326,6 +326,19 @@ int DmlcTpuBinnedCacheReaderPartMapJson(DmlcTpuBinnedCacheReaderHandle handle,
  * handle, 0 = end of blocks, -1 = error */
 int DmlcTpuBinnedCacheReaderNextBlock(DmlcTpuBinnedCacheReaderHandle handle,
                                       const void** data, uint64_t* size);
+/* next block as a zero-copy view (doc/binned_cache.md "Zero-copy hit
+ * path"): 1 = got a block, 0 = end of blocks, -1 = error.  *borrowed = 1
+ * means *data points into the reader's mapping/arena and stays valid until
+ * the handle is freed; *borrowed = 0 means an internal scratch buffer
+ * (streamed or reassembled split record) valid only until the next call —
+ * copy it before advancing.  Bytes served through borrowed views are never
+ * copied host-side (cache.bytes_copied counts every non-borrowed byte). */
+int DmlcTpuBinnedCacheReaderNextBlockView(
+    DmlcTpuBinnedCacheReaderHandle handle, const void** data, uint64_t* size,
+    int* borrowed);
+/* read backend this open resolved to: 0 stream, 1 mmap, 2 O_DIRECT arena */
+int DmlcTpuBinnedCacheReaderBackend(DmlcTpuBinnedCacheReaderHandle handle,
+                                    int* out);
 /* jump the block cursor to a part's first-record offset (part map) */
 int DmlcTpuBinnedCacheReaderSeekTo(DmlcTpuBinnedCacheReaderHandle handle,
                                    uint64_t offset);
@@ -333,6 +346,12 @@ int DmlcTpuBinnedCacheReaderBeforeFirst(DmlcTpuBinnedCacheReaderHandle handle);
 int64_t DmlcTpuBinnedCacheReaderCorruptSkipped(
     DmlcTpuBinnedCacheReaderHandle handle);
 void DmlcTpuBinnedCacheReaderFree(DmlcTpuBinnedCacheReaderHandle handle);
+/* 4 KiB-aligned host staging arena from the process-wide recycling pool
+ * (CacheArenaPool): capacity >= size, rounded to a power-of-two bucket.
+ * Release returns it for reuse (cache.arena_reuse) or frees it when the
+ * pool is at its DMLCTPU_BINCACHE_ARENA_MB cap; callable from any thread. */
+int DmlcTpuCacheArenaAcquire(uint64_t size, void** out);
+int DmlcTpuCacheArenaRelease(void* ptr);
 
 /* ---- telemetry (dmlctpu/telemetry.h) ------------------------------------- */
 /* *out = 1 when telemetry was compiled in (DMLCTPU_TELEMETRY=1), else 0.

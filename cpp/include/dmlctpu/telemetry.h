@@ -408,6 +408,23 @@ DMLCTPU_STAGE_COUNTER(FaultInjected, "fault.injected")
 DMLCTPU_STAGE_COUNTER(CacheBuildBytes, "cache.build_bytes")
 DMLCTPU_STAGE_COUNTER(CacheHitBytes, "cache.hit_bytes")
 DMLCTPU_STAGE_COUNTER(CacheRebuilds, "cache.rebuilds")
+// Zero-copy hit path (doc/binned_cache.md "Zero-copy hit path"): bytes that
+// were memcpy'd anywhere between the cache file and the repack input —
+// streaming-fallback reads, split-record reassembly, legacy NextBlock
+// copies.  The bytes_copied / hit_bytes ratio is the proof the mmap path
+// is engaged (~0 when it is; ~1+ when every block goes through a decode
+// buffer); stall_attribution surfaces it as the cache stage's copy_ratio.
+DMLCTPU_STAGE_COUNTER(CacheBytesCopied, "cache.bytes_copied")
+// Which read backend each reader open chose (mmap/O_DIRECT-arena vs the
+// streaming fallback) — a fleet of stream_opens where mmap was expected is
+// a misconfiguration, not a perf mystery.
+DMLCTPU_STAGE_COUNTER(CacheMmapOpens, "cache.mmap_opens")
+DMLCTPU_STAGE_COUNTER(CacheStreamOpens, "cache.stream_opens")
+// Recycled aligned staging arenas (CacheArenaPool): acquisitions served
+// from the free list vs fresh allocations, and bytes currently pooled.
+DMLCTPU_STAGE_COUNTER(CacheArenaAlloc, "cache.arena_alloc")
+DMLCTPU_STAGE_COUNTER(CacheArenaReuse, "cache.arena_reuse")
+DMLCTPU_STAGE_GAUGE(CacheArenaBytes, "cache.arena_bytes")
 
 #undef DMLCTPU_STAGE_COUNTER
 #undef DMLCTPU_STAGE_GAUGE

@@ -607,6 +607,31 @@ int DmlcTpuBinnedCacheReaderNextBlock(DmlcTpuBinnedCacheReaderHandle handle,
   });
 }
 
+int DmlcTpuBinnedCacheReaderNextBlockView(
+    DmlcTpuBinnedCacheReaderHandle handle, const void** data, uint64_t* size,
+    int* borrowed) {
+  return Guard([&] {
+    auto* ctx = static_cast<BinnedCacheReaderCtx*>(handle);
+    const char* d = nullptr;
+    uint64_t n = 0;
+    int b = 0;
+    if (!ctx->reader->NextBlockView(&d, &n, &b)) return 0;
+    *data = d;
+    *size = n;
+    *borrowed = b;
+    return 1;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderBackend(DmlcTpuBinnedCacheReaderHandle handle,
+                                    int* out) {
+  return Guard([&] {
+    *out = static_cast<int>(
+        static_cast<BinnedCacheReaderCtx*>(handle)->reader->backend());
+    return 0;
+  });
+}
+
 int DmlcTpuBinnedCacheReaderSeekTo(DmlcTpuBinnedCacheReaderHandle handle,
                                    uint64_t offset) {
   return Guard([&] {
@@ -636,6 +661,21 @@ int64_t DmlcTpuBinnedCacheReaderCorruptSkipped(
 
 void DmlcTpuBinnedCacheReaderFree(DmlcTpuBinnedCacheReaderHandle handle) {
   delete static_cast<BinnedCacheReaderCtx*>(handle);
+}
+
+int DmlcTpuCacheArenaAcquire(uint64_t size, void** out) {
+  return Guard([&] {
+    *out = dmlctpu::data::CacheArenaPool::Get()->Acquire(
+        static_cast<size_t>(size));
+    return 0;
+  });
+}
+
+int DmlcTpuCacheArenaRelease(void* ptr) {
+  return Guard([&] {
+    dmlctpu::data::CacheArenaPool::Get()->Release(ptr);
+    return 0;
+  });
 }
 
 int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,

@@ -28,14 +28,25 @@
 #ifndef DMLCTPU_SRC_DATA_BINNED_CACHE_H_
 #define DMLCTPU_SRC_DATA_BINNED_CACHE_H_
 
+#include <algorithm>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <utility>
 #include <vector>
+
+#if defined(__unix__) || defined(__APPLE__)
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#define DMLCTPU_BINCACHE_POSIX 1
+#endif
 
 #include "dmlctpu/endian.h"
 #include "dmlctpu/fault.h"
@@ -109,6 +120,120 @@ class ByteCountingStream : public Stream {
   uint64_t* count_;
 };
 
+/*! \brief which read path a BinnedCacheReader open resolved to.
+ *  kMmap / kDirectArena serve borrowed block views (the zero-copy hit
+ *  path); kStream is the fallback for recover mode, remote URIs,
+ *  DMLCTPU_BINCACHE_MMAP=0 and platforms without mmap. */
+enum class CacheReadBackend : int {
+  kStream = 0,
+  kMmap = 1,
+  kDirectArena = 2,
+};
+
+/*! \brief process-wide pool of 4 KiB-aligned host staging arenas.
+ *
+ *  Serves two clients: the O_DIRECT cold-read path (whose pread buffers
+ *  must be sector-aligned) and the Python repack loop (via the C API), so
+ *  every repeat epoch gathers into a recycled arena instead of a fresh
+ *  allocation.  Capacities are rounded up to the next power of two (min
+ *  4 KiB) so repeated near-identical batch sizes land in one bucket and
+ *  always reuse.  Release keeps at most DMLCTPU_BINCACHE_ARENA_MB (default
+ *  256) MiB on the free list; beyond that arenas are freed outright.
+ */
+class CacheArenaPool {
+ public:
+  static CacheArenaPool* Get() {
+    // intentionally immortal (heap singleton): a value static's destructor
+    // would tear down the free-list maps at exit — stranding the pooled
+    // arenas as real leaks — and would race any Release arriving from a
+    // late finalizer.  The arenas stay reachable through this pointer, so
+    // leak checkers see pool residency, not a leak.
+    static CacheArenaPool* inst = new CacheArenaPool;
+    return inst;
+  }
+
+  /*! \brief 4 KiB-aligned buffer with capacity >= size; never null */
+  void* Acquire(size_t size) {
+    size_t cap = Bucket(size);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = free_.find(cap);
+      if (it != free_.end()) {
+        void* p = it->second;
+        free_.erase(it);
+        pooled_bytes_ -= cap;
+        live_[p] = cap;
+        telemetry::stage::CacheArenaReuse().Add(1);
+        telemetry::stage::CacheArenaBytes().Set(
+            static_cast<int64_t>(pooled_bytes_));
+        return p;
+      }
+    }
+    void* p = nullptr;
+#ifdef DMLCTPU_BINCACHE_POSIX
+    if (posix_memalign(&p, 4096, cap) != 0) p = nullptr;
+#else
+    p = std::malloc(cap);
+#endif
+    TCHECK(p != nullptr) << "cache arena allocation failed (" << cap
+                         << " bytes)";
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      live_[p] = cap;
+    }
+    telemetry::stage::CacheArenaAlloc().Add(1);
+    return p;
+  }
+
+  /*! \brief return an Acquire'd buffer; pooled for reuse or freed when the
+   *  free list is at its byte cap.  Safe from any thread (the Python side
+   *  releases from numpy-view finalizers on whatever thread drops the last
+   *  reference). */
+  void Release(void* ptr) {
+    if (ptr == nullptr) return;
+    std::unique_lock<std::mutex> lock(mu_);
+    auto it = live_.find(ptr);
+    TCHECK(it != live_.end()) << "CacheArenaPool::Release of unknown pointer";
+    size_t cap = it->second;
+    live_.erase(it);
+    if (pooled_bytes_ + cap <= max_pooled_bytes_) {
+      free_.emplace(cap, ptr);
+      pooled_bytes_ += cap;
+      telemetry::stage::CacheArenaBytes().Set(
+          static_cast<int64_t>(pooled_bytes_));
+      return;
+    }
+    lock.unlock();
+    std::free(ptr);
+  }
+
+  /*! \brief bytes currently on the free list (test hook) */
+  uint64_t pooled_bytes() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return pooled_bytes_;
+  }
+
+ private:
+  CacheArenaPool() {
+    const char* e = std::getenv("DMLCTPU_BINCACHE_ARENA_MB");
+    uint64_t mb = 256;
+    if (e != nullptr && *e != '\0') mb = std::strtoull(e, nullptr, 10);
+    max_pooled_bytes_ = mb << 20;
+  }
+
+  static size_t Bucket(size_t size) {
+    size_t cap = 4096;
+    while (cap < size) cap <<= 1;
+    return cap;
+  }
+
+  std::mutex mu_;                     // guards free_/live_/pooled_bytes_
+  std::multimap<size_t, void*> free_;  // bucket capacity -> idle arena
+  std::map<void*, size_t> live_;       // outstanding arena -> capacity
+  uint64_t pooled_bytes_ = 0;
+  uint64_t max_pooled_bytes_ = 256ull << 20;
+};
+
 /*! \brief Streaming writer for the binned epoch cache.
  *
  *  WriteBlock appends one opaque block record and files it under its
@@ -121,8 +246,14 @@ class ByteCountingStream : public Stream {
  */
 class BinnedCacheWriter {
  public:
-  BinnedCacheWriter(const std::string& uri, const std::string& meta_json)
+  BinnedCacheWriter(const std::string& uri, const std::string& meta_json_in)
       : uri_(uri) {
+    // pad the meta with trailing spaces (insignificant to JSON) so
+    // data_begin = 40 + meta_len stays 4-byte aligned: every record head —
+    // and therefore every block payload the mmap hit path serves as a
+    // borrowed view — lands 4-aligned for the numpy f32/i32 column views
+    std::string meta_json = meta_json_in;
+    while ((meta_json.size() % 4) != 0) meta_json.push_back(' ');
     stream_ = Stream::Create(uri.c_str(), "w");
     uint64_t header[5] = {kBinnedCacheMagic, kBinnedCacheVersion,
                           kBinnedCachePayloadUnknown,
@@ -343,6 +474,10 @@ class BinnedCacheReader {
       }
       got += n;
     }
+    // the writer pads meta with trailing spaces so the block region starts
+    // 4-aligned (mmap views need it); the padding is not part of the meta
+    while (!meta_json_.empty() && meta_json_.back() == ' ')
+      meta_json_.pop_back();
     data_begin_ = 5 * sizeof(uint64_t) + meta_len;
     if (part_map_offset_ < data_begin_ || part_map_offset_ > total_bytes_) {
       error_ = "binned cache part map offset out of range: " + uri;
@@ -357,8 +492,19 @@ class BinnedCacheReader {
       return;
     }
     valid_ = true;
+    SelectBackend();
     BeforeFirst();
   }
+
+  ~BinnedCacheReader() {
+#ifdef DMLCTPU_BINCACHE_POSIX
+    if (map_base_ != nullptr) ::munmap(map_base_, total_bytes_);
+#endif
+    if (arena_ != nullptr) CacheArenaPool::Get()->Release(arena_);
+  }
+
+  BinnedCacheReader(const BinnedCacheReader&) = delete;
+  BinnedCacheReader& operator=(const BinnedCacheReader&) = delete;
 
   bool valid() const { return valid_; }
   /*! \brief true when there was no file at all (first build, not a rebuild) */
@@ -367,8 +513,16 @@ class BinnedCacheReader {
   const std::string& meta_json() const { return meta_json_; }
   const std::string& part_map_json() const { return part_map_json_; }
 
+  /*! \brief which read path this open resolved to (kStream / kMmap /
+   *  kDirectArena); fixed at construction. */
+  CacheReadBackend backend() const { return backend_; }
+
   void BeforeFirst() {
     if (!valid_) return;
+    if (backend_ != CacheReadBackend::kStream) {
+      pos_ = data_begin_;
+      return;
+    }
     fi_->Seek(data_begin_);
     reader_ = std::make_unique<RecordIOReader>(fi_.get(), recover_);
   }
@@ -380,18 +534,107 @@ class BinnedCacheReader {
     TCHECK(offset >= data_begin_ && offset < part_map_offset_)
         << "block offset " << offset << " outside the data region ["
         << data_begin_ << ", " << part_map_offset_ << ")";
+    if (backend_ != CacheReadBackend::kStream) {
+      pos_ = offset;
+      return;
+    }
     fi_->Seek(offset);
     reader_ = std::make_unique<RecordIOReader>(fi_.get(), recover_);
+  }
+
+  /*! \brief Next block as a borrowed view — the zero-copy hit path.
+   *
+   *  On the mmap/arena backends a contiguous (cflag 0) record yields
+   *  *borrowed=1: \p *data points straight into the mapping/arena, valid
+   *  until the reader is destroyed, and NO bytes move.  A record that was
+   *  magic-split on write is reassembled into an internal buffer
+   *  (*borrowed=0, valid until the next call, counted in
+   *  cache.bytes_copied) — rare: only payloads containing the aligned
+   *  RecordIO magic word.  On the streaming backend every block lands in
+   *  the internal buffer (*borrowed=0, one counted copy).  The view
+   *  cursor is strict: any framing damage is fatal, never resynced —
+   *  recover-mode readers always take the streaming backend.
+   */
+  bool NextBlockView(const char** data, uint64_t* size, int* borrowed) {
+    if (!valid_) return false;
+    if (backend_ == CacheReadBackend::kStream) {
+      if (fi_->Tell() >= part_map_offset_) return false;
+      if (!reader_->NextRecord(&view_buf_)) return false;
+      // the stream read itself materializes the block in a decode buffer
+      telemetry::stage::CacheBytesCopied().Add(
+          static_cast<int64_t>(view_buf_.size()));
+      telemetry::stage::CacheHitBytes().Add(
+          static_cast<int64_t>(view_buf_.size()));
+      *data = view_buf_.data();
+      *size = view_buf_.size();
+      *borrowed = 0;
+      return true;
+    }
+    if (pos_ >= part_map_offset_) return false;
+    uint32_t hdr[2];
+    ReadHead(hdr);
+    uint32_t cflag = RecordIOWriter::DecodeFlag(hdr[1]);
+    uint32_t len = RecordIOWriter::DecodeLength(hdr[1]);
+    if (cflag == 0u) {
+      const char* payload = base_ + pos_ + 8;
+      pos_ += 8 + RoundUp4(len);
+      TCHECK_LE(pos_, part_map_offset_)
+          << "corrupt binned cache: block overruns the data region";
+      telemetry::stage::CacheHitBytes().Add(static_cast<int64_t>(len));
+      *data = payload;
+      *size = len;
+      *borrowed = 1;
+      return true;
+    }
+    TCHECK_EQ(cflag, 1u)
+        << "corrupt binned cache: expected a record start at " << pos_;
+    // magic-split record: reassemble with the elided magics restored
+    view_buf_.clear();
+    for (;;) {
+      view_buf_.append(base_ + pos_ + 8, len);
+      pos_ += 8 + RoundUp4(len);
+      TCHECK_LE(pos_, part_map_offset_)
+          << "corrupt binned cache: split record overruns the data region";
+      if (cflag == 3u) break;
+      const uint32_t magic = RecordIOWriter::kMagic;
+      view_buf_.append(reinterpret_cast<const char*>(&magic), 4);
+      ReadHead(hdr);
+      cflag = RecordIOWriter::DecodeFlag(hdr[1]);
+      len = RecordIOWriter::DecodeLength(hdr[1]);
+      TCHECK(cflag == 2u || cflag == 3u)
+          << "corrupt binned cache: bad split-record piece flag";
+    }
+    telemetry::stage::CacheBytesCopied().Add(
+        static_cast<int64_t>(view_buf_.size()));
+    telemetry::stage::CacheHitBytes().Add(
+        static_cast<int64_t>(view_buf_.size()));
+    *data = view_buf_.data();
+    *size = view_buf_.size();
+    *borrowed = 0;
+    return true;
   }
 
   /*! \brief Next block record; false at the part-map boundary / EOF.
    *  In recover mode corrupt spans are resynced past (counted in
    *  corrupt_skipped + record.corrupt_skipped) and the caller's per-part
-   *  record accounting detects the loss. */
+   *  record accounting detects the loss.  Always copies into \p out
+   *  (counted in cache.bytes_copied) — the zero-copy hit path is
+   *  NextBlockView. */
   bool NextBlock(std::string* out) {
-    if (!valid_ || fi_->Tell() >= part_map_offset_) return false;
-    if (!reader_->NextRecord(out)) return false;
-    telemetry::stage::CacheHitBytes().Add(static_cast<int64_t>(out->size()));
+    if (backend_ == CacheReadBackend::kStream) {
+      if (!valid_ || fi_->Tell() >= part_map_offset_) return false;
+      if (!reader_->NextRecord(out)) return false;
+      telemetry::stage::CacheBytesCopied().Add(
+          static_cast<int64_t>(out->size()));
+      telemetry::stage::CacheHitBytes().Add(static_cast<int64_t>(out->size()));
+      return true;
+    }
+    const char* data = nullptr;
+    uint64_t size = 0;
+    int borrowed = 0;
+    if (!NextBlockView(&data, &size, &borrowed)) return false;
+    out->assign(data, size);
+    telemetry::stage::CacheBytesCopied().Add(static_cast<int64_t>(size));
     return true;
   }
 
@@ -400,6 +643,114 @@ class BinnedCacheReader {
   }
 
  private:
+  static uint32_t RoundUp4(uint32_t n) { return (n + 3u) & ~3u; }
+
+  /*! \brief strict 8-byte record-head read at pos_ (memcpy: no alignment
+   *  assumption, so pre-padding legacy caches still map fine) */
+  void ReadHead(uint32_t hdr[2]) {
+    TCHECK_LE(pos_ + 8, part_map_offset_)
+        << "corrupt binned cache: truncated record head at " << pos_;
+    std::memcpy(hdr, base_ + pos_, 8);
+    TCHECK_EQ(hdr[0], RecordIOWriter::kMagic)
+        << "corrupt binned cache: bad record magic at " << pos_;
+  }
+
+  /*! \brief resolve the read backend for this open.  Zero-copy (mmap, or
+   *  O_DIRECT into a pooled arena when DMLCTPU_BINCACHE_ODIRECT=1) for
+   *  strict local opens; streaming when recover mode must resync, the URI
+   *  is remote, DMLCTPU_BINCACHE_MMAP=0, or mapping fails.  Each attempt
+   *  re-checks the on-disk size against the header before touching pages,
+   *  so a file truncated after the ctor's validation degrades to the
+   *  streaming reader's short-read error instead of a SIGBUS. */
+  void SelectBackend() {
+    backend_ = CacheReadBackend::kStream;
+    io::URI parsed(uri_.c_str());
+    bool local = parsed.protocol.empty() || parsed.protocol == "file://";
+    const char* mm = std::getenv("DMLCTPU_BINCACHE_MMAP");
+    bool mmap_enabled = !(mm != nullptr && std::strcmp(mm, "0") == 0);
+    if (recover_ || !local || !mmap_enabled) {
+      telemetry::stage::CacheStreamOpens().Add(1);
+      return;
+    }
+    const std::string path = parsed.protocol.empty() ? uri_ : parsed.name;
+    const char* od = std::getenv("DMLCTPU_BINCACHE_ODIRECT");
+    if (od != nullptr && std::strcmp(od, "1") == 0 && TryDirectLoad(path)) {
+      backend_ = CacheReadBackend::kDirectArena;
+    } else if (TryMmap(path)) {
+      backend_ = CacheReadBackend::kMmap;
+    } else {
+      telemetry::stage::CacheStreamOpens().Add(1);
+      return;
+    }
+    telemetry::stage::CacheMmapOpens().Add(1);
+    fi_.reset();  // the mapping/arena owns the data now; free the stream fd
+    reader_.reset();
+  }
+
+  bool TryMmap(const std::string& path) {
+#ifdef DMLCTPU_BINCACHE_POSIX
+    int fd = ::open(path.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (::fstat(fd, &st) != 0 ||
+        static_cast<uint64_t>(st.st_size) != total_bytes_) {
+      ::close(fd);  // size changed since validation: no mapping, no SIGBUS
+      return false;
+    }
+    void* m = ::mmap(nullptr, total_bytes_, PROT_READ, MAP_PRIVATE, fd, 0);
+    ::close(fd);  // the mapping keeps its own reference to the file
+    if (m == MAP_FAILED) return false;
+    // advisory only: sequential readahead over the data region, and start
+    // faulting pages in now — the first epoch's IO overlaps repack
+    ::madvise(m, total_bytes_, MADV_SEQUENTIAL);
+    ::madvise(m, total_bytes_, MADV_WILLNEED);
+    map_base_ = static_cast<char*>(m);
+    base_ = map_base_;
+    return true;
+#else
+    (void)path;
+    return false;
+#endif
+  }
+
+  /*! \brief cold-read option: O_DIRECT pread of the whole cache into a
+   *  pooled 4 KiB-aligned arena, bypassing the page cache.  Any failure
+   *  (filesystem without O_DIRECT support → EINVAL, short read) releases
+   *  the arena and falls through to mmap. */
+  bool TryDirectLoad(const std::string& path) {
+#if defined(DMLCTPU_BINCACHE_POSIX) && defined(O_DIRECT)
+    int fd = ::open(path.c_str(), O_RDONLY | O_DIRECT);
+    if (fd < 0) return false;
+    struct stat st;
+    if (::fstat(fd, &st) != 0 ||
+        static_cast<uint64_t>(st.st_size) != total_bytes_) {
+      ::close(fd);
+      return false;
+    }
+    size_t padded = (total_bytes_ + 4095) & ~static_cast<size_t>(4095);
+    char* a = static_cast<char*>(CacheArenaPool::Get()->Acquire(padded));
+    uint64_t off = 0;
+    while (off < total_bytes_) {
+      // aligned offset + aligned count; the tail read may return short
+      size_t want = std::min<uint64_t>(padded - off, 8u << 20);
+      ssize_t n = ::pread(fd, a + off, want, static_cast<off_t>(off));
+      if (n <= 0) break;
+      off += static_cast<uint64_t>(n);
+    }
+    ::close(fd);
+    if (off < total_bytes_) {
+      CacheArenaPool::Get()->Release(a);
+      return false;
+    }
+    arena_ = a;
+    base_ = a;
+    return true;
+#else
+    (void)path;
+    return false;
+#endif
+  }
+
   std::string uri_;
   bool recover_ = false;
   bool valid_ = false;
@@ -412,6 +763,16 @@ class BinnedCacheReader {
   uint64_t total_bytes_ = 0;
   uint64_t part_map_offset_ = 0;
   uint64_t data_begin_ = 0;
+  // zero-copy backends: base_ points at file offset 0 of the mapping
+  // (map_base_) or the O_DIRECT arena (arena_); pos_ is the absolute file
+  // offset of the cursor; view_buf_ backs non-borrowed (reassembled /
+  // streamed) views until the next call
+  CacheReadBackend backend_ = CacheReadBackend::kStream;
+  const char* base_ = nullptr;
+  char* map_base_ = nullptr;
+  char* arena_ = nullptr;
+  uint64_t pos_ = 0;
+  std::string view_buf_;
 };
 
 }  // namespace data

@@ -1150,4 +1150,228 @@ TESTCASE(binned_cache_write_short_fault_leaves_invalid_cache) {
   EXPECT_TRUE(data::BinnedCacheReader(f).valid());
 }
 
+// ---- the zero-copy hit path (doc/binned_cache.md) --------------------------
+
+namespace {
+
+// set an env var for a scope, restoring the previous state on exit —
+// backend selection reads DMLCTPU_BINCACHE_* at reader construction
+class ScopedEnv {
+ public:
+  ScopedEnv(const char* name, const char* value) : name_(name) {
+    const char* old = std::getenv(name);
+    had_ = old != nullptr;
+    if (had_) old_ = old;
+    if (value != nullptr) {
+      ::setenv(name, value, 1);
+    } else {
+      ::unsetenv(name);
+    }
+  }
+  ~ScopedEnv() {
+    if (had_) {
+      ::setenv(name_, old_.c_str(), 1);
+    } else {
+      ::unsetenv(name_);
+    }
+  }
+
+ private:
+  const char* name_;
+  bool had_ = false;
+  std::string old_;
+};
+
+// three parts x two blocks of distinct fill bytes; returns the payloads
+// in write order
+std::vector<std::string> BuildViewCache(const std::string& f) {
+  std::vector<std::string> payloads;
+  data::BinnedCacheWriter w(f, "{\"zc\":1}");
+  for (uint32_t part = 0; part < 3; ++part) {
+    for (int k = 0; k < 2; ++k) {
+      payloads.emplace_back(40 + part * 12 + k,
+                            static_cast<char>('a' + part * 2 + k));
+      w.WriteBlock(part, 1, 4, payloads.back().data(),
+                   payloads.back().size());
+    }
+  }
+  w.Close();
+  return payloads;
+}
+
+std::vector<std::string> DrainBlocks(data::BinnedCacheReader* r) {
+  std::vector<std::string> out;
+  std::string blk;
+  while (r->NextBlock(&blk)) out.push_back(blk);
+  return out;
+}
+
+}  // namespace
+
+TESTCASE(binned_cache_mmap_views_borrowed_and_bit_identical) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/views.bincache";
+  auto payloads = BuildViewCache(f);
+
+  // streaming ground truth (knob off -> kStream even on a local file)
+  std::vector<std::string> streamed;
+  uint64_t stream_opens0 = telemetry::stage::CacheStreamOpens().Value();
+  {
+    ScopedEnv off("DMLCTPU_BINCACHE_MMAP", "0");
+    data::BinnedCacheReader s(f);
+    EXPECT_TRUE(s.valid());
+    EXPECT_TRUE(s.backend() == data::CacheReadBackend::kStream);
+    streamed = DrainBlocks(&s);
+  }
+  EXPECT_EQV(streamed.size(), payloads.size());
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheStreamOpens().Value() > stream_opens0);
+
+  uint64_t mmap_opens0 = telemetry::stage::CacheMmapOpens().Value();
+  uint64_t copied0 = telemetry::stage::CacheBytesCopied().Value();
+  data::BinnedCacheReader r(f);
+  EXPECT_TRUE(r.valid());
+  EXPECT_TRUE(r.backend() == data::CacheReadBackend::kMmap);
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheMmapOpens().Value() > mmap_opens0);
+
+  // every block is a contiguous record: views are borrowed, nothing copies,
+  // and a borrowed pointer stays valid after the cursor moves past it
+  const char* first_data = nullptr;
+  uint64_t first_size = 0;
+  const char* data = nullptr;
+  uint64_t size = 0;
+  int borrowed = 0;
+  size_t n = 0;
+  while (r.NextBlockView(&data, &size, &borrowed)) {
+    EXPECT_EQV(borrowed, 1);
+    EXPECT_EQV(std::string(data, size), streamed[n]);
+    if (n == 0) {
+      first_data = data;
+      first_size = size;
+    }
+    ++n;
+  }
+  EXPECT_EQV(n, payloads.size());
+  EXPECT_EQV(std::string(first_data, first_size), payloads[0]);
+  if (telemetry::Enabled())
+    EXPECT_EQV(telemetry::stage::CacheBytesCopied().Value(), copied0);
+
+  // part-map seeks work on the view cursor too
+  auto offsets = PartOffsets(r.part_map_json());
+  EXPECT_EQV(offsets.size(), 3u);
+  r.SeekTo(offsets[2]);
+  EXPECT_TRUE(r.NextBlockView(&data, &size, &borrowed));
+  EXPECT_EQV(std::string(data, size), payloads[4]);
+  r.BeforeFirst();
+  EXPECT_TRUE(r.NextBlockView(&data, &size, &borrowed));
+  EXPECT_EQV(std::string(data, size), payloads[0]);
+
+  // NextBlock on the mmap backend materializes (counted) but stays
+  // bit-identical to the streaming read
+  r.BeforeFirst();
+  EXPECT_TRUE(DrainBlocks(&r) == streamed);
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheBytesCopied().Value() > copied0);
+}
+
+TESTCASE(binned_cache_magic_split_record_reassembles_in_view) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/split.bincache";
+  // a payload containing the aligned RecordIO magic is split on write; the
+  // view path must reassemble it (borrowed=0, counted copy), bit-identical
+  std::string payload(24, 'z');
+  const uint32_t magic = RecordIOWriter::kMagic;
+  std::memcpy(payload.data() + 4, &magic, 4);
+  std::memcpy(payload.data() + 16, &magic, 4);
+  {
+    data::BinnedCacheWriter w(f, "{}");
+    w.WriteBlock(0, 1, 1, payload.data(), payload.size());
+    w.Close();
+  }
+  data::BinnedCacheReader r(f);
+  EXPECT_TRUE(r.valid());
+  EXPECT_TRUE(r.backend() == data::CacheReadBackend::kMmap);
+  uint64_t copied0 = telemetry::stage::CacheBytesCopied().Value();
+  const char* data = nullptr;
+  uint64_t size = 0;
+  int borrowed = -1;
+  EXPECT_TRUE(r.NextBlockView(&data, &size, &borrowed));
+  EXPECT_EQV(borrowed, 0);
+  EXPECT_EQV(std::string(data, size), payload);
+  EXPECT_TRUE(!r.NextBlockView(&data, &size, &borrowed));
+  if (telemetry::Enabled())
+    EXPECT_EQV(telemetry::stage::CacheBytesCopied().Value(),
+               copied0 + payload.size());
+}
+
+TESTCASE(binned_cache_recover_and_knob_take_streaming_backend) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/fallback.bincache";
+  auto payloads = BuildViewCache(f);
+  {  // recover mode must resync, which the strict view cursor cannot do
+    data::BinnedCacheReader r(f, /*recover=*/true);
+    EXPECT_TRUE(r.valid());
+    EXPECT_TRUE(r.backend() == data::CacheReadBackend::kStream);
+    EXPECT_EQV(DrainBlocks(&r).size(), payloads.size());
+  }
+  {  // a truncated copy is rejected at validation — never mapped, no SIGBUS
+    std::string cut = SlurpFile(f);
+    std::string g = tmp.path + "/cut.bincache";
+    WriteFile(g, cut.substr(0, cut.size() - 3));
+    data::BinnedCacheReader r(g);
+    EXPECT_TRUE(!r.valid());
+    EXPECT_TRUE(r.error().find("truncated") != std::string::npos);
+  }
+}
+
+TESTCASE(binned_cache_odirect_arena_backend) {
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/odirect.bincache";
+  auto payloads = BuildViewCache(f);
+  std::vector<std::string> streamed;
+  {
+    ScopedEnv off("DMLCTPU_BINCACHE_MMAP", "0");
+    data::BinnedCacheReader s(f);
+    streamed = DrainBlocks(&s);
+  }
+  uint64_t pooled0 = data::CacheArenaPool::Get()->pooled_bytes();
+  data::CacheReadBackend got;
+  {
+    ScopedEnv od("DMLCTPU_BINCACHE_ODIRECT", "1");
+    data::BinnedCacheReader r(f);
+    EXPECT_TRUE(r.valid());
+    got = r.backend();
+    // O_DIRECT is filesystem-dependent (tmpfs rejects it with EINVAL); the
+    // contract is graceful fallback, so accept either zero-copy backend —
+    // the served bytes must be identical regardless
+    EXPECT_TRUE(got == data::CacheReadBackend::kDirectArena ||
+                got == data::CacheReadBackend::kMmap);
+    EXPECT_TRUE(DrainBlocks(&r) == streamed);
+  }
+  // a direct-arena reader returns its arena to the pool on destruction
+  if (got == data::CacheReadBackend::kDirectArena)
+    EXPECT_TRUE(data::CacheArenaPool::Get()->pooled_bytes() > pooled0);
+}
+
+TESTCASE(cache_arena_pool_recycles_by_bucket) {
+  auto* pool = data::CacheArenaPool::Get();
+  uint64_t alloc0 = telemetry::stage::CacheArenaAlloc().Value();
+  void* p1 = pool->Acquire(10000);  // bucket 16384
+  EXPECT_TRUE(p1 != nullptr);
+  EXPECT_EQV(reinterpret_cast<uintptr_t>(p1) % 4096, 0u);
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheArenaAlloc().Value() > alloc0);
+  uint64_t before = pool->pooled_bytes();
+  pool->Release(p1);
+  EXPECT_EQV(pool->pooled_bytes(), before + 16384);
+  // a nearby size lands in the same bucket and reuses a pooled arena
+  uint64_t reuse0 = telemetry::stage::CacheArenaReuse().Value();
+  void* p2 = pool->Acquire(12000);
+  EXPECT_EQV(pool->pooled_bytes(), before);
+  if (telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheArenaReuse().Value() > reuse0);
+  pool->Release(p2);
+}
+
 TESTMAIN()

@@ -39,8 +39,10 @@ import os
 import sys
 import threading
 import time
+import weakref
+from collections import deque
 from dataclasses import dataclass
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Union
 
 import numpy as np
 
@@ -50,8 +52,8 @@ import jax.numpy as jnp
 from .._native import check, lib, NativeError
 from .. import telemetry
 from .staging import (DeviceStagingIter, _StagedBatchOwnedC,
-                      _observability_scope, _pick_virtual_parts,
-                      _replicated_sharding, _staged_iter)
+                      _device_put_maybe_donated, _observability_scope,
+                      _pick_virtual_parts, _replicated_sharding, _staged_iter)
 
 LOGGER = logging.getLogger("dmlc_core_tpu.binned_cache")
 
@@ -105,6 +107,14 @@ def _declare_binned_cache_sig():
                                                       P(ctypes.c_char_p)]
     L.DmlcTpuBinnedCacheReaderNextBlock.argtypes = [
         ctypes.c_void_p, P(ctypes.c_void_p), P(ctypes.c_uint64)]
+    L.DmlcTpuBinnedCacheReaderNextBlockView.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_void_p), P(ctypes.c_uint64),
+        P(ctypes.c_int)]
+    L.DmlcTpuBinnedCacheReaderBackend.argtypes = [ctypes.c_void_p,
+                                                  P(ctypes.c_int)]
+    L.DmlcTpuCacheArenaAcquire.argtypes = [ctypes.c_uint64,
+                                           P(ctypes.c_void_p)]
+    L.DmlcTpuCacheArenaRelease.argtypes = [ctypes.c_void_p]
     L.DmlcTpuBinnedCacheReaderSeekTo.argtypes = [ctypes.c_void_p,
                                                  ctypes.c_uint64]
     L.DmlcTpuBinnedCacheReaderBeforeFirst.argtypes = [ctypes.c_void_p]
@@ -241,6 +251,26 @@ class _NativeWriter:
             pass
 
 
+def _reader_handle_free(L, handle_value: int) -> None:
+    try:
+        L.DmlcTpuBinnedCacheReaderFree(ctypes.c_void_p(handle_value))
+    except (AttributeError, TypeError):
+        pass  # interpreter teardown: the process is about to unmap anyway
+
+
+class _ReaderHandleOwner:
+    """Owns the native reader handle.  The free (and with it the munmap of
+    the zero-copy mapping) runs only once BOTH the :class:`_NativeReader`
+    wrapper and every borrowed block view — each pins this object through
+    its buffer base chain — are garbage, so ``close()`` can never invalidate
+    a view still queued in the repacker or held by an in-flight jax
+    transfer (doc/binned_cache.md borrow rules)."""
+
+    def __init__(self, L, handle_value: int):
+        self._finalize = weakref.finalize(self, _reader_handle_free, L,
+                                          handle_value)
+
+
 class _NativeReader:
     """Validating cache reader; construction never raises on a bad cache
     (``valid`` turns False and ``error`` says why)."""
@@ -248,8 +278,10 @@ class _NativeReader:
     def __init__(self, path: str, recover: bool = False):
         self._lib = _declare_binned_cache_sig()
         self._handle = ctypes.c_void_p()
+        self._keep: Optional[_ReaderHandleOwner] = None
         check(self._lib.DmlcTpuBinnedCacheReaderCreate(
             path.encode(), 1 if recover else 0, ctypes.byref(self._handle)))
+        self._keep = _ReaderHandleOwner(self._lib, int(self._handle.value))
         flag = ctypes.c_int()
         check(self._lib.DmlcTpuBinnedCacheReaderValid(self._handle,
                                                       ctypes.byref(flag)))
@@ -279,7 +311,46 @@ class _NativeReader:
             self._handle, ctypes.byref(data), ctypes.byref(size)))
         if rc != 1:
             return None
+        # materializing bytes out of the native block buffer is itself a
+        # copy the zero-copy path (next_block_view) avoids
+        telemetry.counter_add("cache.bytes_copied", int(size.value))
         return ctypes.string_at(data, size.value)
+
+    def next_block_view(self) -> Optional[np.ndarray]:
+        """Next block payload as a uint8 numpy view — the zero-copy hit path.
+
+        A borrowed view points straight into the reader's mapping/arena and
+        stays valid until the last view AND this reader are garbage (its
+        buffer base chain pins the native handle).  Non-borrowed scratch
+        (streaming backend, reassembled magic-split records) is copied out
+        here — counted in ``cache.bytes_copied`` — so callers always get a
+        stable array either way."""
+        data, size = ctypes.c_void_p(), ctypes.c_uint64()
+        borrowed = ctypes.c_int()
+        rc = check(self._lib.DmlcTpuBinnedCacheReaderNextBlockView(
+            self._handle, ctypes.byref(data), ctypes.byref(size),
+            ctypes.byref(borrowed)))
+        if rc != 1:
+            return None
+        n = int(size.value)
+        if n == 0 or not data.value:
+            return np.empty(0, np.uint8)
+        cbuf = (ctypes.c_uint8 * n).from_address(data.value)
+        cbuf._owner = self._keep  # view -> handle keepalive, never the reverse
+        a = np.frombuffer(cbuf, np.uint8, n)
+        if not borrowed.value:
+            a = a.copy()  # scratch is only valid until the next call
+            telemetry.counter_add("cache.bytes_copied", n)
+        return a
+
+    @property
+    def backend(self) -> int:
+        """Read backend this open resolved to: 0 streaming fallback,
+        1 mmap, 2 O_DIRECT arena (doc/binned_cache.md)."""
+        out = ctypes.c_int()
+        check(self._lib.DmlcTpuBinnedCacheReaderBackend(self._handle,
+                                                        ctypes.byref(out)))
+        return int(out.value)
 
     def seek_to(self, offset: int) -> None:
         check(self._lib.DmlcTpuBinnedCacheReaderSeekTo(self._handle, offset))
@@ -293,12 +364,10 @@ class _NativeReader:
             self._handle))
 
     def close(self) -> None:
-        handle, self._handle = self._handle, ctypes.c_void_p()
-        if handle:
-            try:
-                self._lib.DmlcTpuBinnedCacheReaderFree(handle)
-            except (AttributeError, TypeError):
-                pass
+        # drop this wrapper's reference; the native free waits (via
+        # _ReaderHandleOwner) for any borrowed views still alive
+        self._handle = ctypes.c_void_p()
+        self._keep = None
 
     def __del__(self):
         try:
@@ -307,9 +376,12 @@ class _NativeReader:
             pass
 
 
-def unpack_block(buf: bytes) -> dict:
+def unpack_block(buf: Union[bytes, np.ndarray]) -> dict:
     """Decode one cache block payload into host arrays (zero-copy views
-    over ``buf`` wherever alignment allows)."""
+    over ``buf`` wherever alignment allows).  ``buf`` may be ``bytes`` or a
+    uint8 view from :meth:`_NativeReader.next_block_view` — in the latter
+    case every column stays a borrowed view into the cache mapping (the
+    base chain keeps the mapping alive)."""
     hdr = np.frombuffer(buf, _HDR_DTYPE, count=1)[0]
     nr, nnz = int(hdr["num_rows"]), int(hdr["nnz"])
     with_qid = bool(hdr["flags"] & 1)
@@ -406,11 +478,48 @@ jax.tree_util.register_dataclass(
 
 # ---- repacking blocks into static-shape batches -----------------------------
 
+def _arena_release(L, addr: int) -> None:
+    try:
+        L.DmlcTpuCacheArenaRelease(ctypes.c_void_p(addr))
+    except (AttributeError, TypeError):
+        pass  # interpreter teardown
+
+
+def _acquire_arena_views(specs) -> dict:
+    """One pooled staging arena carved into named numpy views.
+
+    ``specs`` is ``[(name, count, dtype), ...]``; each lane starts on a
+    64-byte boundary inside a single 4 KiB-aligned arena from the native
+    :class:`CacheArenaPool`.  The arena returns to the pool
+    (``cache.arena_reuse``) once every view — including any pinned by an
+    in-flight jax transfer — is garbage, so repeat epochs recycle instead
+    of allocating."""
+    L = _declare_binned_cache_sig()
+    lanes, total = [], 0
+    for name, count, dtype in specs:
+        total = (total + 63) & ~63
+        lanes.append((name, total, int(count), np.dtype(dtype)))
+        total += np.dtype(dtype).itemsize * int(count)
+    ptr = ctypes.c_void_p()
+    check(L.DmlcTpuCacheArenaAcquire(max(total, 1), ctypes.byref(ptr)))
+    buf = (ctypes.c_uint8 * total).from_address(ptr.value)
+    weakref.finalize(buf, _arena_release, L, int(ptr.value))
+    return {name: np.frombuffer(buf, dt, count, off)
+            for name, off, count, dt in lanes}
+
+
 class _Repacker:
     """Re-pack trimmed cache blocks into fixed-shape host batches with the
     StagedBatcher's padding semantics (rows to ``batch_size``, nonzeros to a
     ``nnz_bucket`` multiple — or exactly ``nnz_max`` with row spill), so the
-    cached epoch's batch composition matches the text-parse epoch's."""
+    cached epoch's batch composition matches the text-parse epoch's.
+
+    Zero-copy aware: the per-entry columns (index / ebin / emask — the bulk
+    of every block) are QUEUED as borrowed block views and gathered exactly
+    once, straight into a pooled staging arena at emit time; that gather is
+    the only time hit-path entry bytes move on the host.  Per-row columns
+    (label / weight / qid / row lengths) are a few bytes per row and stay
+    in small concatenated staging buffers."""
 
     def __init__(self, batch_size: int, nnz_bucket: int, nnz_max: int,
                  pad_bin: int, with_qid: bool):
@@ -423,8 +532,9 @@ class _Repacker:
         self._lab, self._wgt = z(np.float32), z(np.float32)
         self._qid = z(np.int32)
         self._len = z(np.int64)
-        self._idx, self._ebin = z(np.int32), z(np.uint8)
-        self._emask = z(bool)
+        #: queued per-entry column views, consumed FIFO by _gather_entries;
+        #: each dict holds {idx, ebin, emask, start-consumed-offset}
+        self._segs: deque = deque()
 
     def feed(self, blk: dict) -> Iterator[dict]:
         self._lab = np.concatenate([self._lab, blk["label"]])
@@ -435,13 +545,30 @@ class _Repacker:
             self._qid = np.concatenate([self._qid, q])
         self._len = np.concatenate(
             [self._len, np.diff(blk["row_ptr"]).astype(np.int64)])
-        self._idx = np.concatenate([self._idx, blk["index"]])
-        self._ebin = np.concatenate([self._ebin, blk["ebin"]])
-        self._emask = np.concatenate([self._emask, blk["emask"]])
+        if blk["nnz"]:
+            self._segs.append({"idx": blk["index"], "ebin": blk["ebin"],
+                               "emask": blk["emask"], "start": 0})
         yield from self._pump(final=False)
 
     def flush(self) -> Iterator[dict]:
         yield from self._pump(final=True)
+
+    def _gather_entries(self, n: int, idx_out: np.ndarray,
+                        ebin_out: np.ndarray, emask_out: np.ndarray) -> None:
+        """Move the next ``n`` queued entries into the output lanes — the
+        single hit-path gather into the emitted arena."""
+        got = 0
+        while got < n:
+            seg = self._segs[0]
+            s = seg["start"]
+            take = min(n - got, seg["idx"].shape[0] - s)
+            idx_out[got:got + take] = seg["idx"][s:s + take]
+            ebin_out[got:got + take] = seg["ebin"][s:s + take]
+            emask_out[got:got + take] = seg["emask"][s:s + take]
+            seg["start"] = s + take
+            got += take
+            if seg["start"] == seg["idx"].shape[0]:
+                self._segs.popleft()
 
     def _take_rows(self) -> Optional[int]:
         """Rows of the next full batch, or None if not enough buffered."""
@@ -480,34 +607,46 @@ class _Repacker:
         else:
             nnz_pad = max(-(-nnz // self._bucket) * self._bucket,
                           self._bucket)
-        rp = np.zeros(B + 1, np.int32)
+        specs = [("label", B, np.float32), ("weight", B, np.float32),
+                 ("row_ptr", B + 1, np.int32)]
+        if self._with_qid:
+            specs.append(("qid", B, np.int32))
+        specs += [("index", nnz_pad, np.int32), ("ebin", nnz_pad, np.uint8),
+                  ("emask", nnz_pad, np.bool_)]
+        out = _acquire_arena_views(specs)
+        # arenas are recycled: every lane byte is written (data then pad)
+        _fill(out["label"], self._lab[:nr], 0)
+        _fill(out["weight"], self._wgt[:nr], 0)
+        rp = out["row_ptr"]
+        rp[0] = 0
         rp[1:nr + 1] = np.cumsum(lens)
         rp[nr + 1:] = rp[nr]
-        out = {
+        if self._with_qid:
+            _fill(out["qid"], self._qid[:nr], 0)
+        self._gather_entries(nnz, out["index"], out["ebin"], out["emask"])
+        out["index"][nnz:] = 0
+        out["ebin"][nnz:] = self._pad_bin
+        out["emask"][nnz:] = False
+        batch = {
             "num_rows": nr,
-            "label": _padded(self._lab[:nr], B, np.float32, 0),
-            "weight": _padded(self._wgt[:nr], B, np.float32, 0),
-            "qid": (_padded(self._qid[:nr], B, np.int32, 0)
-                    if self._with_qid else None),
+            "label": out["label"],
+            "weight": out["weight"],
+            "qid": out["qid"] if self._with_qid else None,
             "row_ptr": rp,
-            "index": _padded(self._idx[:nnz], nnz_pad, np.int32, 0),
-            "ebin": _padded(self._ebin[:nnz], nnz_pad, np.uint8,
-                            self._pad_bin),
-            "emask": _padded(self._emask[:nnz], nnz_pad, bool, False),
+            "index": out["index"],
+            "ebin": out["ebin"],
+            "emask": out["emask"],
         }
         self._lab, self._wgt = self._lab[nr:], self._wgt[nr:]
         if self._with_qid:
             self._qid = self._qid[nr:]
         self._len = self._len[nr:]
-        self._idx, self._ebin = self._idx[nnz:], self._ebin[nnz:]
-        self._emask = self._emask[nnz:]
-        return out
+        return batch
 
 
-def _padded(a: np.ndarray, n: int, dtype, fill) -> np.ndarray:
-    out = np.full(n, fill, dtype)
+def _fill(out: np.ndarray, a: np.ndarray, fill) -> None:
     out[:a.shape[0]] = a
-    return out
+    out[a.shape[0]:] = fill
 
 
 # ---- build ------------------------------------------------------------------
@@ -641,7 +780,7 @@ class BinnedRowIter:
                     continue  # part produced no rows at build time
                 r.seek_to(int(ent["offset"]))
                 for _ in range(int(ent["records"])):
-                    buf = r.next_block()
+                    buf = r.next_block_view()
                     if buf is None:
                         break  # recover mode skipped a corrupt tail
                     yield unpack_block(buf)
@@ -812,7 +951,7 @@ class BinnedStagingIter:
         try:
             r.seek_to(int(ent["offset"]))
             for _ in range(int(ent["records"])):
-                buf = r.next_block()
+                buf = r.next_block_view()
                 if buf is None:
                     break
                 yield unpack_block(buf)
@@ -857,7 +996,7 @@ class BinnedStagingIter:
                 t0 = time.monotonic()
                 r.seek_to(int(ent["offset"]))
                 for _ in range(int(ent["records"])):
-                    buf = r.next_block()
+                    buf = r.next_block_view()
                     if buf is None:
                         break
                     outs = list(rp.feed(unpack_block(buf)))
@@ -915,14 +1054,19 @@ class BinnedStagingIter:
             leaves = ((w["label"], w["weight"], w["row_ptr"], w["index"],
                        w["ebin"], w["emask"], num_rows)
                       + ((w["qid"],) if with_qid else ()))
+            # donated put: the runtime may consume the arena-backed leaves
+            # in place instead of copying them (DMLCTPU_BINCACHE_DONATE=0
+            # opts out; bit-identity vs the non-donated path is tested)
+            donate = os.environ.get("DMLCTPU_BINCACHE_DONATE", "1") != "0"
             if self._sharding is None:
-                staged = jax.device_put(leaves)
+                staged = _device_put_maybe_donated(leaves, donate=donate)
             else:
                 sh, repl = self._sharding, _replicated_sharding(
                     self._sharding)
                 shardings = ((sh, sh, repl, sh, sh, sh, repl)
                              + ((sh,) if with_qid else ()))
-                staged = jax.device_put(leaves, shardings)
+                staged = _device_put_maybe_donated(leaves, shardings,
+                                                   donate=donate)
             batch = BinnedBatch(
                 label=staged[0], weight=staged[1], row_ptr=staged[2],
                 index=staged[3], ebin=staged[4], emask=staged[5],

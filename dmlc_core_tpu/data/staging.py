@@ -274,6 +274,31 @@ def _replicated_sharding(sharding):
     return sharding
 
 
+def _device_put_maybe_donated(leaves, shardings=None, donate: bool = True):
+    """``jax.device_put`` of a staging pytree, donating the host buffers.
+
+    Donation lets the runtime consume the staged leaves instead of
+    defensively copying them — the last host-side copy on the zero-copy
+    bincache hit path (doc/binned_cache.md).  The staging contract already
+    guarantees the leaves are never touched again after the put (the
+    repacker hands each batch fresh arena views), so donation is safe;
+    arena recycling stays correct because jax holds the leaf references
+    until the async transfer completes, which pins the arena finalizer.
+    Falls back to a plain put when ``donate`` is off
+    (``DMLCTPU_BINCACHE_DONATE=0``) or the installed jax predates the
+    ``donate=`` keyword."""
+    if donate:
+        try:
+            if shardings is None:
+                return jax.device_put(leaves, donate=True)
+            return jax.device_put(leaves, shardings, donate=True)
+        except TypeError:  # jax without device_put(donate=)
+            pass
+    if shardings is None:
+        return jax.device_put(leaves)
+    return jax.device_put(leaves, shardings)
+
+
 def _multihost_rounds(native, payload_len: int, pack):
     """Coordinate one epoch of multi-host staging: yield (local, gathered)
     per GLOBAL batch, where ``local`` is this process's item (None once

@@ -271,11 +271,18 @@ def stall_attribution(before: dict, after: dict,
     # binned epoch cache (doc/binned_cache.md): when the interval served
     # from cache (hit bytes or read time moved), the cache read stage joins
     # the table in place of the parse work it replaced; text-parse epochs
-    # keep the classic table
+    # keep the classic table.  copy_ratio = bytes copied host-side per byte
+    # served — the zero-copy hit path's proof metric (~0 when the mmap
+    # backend serves borrowed views; >=1 when every block goes through
+    # decode buffers, i.e. the streaming fallback engaged)
     cache_busy, cache_wait = us("cache.busy_us"), us("cache.wait_us")
-    if cache_busy or cache_wait or d.get("cache.hit_bytes", 0):
+    cache_hit = d.get("cache.hit_bytes", 0)
+    if cache_busy or cache_wait or cache_hit:
         stages["cache"] = {"busy_s": round(cache_busy, 6),
-                           "wait_s": round(cache_wait, 6)}
+                           "wait_s": round(cache_wait, 6),
+                           "copy_ratio": round(
+                               d.get("cache.bytes_copied", 0) / cache_hit, 4)
+                           if cache_hit else 0.0}
 
     sharded = d.get("shard.parts", 0) > 0
     candidates = [n for n in stages if not (sharded and n == "parse")]

@@ -28,6 +28,7 @@ REGISTERED = [
     "cpp/include/dmlctpu/lockfree_queue.h",
     "cpp/include/dmlctpu/fault.h",
     "cpp/src/data/sharded_parser.h",
+    "cpp/src/data/binned_cache.h",
 ]
 
 ATOMIC_OP_RE = re.compile(

@@ -82,7 +82,10 @@ done
 # and the two sanitizers compose).  -fno-sanitize-recover=all turns every
 # UBSan diagnostic into an abort so a report can never scroll by green;
 # the grep below catches ASan reports from forked children whose exit
-# status a suite might swallow.
+# status a suite might swallow.  test_data includes the native bincache
+# suite — mmap-borrowed block views, the recycled arena pool, and the
+# truncated-mapping fallback (doc/binned_cache.md "zero-copy hit path")
+# are exactly the lifetime bugs this tier exists to catch.
 mkdir -p build/asan
 for t in test_data test_telemetry; do
   asan_bin=build/asan/$t

@@ -9,6 +9,7 @@ triggers exactly ONE counted rebuild — plus RecordIO recover resync over
 mid-file corruption and tracker-coordinated shard handoff served from the
 thief's cache read path.
 """
+import gc
 import json
 import subprocess
 import sys
@@ -22,7 +23,8 @@ from dmlc_core_tpu import telemetry
 from dmlc_core_tpu._native import NativeError
 from dmlc_core_tpu.data import (BinnedRowIter, BinnedStagingIter,
                                 DeviceStagingIter, build_bin_cache)
-from dmlc_core_tpu.data.binned_cache import bin_entries_np, cuts_digest_of
+from dmlc_core_tpu.data.binned_cache import (_NativeReader, bin_entries_np,
+                                             cuts_digest_of)
 from dmlc_core_tpu.models import GBDT, QuantileBinner
 
 REPO = Path(__file__).resolve().parent.parent
@@ -334,6 +336,133 @@ def test_device_staging_iter_bin_cache_knob(data, tmp_path):
 
     with pytest.raises(ValueError, match="binner"):
         DeviceStagingIter(str(data), bin_cache=str(cache))
+
+
+# ---- the zero-copy hit path (doc/binned_cache.md) ---------------------------
+
+
+def _drain_views(reader):
+    out = []
+    while (v := reader.next_block_view()) is not None:
+        out.append(v)
+    return out
+
+
+def test_mmap_and_streaming_backends_bit_identical(data, tmp_path,
+                                                   monkeypatch):
+    cache, _ = _build_direct(data, tmp_path)
+    r = _NativeReader(str(cache))
+    assert r.backend == 1  # mmap: the default for a strict local open
+    views = _drain_views(r)
+    assert views and all(v.dtype == np.uint8 for v in views)
+
+    monkeypatch.setenv("DMLCTPU_BINCACHE_MMAP", "0")
+    s = _NativeReader(str(cache))
+    assert s.backend == 0
+    streamed = []
+    while (b := s.next_block()) is not None:
+        streamed.append(b)
+    assert [v.tobytes() for v in views] == streamed
+
+
+def test_streaming_knob_batch_stream_bit_identical(data, monkeypatch):
+    binner = _binner()
+    it = _iter(data, binner)
+    ref = [_bits(b) for b in it]  # builds, then serves via mmap views
+    monkeypatch.setenv("DMLCTPU_BINCACHE_MMAP", "0")
+    got = [_bits(b) for b in _iter(data, binner)]
+    assert got == ref
+
+
+def test_borrowed_view_survives_reader_close(data, tmp_path):
+    cache, _ = _build_direct(data, tmp_path)
+    r = _NativeReader(str(cache))
+    assert r.backend == 1
+    v = r.next_block_view()
+    raw = v.tobytes()
+    r.close()   # drops the reader's reference; the view pins the mapping
+    del r
+    gc.collect()
+    assert v.tobytes() == raw
+
+
+def test_truncated_cache_rejected_before_mapping(data, tmp_path,
+                                                 monkeypatch):
+    # size is checked against the header before any mmap: a truncated copy
+    # must surface as a clean invalid-cache error, never a SIGBUS on read
+    monkeypatch.setenv("DMLCTPU_BINCACHE_MMAP", "1")
+    cache, _ = _build_direct(data, tmp_path)
+    cache.write_bytes(cache.read_bytes()[:-7])
+    r = _NativeReader(str(cache))
+    assert not r.valid and "truncated" in r.error
+    with pytest.raises(ValueError, match="truncated"):
+        BinnedRowIter(str(cache))
+
+
+def test_recover_mode_takes_streaming_backend(data, tmp_path):
+    cache, _ = _build_direct(data, tmp_path)
+    assert _NativeReader(str(cache)).backend == 1
+    # recover must resync past damage, which the strict view cursor cannot
+    # do — a recover open always streams, and still serves the good blocks
+    row = BinnedRowIter(str(cache))
+    victim = sorted(row.part_map)[len(row.part_map) // 2]
+    off = int(row.part_map[victim]["offset"])
+    raw = bytearray(cache.read_bytes())
+    raw[off] ^= 0x5A
+    cache.write_bytes(bytes(raw))
+
+    rec = _NativeReader(str(cache), recover=True)
+    assert rec.backend == 0
+    before = telemetry.counter_get("record.corrupt_skipped")
+    served = _drain_views(rec)
+    assert served
+    if telemetry.enabled():
+        assert telemetry.counter_get("record.corrupt_skipped") > before
+
+
+def test_repeat_epoch_copy_ratio_and_stall_stage(data):
+    if not telemetry.enabled():
+        pytest.skip("copy accounting needs telemetry")
+    it = _iter(data, _binner())
+    for _ in it:    # build epoch (don't hold batches: arenas recycle)
+        pass
+    before = telemetry.snapshot()
+    hit0 = telemetry.counter_get("cache.hit_bytes")
+    copied0 = telemetry.counter_get("cache.bytes_copied")
+    t0 = time.monotonic()
+    for _ in it:    # pure hit epoch over mmap views
+        pass
+    wall = time.monotonic() - t0
+    hit = telemetry.counter_get("cache.hit_bytes") - hit0
+    copied = telemetry.counter_get("cache.bytes_copied") - copied0
+    assert hit > 0
+    # the zero-copy contract: < 10% of served bytes are ever host-copied
+    assert copied / hit < 0.1
+    attr = telemetry.stall_attribution(before, telemetry.snapshot(),
+                                       wall_s=max(wall, 1e-3))
+    assert "cache" in attr["stages"]
+    assert attr["stages"]["cache"]["copy_ratio"] < 0.1
+
+
+def test_donated_and_undonated_stage_bit_identical(data, monkeypatch):
+    binner = _binner()
+    ref = [_bits(b) for b in _iter(data, binner)]
+    monkeypatch.setenv("DMLCTPU_BINCACHE_DONATE", "0")
+    got = [_bits(b) for b in _iter(data, binner)]
+    assert got == ref
+
+
+def test_arena_reuse_across_epochs(data):
+    if not telemetry.enabled():
+        pytest.skip("arena accounting needs telemetry")
+    it = _iter(data, _binner())
+    for _ in it:    # first epoch allocates the batch arenas
+        pass
+    gc.collect()    # every batch dropped -> its arena returns to the pool
+    reuse0 = telemetry.counter_get("cache.arena_reuse")
+    for _ in it:    # same geometry: the repack lands in recycled arenas
+        pass
+    assert telemetry.counter_get("cache.arena_reuse") > reuse0
 
 
 # ---- two-process shard handoff served from the thief's cache ----------------
