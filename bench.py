@@ -760,6 +760,60 @@ def run_gbdt() -> dict:
                        hnp.max(hnp.abs(outs["xla"] - outs["pallas"]))), 7),
                    "note": "off-TPU pallas runs in interpret mode; "
                            "timing not comparable"}
+    # sparse-histogram-backend A/B (ISSUE 14): the SAME COO fit_batch data
+    # through XLA scatter and the feature-sorted sparse Pallas kernel.  On
+    # TPU: two full steady-state fits and their ratio as
+    # `sparse_hist_speedup`.  Off-TPU the kernel only exists in interpret
+    # mode, so a tiny histogram_gh_sparse A/B records correctness + an
+    # honest interpret timing (standing TPU-tunnel caveat applies).
+    sparse_hist_ab = {}
+    if platform == "tpu":
+        sp_times = {}
+        for impl in ("xla", "pallas"):
+            try:
+                m = GBDT(num_features=sf, num_trees=5, max_depth=6,
+                         num_bins=256, learning_rate=0.4,
+                         missing_aware=True, histogram=impl)
+                jax.block_until_ready(
+                    m.fit_batch(batch, binner)["leaf"])  # warmup
+                t0 = time.monotonic()
+                p = m.fit_batch(batch, binner)
+                jax.block_until_ready(p["leaf"])
+                sp_times[impl] = time.monotonic() - t0
+                sparse_hist_ab[f"row_trees_s_{impl}"] = round(
+                    rows * m.num_trees / sp_times[impl])
+            except Exception as e:  # noqa: BLE001 — per-backend isolation
+                sparse_hist_ab[f"{impl}_error"] = str(e)[-200:]
+        if len(sp_times) == 2:
+            sparse_hist_ab["sparse_hist_speedup"] = round(
+                sp_times["xla"] / sp_times["pallas"], 3)
+    else:
+        import jax.numpy as hnp
+        from dmlc_core_tpu.ops.pallas_segment import histogram_gh_sparse
+        hn, hf, hb, hnnz, hrows = 8, 5, 16, 4096, 512
+        srid = hnp.asarray(rng.integers(0, hrows, hnnz).astype(np.int32))
+        sfi = hnp.asarray(rng.integers(0, hf, hnnz).astype(np.int32))
+        seb = hnp.asarray(rng.integers(1, hb, hnnz).astype(np.int32))
+        sem = hnp.ones(hnnz, bool)
+        srel = hnp.asarray(rng.integers(0, hn, hrows).astype(np.int32))
+        sgh = hnp.asarray(rng.standard_normal((hrows, 2)).astype(np.float32))
+        times = {}
+        outs = {}
+        for impl in ("xla", "pallas"):
+            jax.block_until_ready(histogram_gh_sparse(
+                srid, sfi, seb, sem, srel, sgh, hn, hf, hb, force=impl))
+            t0 = time.monotonic()
+            outs[impl] = histogram_gh_sparse(
+                srid, sfi, seb, sem, srel, sgh, hn, hf, hb, force=impl)
+            jax.block_until_ready(outs[impl])
+            times[impl] = round((time.monotonic() - t0) * 1e3, 2)
+        sparse_hist_ab = {
+            "interpret_ms_pallas": times["pallas"],
+            "xla_ms": times["xla"],
+            "max_abs_err": round(float(
+                hnp.max(hnp.abs(outs["xla"] - outs["pallas"]))), 7),
+            "note": "off-TPU pallas runs in interpret mode; "
+                    "timing not comparable"}
     return {"rows": rows, "trees": model.num_trees,
             "depth": model.max_depth, "secs": round(secs, 3),
             "row_trees_s": round(rows * model.num_trees / secs),
@@ -768,6 +822,7 @@ def run_gbdt() -> dict:
             "sparse_nnz": rows * nnz_per_row,
             "sparse_features": sf,
             "hist_ab": hist_ab,
+            "sparse_hist_ab": sparse_hist_ab,
             "hist_note": hist_note,
             "platform": platform}
 
@@ -1553,6 +1608,7 @@ def main() -> None:
         "gbdt_row_trees_per_sec": phases.get("gbdt", {}).get("row_trees_s"),
         "gbdt_sparse_row_trees_per_sec": phases.get("gbdt", {}).get(
             "sparse_row_trees_s"),
+        "gbdt_sparse_hist_ab": phases.get("gbdt", {}).get("sparse_hist_ab"),
         "gbdt_platform": phases.get("gbdt", {}).get("platform"),
         "gbdt_mesh": phases.get("gbdt_mesh"),
         "h2d_gbps_single_chip": phases.get("h2d", {}).get("gbps"),
@@ -1585,6 +1641,12 @@ def main() -> None:
         "gbdt_row_trees_per_sec": full["gbdt_row_trees_per_sec"],
         "model_family_rows_s": full["model_family_rows_s"],
         "gbdt_hist_ab": gbdt.get("hist_ab"),
+        # headline only (full A/B dict rides the DETAIL line): the compact
+        # line's 1 KB tail-capture contract can't afford both dicts
+        "gbdt_sparse_hist_speedup": (gbdt.get("sparse_hist_ab") or {}).get(
+            "sparse_hist_speedup"),
+        "gbdt_sparse_hist_max_abs_err": (
+            gbdt.get("sparse_hist_ab") or {}).get("max_abs_err"),
         "allreduce_bus_gbps": full["allreduce_bus_gbps"],
         "h2d_gbps": full["h2d_gbps_single_chip"],
         "staging_platform": full["staging_platform"],

@@ -33,13 +33,17 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import os
+import time
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.pallas_segment import histogram_gh
+from .. import telemetry
+from ..ops.pallas_segment import (histogram_gh, histogram_gh_sparse_kernel,
+                                  segment_sum, sparse_hist_layout)
 
 
 class QuantileBinner:
@@ -553,6 +557,12 @@ class GBDT:
             raise ValueError("scale_pos_weight applies to the logistic "
                              "objective (weight rows directly otherwise)")
         self.scale_pos_weight = scale_pos_weight
+        if histogram == "auto":
+            # bench/ops escape hatch: force a histogram backend fleet-wide
+            # without touching model code.  An explicit constructor
+            # argument always wins over the environment.
+            histogram = (os.environ.get("DMLCTPU_GBDT_HISTOGRAM", "").strip()
+                         or "auto")
         if histogram not in ("auto", "xla", "pallas"):
             raise ValueError("histogram must be 'auto', 'xla' or 'pallas'")
         self.histogram = histogram
@@ -662,6 +672,122 @@ class GBDT:
                                     check_replication=False)(
                                         bins_i, rel, gh)
         return histogram_gh(bins_i, rel, gh, n_nodes, B, force=impl)
+
+    # The sparse-kernel analogue of _PALLAS_NODE_LIMIT.  The sparse
+    # kernel's compare work is O(nnz * KEY_TILE) — independent of n_nodes
+    # AND of F (the feature-sorted span table means a key tile never sees
+    # another feature's entries) — so, exactly like the dense kernel, the
+    # only thing that grows with depth is the MXU M axis and the VMEM
+    # tiles (A [NNZ_TILE, 2*n_pad], out [2*n_pad, KEY_TILE]).  Same cap,
+    # same rationale: the edge of measured territory, not a crossover.
+    _SPARSE_PALLAS_NODE_LIMIT = 512
+
+    def _hist_impl_sparse(self, n_nodes: int) -> str:
+        """Sparse-histogram backend for a level: `_hist_impl`'s resolution
+        rule against the sparse node cap.  Explicit "xla"/"pallas" wins;
+        "auto" = the kernel on a single-device TPU (or any TPU mesh when
+        the explicit ``histogram_mesh`` shard_map route is declared)
+        within the cap, XLA scatter elsewhere."""
+        if self.histogram != "auto":
+            return self.histogram
+        if self.histogram_mesh is not None:
+            if (jax.default_backend() == "tpu"
+                    and n_nodes <= self._SPARSE_PALLAS_NODE_LIMIT):
+                return "pallas"
+            return "xla"
+        if (jax.default_backend() == "tpu"
+                and jax.device_count() == 1
+                and n_nodes <= self._SPARSE_PALLAS_NODE_LIMIT):
+            return "pallas"
+        return "xla"
+
+    def _sparse_layout_enabled(self, streamed: bool = False) -> bool:
+        """Whether this fit's configuration can route any level through the
+        sparse Pallas kernel — i.e. whether `_sparse_fit_layout` would
+        build a layout.  Checked *before* entry arrays exist (streamed
+        fits use it to decide whether pass 0 should accumulate the global
+        entry arrays the sort needs)."""
+        if self.histogram == "xla":
+            return False
+        if streamed and self.histogram_mesh is not None:
+            return False
+        if self.histogram == "auto" and not any(
+                self._hist_impl_sparse(2 ** d) == "pallas"
+                for d in range(self.max_depth)):
+            return False
+        return True
+
+    def _sparse_fit_layout(self, row_id, findex, ebin, emask, rows: int,
+                           streamed: bool = False):
+        """The once-per-fit feature-sorted entry layout, or None when no
+        level of this fit can resolve to the sparse Pallas kernel (the
+        scatter path needs no layout).  Built host-side — ``findex`` is
+        static across every level and tree, so the sort amortizes over
+        ``num_trees * max_depth`` level passes; the one-time cost is
+        published as ``gbdt.entry_sort_us``.  Sharded over the
+        ``histogram_mesh`` axis when declared (streamed fits keep the
+        kernel single-device: their batch slicing is row-offset based and
+        never mesh-sharded)."""
+        if not self._sparse_layout_enabled(streamed):
+            return None
+        num_shards = 1
+        if self.histogram_mesh is not None:
+            mesh, axis = self.histogram_mesh
+            num_shards = mesh.shape[axis]
+        t0 = time.monotonic()
+        layout = sparse_hist_layout(row_id, findex, ebin, emask,
+                                    self.num_features, self.num_bins,
+                                    num_shards=num_shards, rows=rows)
+        try:
+            telemetry.counter_add("gbdt.entry_sort_us",
+                                  int((time.monotonic() - t0) * 1e6))
+        except Exception:  # no native runtime: models stay pure-JAX usable
+            pass
+        return layout
+
+    def _level_histogram_sparse(self, layout, rel: jax.Array,
+                                gh_row: jax.Array, gh_e, n_nodes: int):
+        """Sparse per-level [nodes, F, bins, 2] via the Pallas kernel.
+
+        Single-device: entry gathers against the feature-sorted layout
+        (``gh_e`` pre-gathered per tree by the caller; only ``rel``
+        changes per level) feed one kernel call.  With ``histogram_mesh``
+        the packed per-shard layout slices ride ``shard_map`` ``P(axis)``
+        in_specs, each device runs the kernel on its local rows' entries,
+        and an explicit psum combines the shards — the same
+        rabit-histogram-allreduce shape as the dense `_level_histogram`
+        route (the per-tree gh gather moves inside the shard_map body
+        there, since gh is only device-local under the mesh)."""
+        F, B = self.num_features, self.num_bins
+        try:
+            telemetry.counter_add("gbdt.hist_sparse_pallas", 1)
+        except Exception:
+            pass
+        if self.histogram_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.collective import shard_map_compat
+
+            mesh, axis = self.histogram_mesh
+            mt = layout.max_tiles
+
+            def local(gk, rid_l, w_l, ts, tc, rel_l, gh_l):
+                rel_e = rel_l[rid_l]
+                ghe = gh_l[rid_l].astype(jnp.float32) * w_l[:, None]
+                h = histogram_gh_sparse_kernel(gk, rel_e, ghe, ts, tc,
+                                               n_nodes, F, B, mt)
+                return jax.lax.psum(h, axis)
+
+            spec = P(axis)
+            return shard_map_compat(local, mesh,
+                                    in_specs=(spec,) * 7, out_specs=P(),
+                                    check_replication=False)(
+                layout.gkey, layout.rid, layout.w,
+                layout.tstart, layout.tcount, rel, gh_row)
+        rel_e = rel[layout.rid]
+        return histogram_gh_sparse_kernel(
+            layout.gkey, rel_e, gh_e, layout.tstart, layout.tcount,
+            n_nodes, F, B, layout.max_tiles)
 
     # ---- forest construction ------------------------------------------------
 
@@ -1281,40 +1407,58 @@ class GBDT:
             active = self._next_active(active, split_f, split_b)
         return split_f, split_b, split_d, split_g, lo, hi, active
 
-    def _build_tree_sparse(self, row_id: jax.Array, findex: jax.Array,
-                           ebin: jax.Array, emask: jax.Array,
-                           grad: jax.Array, hess: jax.Array,
-                           col_mask: jax.Array, col_key: jax.Array):
+    @staticmethod
+    def _sparse_entries(row_id, findex, ebin, emask):
+        """Pre-cast entry arrays for `_build_tree_sparse`, computed ONCE
+        per fit: the int32 casts and the broadcastable f32 emask are
+        invariant across every tree of the batch (only the (grad, hess)
+        values change), so re-deriving them per tree was pure waste."""
+        return (row_id.astype(jnp.int32), findex.astype(jnp.int32),
+                jnp.asarray(ebin, jnp.int32), emask,
+                emask.astype(jnp.float32)[:, None])
+
+    def _build_tree_sparse(self, entries, grad: jax.Array, hess: jax.Array,
+                           col_mask: jax.Array, col_key: jax.Array,
+                           layout=None):
         """One tree from COO entries — O(nnz) histogram work per level.
 
-        The sparse formulation of `_build_tree`: present entries scatter
-        their row's (grad, hess) into [nodes, features, bins] keyed by
-        (node(row), feature, bin); each (node, feature)'s missing mass is
-        the node total minus its present sum, and the dual-direction gain
-        machinery is shared with the dense missing-aware path.  Requires
-        ``missing_aware=True`` bins from ``transform_entries`` (all codes
-        >= 1; bin 0 stays empty).
+        The sparse formulation of `_build_tree`: present entries
+        accumulate their row's (grad, hess) into [nodes, features, bins]
+        keyed by (node(row), feature, bin); each (node, feature)'s missing
+        mass is the node total minus its present sum, and the
+        dual-direction gain machinery is shared with the dense
+        missing-aware path.  Requires ``missing_aware=True`` bins from
+        ``transform_entries`` (all codes >= 1; bin 0 stays empty).
 
-        Deliberately XLA-scatter-only (no ``histogram=`` backend): the
-        Pallas one-hot contraction amortizes its compare work by blocking
-        per feature, which needs feature-sorted keys; COO entries arrive
-        feature-unsorted, so the kernel would pay the full
-        nnz x (nodes*features*bins) compare cost — strictly worse than
-        O(nnz) scatter.
+        Histogram accumulation routes through the ``histogram=`` backend
+        knob per level (`_hist_impl_sparse`): XLA keeps the flattened-key
+        scatter-add; "pallas" runs the feature-sorted one-hot-contraction
+        kernel against ``layout`` (the once-per-fit sorted entry layout
+        from `_sparse_fit_layout` — the old docstring objection that
+        unsorted COO entries make the kernel pay a full
+        nnz x (nodes*features*bins) compare cost dissolves because
+        ``findex`` never changes across levels or trees, so one sort
+        serves the whole fit).  On kernel levels the node totals and leaf
+        sums ride the multi-lane pallas ``segment_sum``; under
+        ``histogram_mesh`` they stay on XLA scatter so GSPMD inserts
+        their psum.
 
-        row_id/findex/ebin/emask: [nnz] (emask 0 for padding lanes);
-        grad/hess: [rows] weight-scaled.  Returns the same 7-tuple as
-        `_build_tree`.
+        entries: the `_sparse_entries` tuple (pre-cast once per fit;
+        emask 0 marks padding lanes); grad/hess: [rows] weight-scaled.
+        Returns the same 7-tuple as `_build_tree`.
         """
         F, B = self.num_features, self.num_bins
         rows = grad.shape[0]
         mono = self.monotone_constraints is not None
-        rid = row_id.astype(jnp.int32)
-        fi = findex.astype(jnp.int32)
-        # entry-level (grad, hess) lanes; padding lanes carry 0 mass
-        gh_k = (jnp.stack([grad, hess], axis=-1)[rid]
-                * emask.astype(jnp.float32)[:, None])
+        rid, fi, ebin, emask, emw = entries
+        mesh = self.histogram_mesh is not None
         gh_row = jnp.stack([grad, hess], axis=-1)          # [rows, 2]
+        # entry-level (grad, hess) lanes, gathered once per TREE (the
+        # values change with the margins, so this is the hoist floor):
+        # scatter levels want unsorted gh_k, kernel levels the sorted gh_e
+        gh_k = gh_e = None
+        if layout is not None and not mesh:
+            gh_e = gh_row[layout.rid] * layout.w[:, None]
 
         node = jnp.zeros(rows, jnp.int32)
         lo = jnp.full(1, -jnp.inf)
@@ -1326,12 +1470,21 @@ class GBDT:
             first = 2 ** depth - 1
             n_nodes = 2 ** depth
             rel = node - first
-            keys = (rel[rid] * F + fi) * B + ebin
-            hist = jax.ops.segment_sum(
-                gh_k, keys, num_segments=n_nodes * F * B
-            ).reshape(n_nodes, F, B, 2)                     # bin 0 is empty
-            gh_node = jax.ops.segment_sum(gh_row, rel,
-                                          num_segments=n_nodes)  # [n, 2]
+            impl = (self._hist_impl_sparse(n_nodes)
+                    if layout is not None else "xla")
+            if impl == "pallas":
+                hist = self._level_histogram_sparse(layout, rel, gh_row,
+                                                    gh_e, n_nodes)
+            else:
+                if gh_k is None:
+                    gh_k = gh_row[rid] * emw   # padding lanes carry 0 mass
+                keys = (rel[rid] * F + fi) * B + ebin
+                hist = jax.ops.segment_sum(
+                    gh_k, keys, num_segments=n_nodes * F * B
+                ).reshape(n_nodes, F, B, 2)                 # bin 0 is empty
+            gh_node = segment_sum(
+                gh_row, rel, num_segments=n_nodes,
+                force="pallas" if impl == "pallas" and not mesh else None)
             (split_f, split_b, split_d, split_g,
              lo, hi, active) = self._level_splits_from_hist(
                 hist, gh_node, depth, col_mask, col_key, lo, hi, active)
@@ -1347,8 +1500,11 @@ class GBDT:
 
         n_leaves = 2 ** self.max_depth
         leaf_rel = node - (n_leaves - 1)
-        gh_leaf = jax.ops.segment_sum(gh_row, leaf_rel,
-                                      num_segments=n_leaves)
+        leaf_force = ("pallas" if layout is not None and not mesh
+                      and self._hist_impl_sparse(n_leaves) == "pallas"
+                      else None)
+        gh_leaf = segment_sum(gh_row, leaf_rel, num_segments=n_leaves,
+                              force=leaf_force)
         leaf_w = -gh_leaf[:, 0] / (gh_leaf[:, 1] + self.lambda_)
         if mono:
             leaf_w = jnp.clip(leaf_w, lo, hi)
@@ -1527,6 +1683,11 @@ class GBDT:
         label = batch.label.astype(jnp.float32)
         w = (batch.weight if weight is None else weight).astype(jnp.float32)
         row_id, findex, ebin, emask = self._entry_bins(batch, binner)
+        # invariant across every tree: the pre-cast entry tuple and (for
+        # the pallas backend) the feature-sorted layout, built exactly once
+        entries = self._sparse_entries(row_id, findex, ebin, emask)
+        layout = self._sparse_fit_layout(row_id, findex, ebin, emask,
+                                         rows=int(label.shape[0]))
         eval_margin = eval_label = eval_weight = None
         if eval_set is not None:
             # eval_set: a held-out PaddedBatch (weight-0 rows excluded
@@ -1548,7 +1709,7 @@ class GBDT:
             return self._boost(
                 label, w,
                 lambda g, h, cm, ck: self._build_tree_sparse(
-                    row_id, findex, ebin, emask, g, h, cm, ck),
+                    entries, g, h, cm, ck, layout=layout),
                 eval_margin=eval_margin, eval_label=eval_label,
                 eval_weight=eval_weight,
                 early_stopping_rounds=early_stopping_rounds,
@@ -1558,7 +1719,7 @@ class GBDT:
         return driver(
             label, w,
             lambda g, h, cm, ck: self._build_tree_sparse(
-                row_id, findex, ebin, emask, g, h, cm, ck),
+                entries, g, h, cm, ck, layout=layout),
             eval_margin=eval_margin, eval_label=eval_label,
             eval_weight=eval_weight,
             early_stopping_rounds=early_stopping_rounds)
@@ -1590,6 +1751,12 @@ class GBDT:
         for the previous level rides the same pass as the next level's
         histogram accumulation, and per-batch entry bins are recomputed
         per pass (compute is cheap next to the IO it avoids holding).
+        When the ``histogram=`` knob resolves levels to the sparse Pallas
+        kernel, the contract relaxes by exactly one resident structure:
+        the once-per-fit feature-sorted entry layout (~13 bytes/entry —
+        int32 key, int32 row, f32 weight), built in pass 0 and reused for
+        every ``num_trees * max_depth`` kernel level; routing still
+        re-streams, so the pass count is unchanged.
         Builds the same forest as ``fit_batch`` on the concatenated data:
         split finding is shared (`_level_splits_from_hist`) and histogram
         accumulation is mathematically associative, though per-batch
@@ -1617,9 +1784,19 @@ class GBDT:
         else:
             replay = batches if callable(batches) else (lambda: iter(batches))
 
-        # pass 0: resident row-level state + per-batch row offsets
+        # pass 0: resident row-level state + per-batch row offsets (plus,
+        # when a level can resolve to the sparse Pallas kernel, the
+        # globalized entry arrays the once-per-fit feature sort needs)
+        want_layout = self._sparse_layout_enabled(streamed=True)
         labels, weights, qids, offsets = [], [], [], [0]
+        ent = ([], [], [], []) if want_layout else None
         for b in replay():
+            if want_layout:
+                rid_b, fi_b, eb_b, em_b = self._entry_bins(b, binner)
+                ent[0].append(np.asarray(rid_b, np.int64) + offsets[-1])
+                ent[1].append(np.asarray(fi_b))
+                ent[2].append(np.asarray(eb_b))
+                ent[3].append(np.asarray(em_b))
             labels.append(np.asarray(b.label, np.float32))
             weights.append(np.asarray(b.weight, np.float32))
             if b.qid is not None:
@@ -1633,6 +1810,13 @@ class GBDT:
                if len(qids) == len(labels) else None)
         rows = int(label.shape[0])
         F, B = self.num_features, self.num_bins
+        layout = None
+        if want_layout:
+            layout = self._sparse_fit_layout(
+                np.concatenate(ent[0]), np.concatenate(ent[1]),
+                np.concatenate(ent[2]), np.concatenate(ent[3]),
+                rows=rows, streamed=True)
+            ent = None  # only the sorted layout stays resident
 
         def stream():
             for i, b in enumerate(replay()):
@@ -1641,8 +1825,28 @@ class GBDT:
         def batch_entries(b):
             return self._entry_bins(b, binner)
 
+        def route_pass(node, prev, first_prev):
+            # one streamed pass routing every row through `prev`'s splits
+            # (per-batch entry bins recomputed, per the residency contract)
+            pf, pb, pd = prev
+            routed = []
+            for off, b in stream():
+                nb = int(b.label.shape[0])
+                rid, fi, ebin, emask = batch_entries(b)
+                node_b = node[off:off + nb]
+                rel_p = node_b - first_prev
+                go_right = self._route_sparse(fi, ebin, emask, rid,
+                                              pf[rel_p], pb[rel_p],
+                                              pd[rel_p], nb)
+                routed.append(2 * node_b + 1 + go_right.astype(jnp.int32))
+            return jnp.concatenate(routed)
+
         def build_tree(grad, hess, col_mask, ck):
             gh_row = jnp.stack([grad, hess], axis=-1)      # [rows, 2]
+            # per-TREE hoist for kernel levels: the sorted entry gather of
+            # this tree's (grad, hess); only rel changes across levels
+            gh_e = (gh_row[layout.rid] * layout.w[:, None]
+                    if layout is not None else None)
             node = jnp.zeros(rows, jnp.int32)
             lo = jnp.full(1, -jnp.inf)
             hi = jnp.full(1, jnp.inf)
@@ -1653,35 +1857,55 @@ class GBDT:
             for depth in range(self.max_depth):
                 first = 2 ** depth - 1
                 n_nodes = 2 ** depth
-                hist = jnp.zeros((n_nodes * F * B, 2), jnp.float32)
-                routed = []
-                for off, b in stream():
-                    nb = int(b.label.shape[0])
-                    rid, fi, ebin, emask = batch_entries(b)
-                    node_b = node[off:off + nb]
+                impl = (self._hist_impl_sparse(n_nodes)
+                        if layout is not None else "xla")
+                if impl == "pallas":
+                    # kernel level: routing takes its own streamed pass
+                    # (same total pass count — the scatter branch fuses
+                    # routing into its accumulation pass), then ONE kernel
+                    # call over the resident sorted layout
                     if prev is not None:
-                        # route through the previous level's splits in the
-                        # same pass that accumulates this level's histogram
-                        pf, pb, pd = prev
-                        rel_p = node_b - (2 ** (depth - 1) - 1)
-                        go_right = self._route_sparse(
-                            fi, ebin, emask, rid, pf[rel_p], pb[rel_p],
-                            pd[rel_p], nb)
-                        node_b = 2 * node_b + 1 + go_right.astype(jnp.int32)
-                        routed.append(node_b)
-                    rel = node_b - first
-                    gh_k = (gh_row[off:off + nb][rid]
-                            * emask.astype(jnp.float32)[:, None])
-                    keys = (rel[rid] * F + fi) * B + ebin
-                    hist = hist + jax.ops.segment_sum(
-                        gh_k, keys, num_segments=n_nodes * F * B)
-                if prev is not None:
-                    node = jnp.concatenate(routed)
-                gh_node = jax.ops.segment_sum(gh_row, node - first,
-                                              num_segments=n_nodes)
+                        node = route_pass(node, prev, 2 ** (depth - 1) - 1)
+                        prev = None
+                    rel = node - first
+                    hist4 = self._level_histogram_sparse(
+                        layout, rel, gh_row, gh_e, n_nodes)
+                    gh_node = segment_sum(gh_row, rel,
+                                          num_segments=n_nodes,
+                                          force="pallas")
+                else:
+                    hist = jnp.zeros((n_nodes * F * B, 2), jnp.float32)
+                    routed = []
+                    for off, b in stream():
+                        nb = int(b.label.shape[0])
+                        rid, fi, ebin, emask = batch_entries(b)
+                        node_b = node[off:off + nb]
+                        if prev is not None:
+                            # route through the previous level's splits in
+                            # the same pass that accumulates this level's
+                            # histogram
+                            pf, pb, pd = prev
+                            rel_p = node_b - (2 ** (depth - 1) - 1)
+                            go_right = self._route_sparse(
+                                fi, ebin, emask, rid, pf[rel_p], pb[rel_p],
+                                pd[rel_p], nb)
+                            node_b = (2 * node_b + 1
+                                      + go_right.astype(jnp.int32))
+                            routed.append(node_b)
+                        rel = node_b - first
+                        gh_k = (gh_row[off:off + nb][rid]
+                                * emask.astype(jnp.float32)[:, None])
+                        keys = (rel[rid] * F + fi) * B + ebin
+                        hist = hist + jax.ops.segment_sum(
+                            gh_k, keys, num_segments=n_nodes * F * B)
+                    if prev is not None:
+                        node = jnp.concatenate(routed)
+                    hist4 = hist.reshape(n_nodes, F, B, 2)
+                    gh_node = jax.ops.segment_sum(gh_row, node - first,
+                                                  num_segments=n_nodes)
                 (split_f, split_b, split_d, split_g,
                  lo, hi, active) = self._level_splits_from_hist(
-                    hist.reshape(n_nodes, F, B, 2), gh_node, depth,
+                    hist4, gh_node, depth,
                     col_mask, col_key=ck, lo=lo, hi=hi, active=active)
                 features.append(split_f)
                 thresholds.append(split_b)
@@ -1691,24 +1915,15 @@ class GBDT:
                 prev = (split_f, split_b, split_d)
 
             # final pass: route through the deepest splits to the leaves
-            routed = []
-            first = 2 ** (self.max_depth - 1) - 1
-            for off, b in stream():
-                nb = int(b.label.shape[0])
-                rid, fi, ebin, emask = batch_entries(b)
-                node_b = node[off:off + nb]
-                pf, pb, pd = prev
-                rel_p = node_b - first
-                go_right = self._route_sparse(fi, ebin, emask, rid,
-                                              pf[rel_p], pb[rel_p],
-                                              pd[rel_p], nb)
-                routed.append(2 * node_b + 1 + go_right.astype(jnp.int32))
-            node = jnp.concatenate(routed)
+            node = route_pass(node, prev, 2 ** (self.max_depth - 1) - 1)
 
             n_leaves = 2 ** self.max_depth
             leaf_rel = node - (n_leaves - 1)
-            gh_leaf = jax.ops.segment_sum(gh_row, leaf_rel,
-                                          num_segments=n_leaves)
+            leaf_force = ("pallas" if layout is not None
+                          and self._hist_impl_sparse(n_leaves) == "pallas"
+                          else None)
+            gh_leaf = segment_sum(gh_row, leaf_rel, num_segments=n_leaves,
+                                  force=leaf_force)
             leaf_w = -gh_leaf[:, 0] / (gh_leaf[:, 1] + self.lambda_)
             if self.monotone_constraints is not None:
                 leaf_w = jnp.clip(leaf_w, lo, hi)

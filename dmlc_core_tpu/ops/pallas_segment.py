@@ -18,6 +18,26 @@ for fusing more per-entry math into the reduction.
 ``segment_sum(..., force=...)`` picks the implementation; the default
 keeps XLA's scatter.  On non-TPU backends the kernel runs in interpret
 mode (tests exercise it on the CPU mesh).
+
+Sparse histogram
+----------------
+
+``histogram_gh_sparse`` extends the histogram-as-matmul idea to COO
+entries — the O(nnz) GBDT formulation, where each present entry owns a
+static ``(feature, bin)`` key and only its row's node assignment changes
+per tree level.  The naive one-hot contraction over unsorted entries
+would compare every entry tile against every key tile (full
+``nnz x (F * bins)`` compare cost, which is why the scatter path used to
+be the only sparse backend).  The fix is that ``findex`` never changes:
+:func:`sparse_hist_layout` sorts the entries by feature ONCE per staged
+batch (host-side, amortized over ``num_trees x max_depth`` level passes)
+and records, per key tile, the contiguous block span of entries whose
+keys can land in that tile.  The kernel grid is then
+``(key tiles, max blocks per tile)`` with the span table scalar-
+prefetched: each grid step DMAs only its own feature block's entries, so
+compare work is O(nnz * KEY_TILE / NNZ_TILE) per entry tile — no
+``n_nodes`` factor (nodes ride the MXU M axis like ``_hist_kernel``) and
+no full-F factor (a tile only ever sees its own features' entries).
 """
 from __future__ import annotations
 
@@ -25,7 +45,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _ROW_TILE = 512    # rows per out tile (lane-friendly multiple of 128)
 _NNZ_TILE = 1024   # entries per inner step
@@ -83,7 +105,11 @@ def _segment_sum_pallas(contrib: jax.Array, row_id: jax.Array,
     if contrib.shape[0] == 0:  # empty shard: zero histogram, like XLA
         shape = ((num_segments,) if contrib.ndim == 1
                  else (num_segments, lanes))
-        return jnp.zeros(shape, jnp.float32)
+        # honor contrib's dtype like the non-empty path does after its
+        # f32 accumulation — a float32 zero here would make the two
+        # backends stop being drop-in interchangeable exactly on the
+        # empty-shard edge (seen by zero-row shards of uneven splits)
+        return jnp.zeros(shape, contrib.dtype)
     contrib2 = contrib.reshape(contrib.shape[0], lanes).T  # [L, nnz]
     nnz = contrib2.shape[1]
     nnz_pad = pl.cdiv(nnz, _NNZ_TILE) * _NNZ_TILE
@@ -300,6 +326,319 @@ def histogram_gh(bins: jax.Array, rel: jax.Array, gh: jax.Array,
         jnp.broadcast_to(gh[:, None, :], (rows, F, 2)).reshape(-1, 2),
         keys, num_segments=n_nodes * F * num_bins
     ).reshape(n_nodes, F, num_bins, 2)
+
+
+# ---- sparse (COO) histogram -------------------------------------------------
+
+
+def _sparse_geometry(num_features: int, num_bins: int) -> tuple[int, int]:
+    """(nb, num_kt): per-feature key stride (pow2 >= num_bins) and key-tile
+    count.  Unlike the dense kernel there is no ``_KEY_TILE // 8`` floor —
+    that clamp bounds the dense kernel's unrolled per-feature compare loop,
+    and the sparse kernel has no such loop (each entry carries its own
+    key)."""
+    nb = 1 << max(num_bins - 1, 1).bit_length()
+    if num_features * nb >= 2 ** 31:
+        raise ValueError(f"feature x bin key space overflows int32 "
+                         f"({num_features} features x {nb} bin stride)")
+    num_kt = pl.cdiv(num_features * nb, _KEY_TILE)
+    return nb, num_kt
+
+
+class SparseHistLayout:
+    """Feature-sorted COO layout for :func:`histogram_gh_sparse`.
+
+    ``findex``/``ebin`` are static across every level of every tree, so
+    the expensive part of the sparse kernel — sorting the entries by
+    feature and computing, per key tile, which contiguous span of
+    ``_NNZ_TILE`` entry blocks can contribute to it — happens ONCE per
+    staged batch (host-side numpy) and is reused for the whole fit.
+    Masked (``emask == 0``) entries are dropped outright during the sort;
+    the padding lanes that fill the last block carry ``w == 0`` AND
+    ``gkey == -1``, so they are doubly inert in the kernel.
+
+    With ``num_shards > 1`` the layout is built per row-shard (entries
+    bucketed to the shard owning their row, row ids localized) and packed
+    into flat arrays whose equal per-shard slices are exactly what
+    ``shard_map`` with ``P(axis)`` in_specs hands each device — the
+    multi-chip psum route (`gbdt._level_histogram` mirror).
+
+    Fields: ``gkey``/``rid``/``w`` are ``[num_shards * nnz_pad]`` packed
+    per-entry arrays (global key ``fi * nb + ebin``, row id — shard-local
+    when sharded — and 0/1 live weight); ``tstart``/``tcount`` are
+    ``[num_shards * num_kt]`` per-key-tile entry-block spans;
+    ``max_tiles`` is the grid's inner extent (max span over all tiles and
+    shards)."""
+
+    __slots__ = ("num_features", "num_bins", "num_shards", "nb", "num_kt",
+                 "max_tiles", "nnz_pad", "nnz_live", "gkey", "rid", "w",
+                 "tstart", "tcount")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+def _sparse_layout_shard(rid: np.ndarray, fi: np.ndarray, eb: np.ndarray,
+                         nb: int, num_kt: int, num_features: int):
+    """Sort one shard's live entries by feature; per-key-tile block spans.
+
+    np.argsort(kind="stable") keeps within-feature entries in input order,
+    so the layout — and the kernel's accumulation order — is a pure
+    function of the entry stream (feature-sort determinism test)."""
+    order = np.argsort(fi, kind="stable")
+    fi_s = fi[order]
+    gkey = (fi_s * nb + eb[order]).astype(np.int32)
+    rid_s = rid[order].astype(np.int32)
+    starts = np.zeros(num_features + 1, np.int64)
+    np.cumsum(np.bincount(fi_s, minlength=num_features), out=starts[1:])
+    tstart = np.zeros(num_kt, np.int32)
+    tcount = np.zeros(num_kt, np.int32)
+    for kt in range(num_kt):
+        # features whose key range [f*nb, (f+1)*nb) intersects this tile
+        flo = min((kt * _KEY_TILE) // nb, num_features)
+        fhi = min(-(-((kt + 1) * _KEY_TILE) // nb), num_features)
+        s, e = int(starts[flo]), int(starts[fhi])
+        if e > s:
+            tstart[kt] = s // _NNZ_TILE
+            tcount[kt] = -(-e // _NNZ_TILE) - tstart[kt]
+    return rid_s, gkey, tstart, tcount
+
+
+def sparse_hist_layout(row_id, findex, ebin, emask,
+                       num_features: int, num_bins: int,
+                       num_shards: int = 1,
+                       rows: int | None = None) -> SparseHistLayout:
+    """Build the feature-sorted layout (see :class:`SparseHistLayout`).
+
+    row_id/findex/ebin/emask: [nnz] COO entry arrays (any int/bool dtypes;
+    device or host).  ``num_shards > 1`` buckets entries by the row shard
+    that owns them (``rows`` must then divide evenly — shard_map's
+    even-sharding rule) and localizes row ids to the shard."""
+    fi = np.asarray(findex).astype(np.int64)
+    eb = np.asarray(ebin).astype(np.int64)
+    em = np.asarray(emask).astype(bool)
+    rid = np.asarray(row_id).astype(np.int64)
+    if em.any():
+        fl, el = fi[em], eb[em]
+        if fl.min() < 0 or fl.max() >= num_features:
+            raise ValueError("findex out of range for live entries")
+        if el.min() < 0 or el.max() >= num_bins:
+            raise ValueError("ebin out of range for live entries")
+    nb, num_kt = _sparse_geometry(num_features, num_bins)
+    if num_shards == 1:
+        parts = [(rid[em], fi[em], eb[em])]
+    else:
+        if rows is None or rows % num_shards:
+            raise ValueError("sharded layout needs rows divisible by "
+                             f"num_shards (rows={rows}, "
+                             f"num_shards={num_shards})")
+        local = rows // num_shards
+        owner = rid // local
+        parts = []
+        for s in range(num_shards):
+            sel = em & (owner == s)
+            parts.append((rid[sel] - s * local, fi[sel], eb[sel]))
+    built = [_sparse_layout_shard(r, f, e, nb, num_kt, num_features)
+             for r, f, e in parts]
+    n_live = [len(b[0]) for b in built]
+    nnz_pad = max(pl.cdiv(max(max(n_live), 1), _NNZ_TILE) * _NNZ_TILE,
+                  _NNZ_TILE)
+    gkey_p = np.full(num_shards * nnz_pad, -1, np.int32)
+    rid_p = np.zeros(num_shards * nnz_pad, np.int32)
+    w_p = np.zeros(num_shards * nnz_pad, np.float32)
+    for s, (rid_s, gkey, _, _) in enumerate(built):
+        gkey_p[s * nnz_pad:s * nnz_pad + len(gkey)] = gkey
+        rid_p[s * nnz_pad:s * nnz_pad + len(rid_s)] = rid_s
+        w_p[s * nnz_pad:s * nnz_pad + len(rid_s)] = 1.0
+    tstart = np.concatenate([b[2] for b in built])
+    tcount = np.concatenate([b[3] for b in built])
+    return SparseHistLayout(
+        num_features=num_features, num_bins=num_bins,
+        num_shards=num_shards, nb=nb, num_kt=num_kt,
+        max_tiles=max(int(tcount.max()) if tcount.size else 0, 1),
+        nnz_pad=nnz_pad, nnz_live=sum(n_live),
+        gkey=jnp.asarray(gkey_p), rid=jnp.asarray(rid_p),
+        w=jnp.asarray(w_p),
+        tstart=jnp.asarray(tstart), tcount=jnp.asarray(tcount))
+
+
+def _sparse_hist_kernel(n_pad: int, tstart_ref, tcount_ref,
+                        gkey_ref, rel_ref, gh_ref, out_ref):
+    """One (key-tile, entry-block) step of the sparse histogram:
+
+        out[(lane, node), key] += A^T B
+        A[entry, (lane, node)] = gh[lane, entry] * [rel[entry] == node]
+        B[entry, key]          = [gkey[entry] - kt*KEY_TILE == key]
+
+    The scalar-prefetched span table makes the entry-block index map
+    data-dependent: step (kt, et) reads block ``tstart[kt] + et`` and the
+    body only runs while ``et < tcount[kt]`` — entries sorted by feature
+    mean each key tile touches just its own features' blocks.  Entries of
+    a neighboring feature sharing a boundary block self-mask: their gkey
+    falls outside this tile's [0, KEY_TILE) local range, so B's one-hot
+    row is all zero.  Same 2-D-shapes / HIGHEST-precision discipline as
+    ``_hist_kernel``."""
+    kt = pl.program_id(0)
+    et = pl.program_id(1)
+
+    @pl.when(et == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(et < tcount_ref[kt])
+    def _accum():
+        # A: [NNZ_TILE, 2*n_pad] node-masked (grad, hess) lanes.  Padding
+        # entries carry gh == 0 (w-zeroed by the caller) AND gkey == -1.
+        node_ids = jax.lax.broadcasted_iota(jnp.int32, (_NNZ_TILE, n_pad), 1)
+        rel_col = jnp.broadcast_to(rel_ref[...].reshape(_NNZ_TILE, 1),
+                                   (_NNZ_TILE, n_pad))
+        mask = (rel_col == node_ids).astype(jnp.float32)
+        g_col = jnp.broadcast_to(gh_ref[0:1, :].reshape(_NNZ_TILE, 1),
+                                 (_NNZ_TILE, n_pad))
+        h_col = jnp.broadcast_to(gh_ref[1:2, :].reshape(_NNZ_TILE, 1),
+                                 (_NNZ_TILE, n_pad))
+        a = jnp.concatenate([mask * g_col, mask * h_col], axis=1)
+        # B: [NNZ_TILE, KEY_TILE] one-hot of each entry's own static key
+        loc = jax.lax.broadcasted_iota(jnp.int32, (_NNZ_TILE, _KEY_TILE), 1)
+        key_col = jnp.broadcast_to(
+            (gkey_ref[...] - kt * _KEY_TILE).reshape(_NNZ_TILE, 1),
+            (_NNZ_TILE, _KEY_TILE))
+        b = (key_col == loc).astype(jnp.float32)
+        out_ref[...] += jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "num_features", "num_bins",
+                                    "max_tiles", "interpret"))
+def _histogram_gh_sparse_pallas(gkey: jax.Array, rel_e: jax.Array,
+                                gh_e: jax.Array, tstart: jax.Array,
+                                tcount: jax.Array, n_nodes: int,
+                                num_features: int, num_bins: int,
+                                max_tiles: int, interpret: bool) -> jax.Array:
+    """One shard's kernel call.  gkey/rel_e: [nnz_pad] int32 (nnz_pad a
+    multiple of _NNZ_TILE); gh_e: [nnz_pad, 2] f32, already entry-gathered
+    and w-masked; tstart/tcount: [num_kt] int32 block spans.  Returns
+    [n_nodes, F, num_bins, 2] f32."""
+    nnz_pad = gkey.shape[0]
+    nb, num_kt = _sparse_geometry(num_features, num_bins)
+    k_pad = num_kt * _KEY_TILE
+    f_pad = k_pad // nb
+    n_pad = pl.cdiv(n_nodes, 8) * 8
+    m_pad = 2 * n_pad
+    nblocks = nnz_pad // _NNZ_TILE
+    gkey2 = gkey.reshape(1, nnz_pad)
+    rel2 = rel_e.astype(jnp.int32).reshape(1, nnz_pad)
+    gh2 = gh_e.astype(jnp.float32).T            # [2, nnz_pad]
+
+    # block index of entry inputs at step (kt, et): clamped so skipped
+    # steps (et >= tcount[kt]) re-address an in-range block — a repeated
+    # index means no re-fetch, keeping HBM traffic proportional to the
+    # executed tiles only
+    def eidx(kt, et, ts, tc):
+        return (0, jnp.minimum(ts[kt] + et, nblocks - 1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_kt, max_tiles),
+        in_specs=[
+            pl.BlockSpec((1, _NNZ_TILE), eidx),
+            pl.BlockSpec((1, _NNZ_TILE), eidx),
+            pl.BlockSpec((2, _NNZ_TILE), eidx),
+        ],
+        out_specs=pl.BlockSpec((m_pad, _KEY_TILE),
+                               lambda kt, et, ts, tc: (0, kt)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_sparse_hist_kernel, n_pad),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, k_pad), jnp.float32),
+        interpret=interpret,
+    )(tstart, tcount, gkey2, rel2, gh2)
+    return (out.reshape(2, n_pad, f_pad, nb)
+            [:, :n_nodes, :num_features, :num_bins]
+            .transpose(1, 2, 3, 0))             # [n, F, B, 2]
+
+
+def histogram_gh_sparse_kernel(gkey, rel_e, gh_e, tstart, tcount,
+                               n_nodes: int, num_features: int,
+                               num_bins: int, max_tiles: int,
+                               interpret: bool | None = None) -> jax.Array:
+    """Raw kernel entry over pre-gathered per-entry arrays:
+    ``rel_e = rel[layout.rid]`` (per level) and
+    ``gh_e = gh[layout.rid] * layout.w[:, None]`` (per tree).  The GBDT
+    builder calls this directly so the gh gather hoists out of the level
+    loop and — under ``histogram_mesh`` — so the call can sit inside a
+    ``shard_map`` body next to its psum.  ``histogram_gh_sparse`` wraps it
+    for one-shot use."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _histogram_gh_sparse_pallas(gkey, rel_e, gh_e, tstart, tcount,
+                                       n_nodes, num_features, num_bins,
+                                       max_tiles, interpret)
+
+
+def histogram_gh_sparse(row_id, findex, ebin, emask, rel, gh,
+                        n_nodes: int, num_features: int, num_bins: int,
+                        force: str | None = None,
+                        layout: SparseHistLayout | None = None) -> jax.Array:
+    """Sparse (COO) GBDT gradient histogram: ``out[n, f, b, :] = sum of
+    gh[row_id[k]] over live entries k with rel[row_id[k]] == n,
+    findex[k] == f, ebin[k] == b``.
+
+    row_id/findex/ebin/emask: [nnz] entry arrays (emask 0 marks padding /
+    masked lanes); rel: [rows] node ids in [0, n_nodes); gh: [rows, 2]
+    (grad, hess).  Returns [n_nodes, F, num_bins, 2].
+
+    force: None/"xla" -> the flattened-key ``jax.ops.segment_sum``
+    scatter-add over ``(rel[rid] * F + fi) * B + ebin`` — exactly the
+    formulation ``gbdt._build_tree_sparse`` always used, O(nnz) work.
+
+    "pallas" -> the sparse histogram-as-matmul kernel: entries sorted by
+    feature once (``layout``; built here when not supplied — pass a
+    prebuilt one to amortize the sort over a whole fit), then per
+    (key-tile, entry-block) grid step A = node-masked per-entry (grad,
+    hess) [NNZ_TILE, 2*nodes] contracts against B = key one-hot
+    [NNZ_TILE, KEY_TILE] on the MXU at f32/HIGHEST.  The scalar-
+    prefetched span table means a key tile only reads its own features'
+    entry blocks: compare work O(nnz * KEY_TILE) total, independent of
+    ``n_nodes`` and of F, vs the dense kernel's O(rows * F * bins).  Max
+    abs err vs the scatter path <= 4e-6 (accumulation order only), so
+    the backends stay drop-in interchangeable.
+    """
+    check_force(force, "histogram backend")
+    if force == "pallas":
+        if layout is None:
+            layout = sparse_hist_layout(row_id, findex, ebin, emask,
+                                        num_features, num_bins)
+        if layout.num_shards != 1:
+            raise ValueError(
+                "sharded SparseHistLayout must run under shard_map with "
+                "per-shard slices (see gbdt's histogram_mesh route); call "
+                "histogram_gh_sparse_kernel from the shard_map body")
+        if (layout.num_features, layout.num_bins) != (num_features,
+                                                      num_bins):
+            raise ValueError(
+                f"layout built for F={layout.num_features}/"
+                f"B={layout.num_bins}, called with F={num_features}/"
+                f"B={num_bins}")
+        gh_e = gh[layout.rid].astype(jnp.float32) * layout.w[:, None]
+        rel_e = jnp.asarray(rel, jnp.int32)[layout.rid]
+        out = histogram_gh_sparse_kernel(
+            layout.gkey, rel_e, gh_e, layout.tstart, layout.tcount,
+            n_nodes, num_features, num_bins, layout.max_tiles)
+        return out.astype(gh.dtype)
+    rid = jnp.asarray(row_id, jnp.int32)
+    fi = jnp.asarray(findex, jnp.int32)
+    gh_k = gh[rid] * emask.astype(gh.dtype)[:, None]
+    keys = ((jnp.asarray(rel, jnp.int32)[rid] * num_features + fi)
+            * num_bins + jnp.asarray(ebin, jnp.int32))
+    return jax.ops.segment_sum(
+        gh_k, keys, num_segments=n_nodes * num_features * num_bins
+    ).reshape(n_nodes, num_features, num_bins, 2)
 
 
 def segment_sum(contrib: jax.Array, row_id: jax.Array, num_segments: int,

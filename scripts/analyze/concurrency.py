@@ -29,6 +29,8 @@ REGISTERED = [
     "cpp/include/dmlctpu/fault.h",
     "cpp/src/data/sharded_parser.h",
     "cpp/src/data/binned_cache.h",
+    "cpp/include/dmlctpu/threaded_iter.h",
+    "cpp/src/data/text_parser.h",
 ]
 
 ATOMIC_OP_RE = re.compile(

@@ -202,8 +202,18 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # the invalidation matrix proves every header-contract mutation costs
   # exactly one counted rebuild with a bit-identical stream after.
   python -m pytest tests/test_binned_cache.py -x -q
+
+  # Sparse-pallas tier: the sparse COO histogram kernel and its GBDT
+  # wiring, slow marks included — the interpret-mode kernel parity suite,
+  # the feature-sort determinism + sharded-layout psum cases, and the
+  # forest-identity fits (batch, streamed, shard_map mesh) that prove the
+  # histogram= backends stay drop-in interchangeable.
+  python -m pytest tests/test_pallas.py -x -q \
+    -k "sparse or empty_shard" -m ""
+  python -m pytest tests/test_gbdt.py -x -q \
+    -k "sparse_fit_batch_pallas or streamed_pallas or sharded_fit_batch_pallas or histogram_env_knob" -m ""
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + sparse-pallas tier")
 echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"

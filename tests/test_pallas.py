@@ -264,3 +264,158 @@ def test_histogram_gh_gbdt_forests_identical():
                                   np.asarray(fp["threshold"]))
     np.testing.assert_allclose(np.asarray(fx["leaf"]),
                                np.asarray(fp["leaf"]), rtol=1e-5, atol=1e-6)
+
+
+# ---- sparse (COO) histogram kernel ------------------------------------------
+
+
+def _sparse_case(rng, rows, F, B, n_nodes, nnz, n_masked=7):
+    """Random COO entries with trailing masked lanes carrying garbage."""
+    from dmlc_core_tpu.ops.pallas_segment import histogram_gh_sparse
+    del histogram_gh_sparse  # import check only
+    rid = rng.integers(0, rows, nnz).astype(np.int32)
+    fi = rng.integers(0, F, nnz).astype(np.int32)
+    eb = rng.integers(1, B, nnz).astype(np.int32)   # bin 0 reserved: missing
+    em = np.ones(nnz, bool)
+    if n_masked:
+        em[-n_masked:] = False
+        # masked lanes: out-of-range junk that must not influence anything
+        fi[-n_masked:] = rng.integers(0, 2 ** 20, n_masked)
+        eb[-n_masked:] = rng.integers(0, 2 ** 20, n_masked)
+    rel = rng.integers(0, n_nodes, rows).astype(np.int32)
+    gh = rng.standard_normal((rows, 2)).astype(np.float32)
+    return (jnp.asarray(rid), jnp.asarray(fi), jnp.asarray(eb),
+            jnp.asarray(em), jnp.asarray(rel), jnp.asarray(gh))
+
+
+def test_histogram_gh_sparse_matches_scatter():
+    """Sparse kernel vs the flattened-key XLA scatter across geometries:
+    single/multi key tile (F*nb <=/> 512), non-pow2 bins, nnz not a block
+    multiple, and n_nodes crossing the 8-sublane pad."""
+    from dmlc_core_tpu.ops.pallas_segment import histogram_gh_sparse
+    rng = np.random.default_rng(31)
+    for rows, F, B, n_nodes, nnz in [
+            (100, 3, 8, 1, 500),       # one key tile
+            (200, 5, 16, 4, 2000),     # one key tile, deeper
+            (150, 6, 256, 2, 1500),    # nb=256 -> 3 key tiles
+            (120, 4, 33, 8, 1111),     # non-pow2 bins -> nb=64
+            (90, 2, 8, 16, 257),       # n_nodes past one sublane pad
+    ]:
+        rid, fi, eb, em, rel, gh = _sparse_case(rng, rows, F, B, n_nodes, nnz)
+        want = histogram_gh_sparse(rid, fi, eb, em, rel, gh, n_nodes, F, B)
+        got = histogram_gh_sparse(rid, fi, eb, em, rel, gh, n_nodes, F, B,
+                                  force="pallas")
+        assert got.shape == (n_nodes, F, B, 2)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=4e-6,
+            err_msg=f"rows={rows} F={F} B={B} n={n_nodes} nnz={nnz}")
+
+
+def test_histogram_gh_sparse_padding_lanes_inert():
+    """Masked entries (emask=0) with garbage keys AND rows pointing at
+    nonzero gh must contribute nothing: the layout drops them in the sort
+    and the block-padding lanes are doubly inert (gkey=-1, w=0)."""
+    from dmlc_core_tpu.ops.pallas_segment import histogram_gh_sparse
+    rng = np.random.default_rng(32)
+    rows, F, B, n_nodes = 64, 3, 8, 2
+    rid, fi, eb, em, rel, gh = _sparse_case(rng, rows, F, B, n_nodes,
+                                            nnz=300, n_masked=50)
+    got = histogram_gh_sparse(rid, fi, eb, em, rel, gh, n_nodes, F, B,
+                              force="pallas")
+    live = np.asarray(em)
+    want = histogram_gh_sparse(rid[live], fi[live], eb[live],
+                               em[live], rel, gh, n_nodes, F, B,
+                               force="pallas")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_histogram_gh_sparse_bin0_stays_empty():
+    """missing_aware entry codes live in [1, B); the kernel must leave the
+    reserved missing bin 0 exactly zero (the builder derives missing mass
+    as node total minus present sum from it)."""
+    from dmlc_core_tpu.ops.pallas_segment import histogram_gh_sparse
+    rng = np.random.default_rng(33)
+    rid, fi, eb, em, rel, gh = _sparse_case(rng, 128, 4, 16, 4, 900)
+    got = np.asarray(histogram_gh_sparse(rid, fi, eb, em, rel, gh, 4, 4, 16,
+                                         force="pallas"))
+    assert not got[:, :, 0, :].any()
+    assert np.abs(got).sum() > 0  # and the live bins are not trivially zero
+
+
+def test_sparse_layout_feature_sort_determinism():
+    """The stable feature sort makes the layout a pure function of the
+    entry stream: rebuilding bit-identical, and permuting the input
+    entries changes only accumulation order (allclose histograms)."""
+    from dmlc_core_tpu.ops.pallas_segment import (histogram_gh_sparse,
+                                                  sparse_hist_layout)
+    rng = np.random.default_rng(34)
+    rows, F, B, n_nodes = 96, 5, 16, 4
+    rid, fi, eb, em, rel, gh = _sparse_case(rng, rows, F, B, n_nodes, 700)
+    la = sparse_hist_layout(rid, fi, eb, em, F, B)
+    lb = sparse_hist_layout(rid, fi, eb, em, F, B)
+    for f in ("gkey", "rid", "w", "tstart", "tcount"):
+        np.testing.assert_array_equal(np.asarray(getattr(la, f)),
+                                      np.asarray(getattr(lb, f)), err_msg=f)
+    ha = histogram_gh_sparse(rid, fi, eb, em, rel, gh, n_nodes, F, B,
+                             force="pallas", layout=la)
+    perm = rng.permutation(len(np.asarray(rid)))
+    hb = histogram_gh_sparse(rid[perm], fi[perm], eb[perm], em[perm],
+                             rel, gh, n_nodes, F, B, force="pallas")
+    np.testing.assert_allclose(np.asarray(ha), np.asarray(hb), atol=4e-6)
+
+
+def test_histogram_gh_sparse_shardmap_psum_matches_global():
+    """The multi-device sparse route: a num_shards=8 layout packs equal
+    per-shard slices, shard_map P('data') in_specs hand each device its
+    shard, the kernel runs on local rows, psum combines — mirroring the
+    dense test above and gbdt._level_histogram_sparse."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.ops.pallas_segment import (histogram_gh_sparse,
+                                                  histogram_gh_sparse_kernel,
+                                                  sparse_hist_layout)
+    from dmlc_core_tpu.parallel.collective import shard_map_compat
+
+    rng = np.random.default_rng(35)
+    rows, F, B, n_nodes = 8 * 32, 3, 8, 4
+    rid, fi, eb, em, rel, gh = _sparse_case(rng, rows, F, B, n_nodes, 1800)
+    layout = sparse_hist_layout(rid, fi, eb, em, F, B,
+                                num_shards=8, rows=rows)
+    mt = layout.max_tiles
+
+    def local(gk, rid_l, w_l, ts, tc, rel_l, gh_l):
+        rel_e = rel_l[rid_l]
+        gh_e = gh_l[rid_l] * w_l[:, None]
+        h = histogram_gh_sparse_kernel(gk, rel_e, gh_e, ts, tc,
+                                       n_nodes, F, B, mt)
+        return jax.lax.psum(h, "data")
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    sharded = jax.jit(shard_map_compat(
+        local, mesh, in_specs=(P("data"),) * 7, out_specs=P(),
+        check_replication=False))
+    rs = NamedSharding(mesh, P("data"))
+    got = sharded(*(jax.device_put(a, rs) for a in
+                    (layout.gkey, layout.rid, layout.w,
+                     layout.tstart, layout.tcount, rel, gh)))
+    want = histogram_gh_sparse(rid, fi, eb, em, rel, gh, n_nodes, F, B)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=4e-6)
+
+
+def test_segment_sum_empty_shard_dtype_matches_contrib():
+    """Regression: the empty-shard early return must honor contrib's dtype
+    exactly like the non-empty path's cast-back does — the documented
+    drop-in-interchangeability contract covers the zero-shape edge too."""
+    from dmlc_core_tpu.ops.pallas_segment import _segment_sum_pallas
+    for dtype in (jnp.bfloat16, jnp.float32, jnp.int32):
+        empty = segment_sum(jnp.zeros((0,), dtype),
+                            jnp.zeros((0,), jnp.int32), 4, force="pallas")
+        full = segment_sum(jnp.ones((3,), dtype),
+                           jnp.zeros((3,), jnp.int32), 4, force="pallas")
+        assert empty.dtype == full.dtype == dtype, (dtype, empty.dtype)
+        # and the internal jitted path (public segment_sum casts on top)
+        internal = _segment_sum_pallas(jnp.zeros((0, 2), dtype),
+                                       jnp.zeros((0,), jnp.int32),
+                                       4, interpret=True)
+        assert internal.dtype == dtype and internal.shape == (4, 2)
