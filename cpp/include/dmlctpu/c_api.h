@@ -213,6 +213,26 @@ int DmlcTpuStagedBatcherGetPoolKnobs(DmlcTpuStagedBatcherHandle handle,
                                      uint64_t* chunk_bytes, int* out_applied);
 void DmlcTpuStagedBatcherFree(DmlcTpuStagedBatcherHandle handle);
 
+/* ---- staged-batch wire codec (dataservice data side channel) ------------- */
+/*! \brief serialize an owned batch's geometry into a fixed self-describing
+ *  wire header (magic + version + shapes + arena offsets).  The arena bytes
+ *  themselves travel separately (they are already one contiguous block —
+ *  send batch->arena_bytes bytes from batch->arena verbatim).  `cap` must be
+ *  at least DMLCTPU_STAGED_WIRE_HEADER_BYTES; *out_len receives the header
+ *  length actually written. */
+int DmlcTpuStagedBatchWireHeader(const DmlcTpuStagedBatchOwnedC* batch,
+                                 void* buf, uint64_t cap, uint64_t* out_len);
+/*! \brief rebind a wire header + received arena into an owned-batch view
+ *  without copying: validates magic/version and bounds-checks every column
+ *  span against arena_bytes, then fills *out with offsets into the CALLER's
+ *  arena.  out->batch is NULL (the caller owns the arena memory; passing
+ *  NULL to DmlcTpuStagedBatchFree is a no-op), so the receiver keeps its
+ *  recv buffer alive for as long as the arrays are in use. */
+int DmlcTpuStagedBatchFromWire(const void* header, uint64_t header_len,
+                               void* arena, uint64_t arena_bytes,
+                               DmlcTpuStagedBatchOwnedC* out);
+#define DMLCTPU_STAGED_WIRE_HEADER_BYTES 104
+
 /* ---- RecordBatcher: RecordIO → packed fixed-shape device batches --------- */
 typedef void* DmlcTpuRecordBatcherHandle;
 
@@ -424,6 +444,12 @@ int DmlcTpuFaultDisarm(void);
 int DmlcTpuFaultSnapshotJson(const char** out);
 /* total injected faults across all points since the last (re)arm. */
 int DmlcTpuFaultInjectedTotal(int64_t* out);
+/* Fire the named fault point once on behalf of a binding-side hop (the
+ * dataservice client's connect/receive path lives in Python but is hardened
+ * by the same native registry).  *out_mode receives the armed Mode when this
+ * hit should fault (1=err 2=eof 3=503 4=corrupt), 0 for a clean hit.  The
+ * point is created on first use, so it can be armed before or after. */
+int DmlcTpuFaultFire(const char* point, int* out_mode);
 
 /* ---- logging ------------------------------------------------------------- */
 /* severity: 0=DEBUG 1=INFO 2=WARNING 3=ERROR 4=FATAL.  `where` is

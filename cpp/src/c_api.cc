@@ -326,6 +326,29 @@ int DmlcTpuFaultInjectedTotal(int64_t* out) {
   });
 }
 
+namespace {
+// The dataservice client/worker hops live in Python but are hardened by this
+// registry via DmlcTpuFaultFire; registering the points here keeps every
+// armable name a DMLCTPU_FAULT_POINT site (doc/robustness.md contract).
+void EnsureBindingFaultPoints() {
+  DMLCTPU_FAULT_POINT(ds_connect, "dataservice.connect");
+  DMLCTPU_FAULT_POINT(ds_drop, "dataservice.block.drop");
+  (void)ds_connect;
+  (void)ds_drop;
+}
+}  // namespace
+
+int DmlcTpuFaultFire(const char* point, int* out_mode) {
+  return Guard([&] {
+    EnsureBindingFaultPoints();
+    if (point == nullptr || *point == '\0') {
+      throw dmlctpu::Error("DmlcTpuFaultFire: empty point name");
+    }
+    *out_mode = static_cast<int>(dmlctpu::fault::GetPoint(point).Fire());
+    return 0;
+  });
+}
+
 /* ---- logging ------------------------------------------------------------- */
 
 int DmlcTpuLogSetCallback(DmlcTpuLogCallback callback) {
@@ -981,6 +1004,121 @@ void DmlcTpuStagedBatchFree(void* batch) {
   // returns the arena to the batcher's pool (or frees it if the pool is full
   // or the batcher is gone — the pool is shared_ptr-held by each batch)
   delete static_cast<dmlctpu::data::OwnedStagedBatch*>(batch);
+}
+
+/* ---- staged-batch wire codec --------------------------------------------- */
+
+namespace {
+
+// Fixed native-endian wire header for one owned staged batch.  Native order
+// matches the rest of the side-channel framing (struct "@i" in metrics.py);
+// the magic word doubles as the cross-arch tripwire, exactly like the 0xff98
+// handshake.  13 * 8 = 104 bytes == DMLCTPU_STAGED_WIRE_HEADER_BYTES.
+struct StagedWireHeader {
+  uint64_t magic;      // kStagedWireMagic
+  uint64_t num_rows;   // widened from uint32 to keep the layout padding-free
+  uint64_t batch_size;
+  uint64_t nnz_pad;
+  int64_t max_index;
+  uint64_t arena_bytes;
+  uint64_t label_off;
+  uint64_t weight_off;
+  uint64_t row_ptr_off;
+  uint64_t index_off;
+  uint64_t value_off;
+  uint64_t field_off;
+  uint64_t qid_off;
+};
+constexpr uint64_t kStagedWireMagic = 0xDB57A6ED00000001ULL;  // ..01 = v1
+constexpr uint64_t kNoColumn = ~static_cast<uint64_t>(0);
+static_assert(sizeof(StagedWireHeader) == DMLCTPU_STAGED_WIRE_HEADER_BYTES,
+              "wire header layout drifted from the public constant");
+
+// column span [off, off+len) must sit inside the arena (absent columns skip)
+void CheckSpan(const char* what, uint64_t off, uint64_t len, uint64_t arena) {
+  if (off == kNoColumn) return;
+  if (off > arena || len > arena - off) {
+    throw dmlctpu::Error(std::string("staged wire batch: column '") + what +
+                         "' overruns the arena");
+  }
+}
+
+}  // namespace
+
+int DmlcTpuStagedBatchWireHeader(const DmlcTpuStagedBatchOwnedC* batch,
+                                 void* buf, uint64_t cap, uint64_t* out_len) {
+  return Guard([&] {
+    if (cap < sizeof(StagedWireHeader)) {
+      throw dmlctpu::Error("DmlcTpuStagedBatchWireHeader: buffer too small");
+    }
+    StagedWireHeader h{};
+    h.magic = kStagedWireMagic;
+    h.num_rows = batch->num_rows;
+    h.batch_size = batch->batch_size;
+    h.nnz_pad = batch->nnz_pad;
+    h.max_index = batch->max_index;
+    h.arena_bytes = batch->arena_bytes;
+    h.label_off = batch->label_off;
+    h.weight_off = batch->weight_off;
+    h.row_ptr_off = batch->row_ptr_off;
+    h.index_off = batch->index_off;
+    h.value_off = batch->value_off;
+    h.field_off = batch->field_off;
+    h.qid_off = batch->qid_off;
+    std::memcpy(buf, &h, sizeof(h));
+    *out_len = sizeof(h);
+    return 0;
+  });
+}
+
+int DmlcTpuStagedBatchFromWire(const void* header, uint64_t header_len,
+                               void* arena, uint64_t arena_bytes,
+                               DmlcTpuStagedBatchOwnedC* out) {
+  return Guard([&] {
+    if (header_len != sizeof(StagedWireHeader)) {
+      throw dmlctpu::Error("staged wire batch: bad header length");
+    }
+    StagedWireHeader h;
+    std::memcpy(&h, header, sizeof(h));
+    if (h.magic != kStagedWireMagic) {
+      throw dmlctpu::Error("staged wire batch: bad magic (corrupt stream or "
+                           "cross-arch sender)");
+    }
+    if (h.arena_bytes != arena_bytes) {
+      throw dmlctpu::Error("staged wire batch: arena length mismatch");
+    }
+    if (h.num_rows > h.batch_size) {
+      throw dmlctpu::Error("staged wire batch: num_rows > batch_size");
+    }
+    CheckSpan("label", h.label_off, h.batch_size * sizeof(float), arena_bytes);
+    CheckSpan("weight", h.weight_off, h.batch_size * sizeof(float), arena_bytes);
+    CheckSpan("row_ptr", h.row_ptr_off, (h.batch_size + 1) * sizeof(int32_t),
+              arena_bytes);
+    CheckSpan("index", h.index_off, h.nnz_pad * sizeof(int32_t), arena_bytes);
+    CheckSpan("value", h.value_off, h.nnz_pad * sizeof(float), arena_bytes);
+    CheckSpan("field", h.field_off, h.nnz_pad * sizeof(int32_t), arena_bytes);
+    CheckSpan("qid", h.qid_off, h.batch_size * sizeof(int32_t), arena_bytes);
+    if (h.label_off == kNoColumn || h.weight_off == kNoColumn ||
+        h.row_ptr_off == kNoColumn || h.index_off == kNoColumn ||
+        h.value_off == kNoColumn) {
+      throw dmlctpu::Error("staged wire batch: required column absent");
+    }
+    out->num_rows = static_cast<uint32_t>(h.num_rows);
+    out->batch_size = h.batch_size;
+    out->nnz_pad = h.nnz_pad;
+    out->max_index = h.max_index;
+    out->batch = nullptr;  // receiver owns the arena; Free(NULL) is a no-op
+    out->arena = arena;
+    out->arena_bytes = arena_bytes;
+    out->label_off = h.label_off;
+    out->weight_off = h.weight_off;
+    out->row_ptr_off = h.row_ptr_off;
+    out->index_off = h.index_off;
+    out->value_off = h.value_off;
+    out->field_off = h.field_off;
+    out->qid_off = h.qid_off;
+    return 0;
+  });
 }
 
 int DmlcTpuStagedBatcherBeforeFirst(DmlcTpuStagedBatcherHandle handle) {

@@ -1,0 +1,15 @@
+"""Disaggregated staging service (doc/dataservice.md).
+
+CPU-only staging workers (:mod:`.server`) run the sharded parser +
+QuantileBinner + StagedBatcher and stream pre-binned cache blocks — or
+packed text-parse batches as fallback — over a TCP data side channel
+(:mod:`.protocol`) to trainer clients (:mod:`.client`), with the tracker's
+:class:`~dmlc_core_tpu.tracker.metrics.LeaseBoard` dispatching per-client
+epoch leases so every client sees every shard exactly once per epoch no
+matter how the worker fleet grows, shrinks, or fails mid-stream.
+"""
+from .client import DataServiceIter
+from .protocol import DATA_MAGIC
+from .server import StagingWorker
+
+__all__ = ["DataServiceIter", "StagingWorker", "DATA_MAGIC"]

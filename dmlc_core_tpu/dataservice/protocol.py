@@ -1,0 +1,172 @@
+"""Wire protocol of the data side channel (client <-> staging worker).
+
+The framing discipline is the 0xff98 metrics channel's, on its own magic
+word: native-endian int32 scalars, ``[len]+utf8`` JSON strings, a magic
+exchanged both ways before anything else, one request per connection.
+(0xff99 is the rendezvous tracker; this channel is 0xff9a.)  On top of the
+JSON control plane the reply side adds length-prefixed **payload frames**
+for the bulk bytes, RecordIO-style — a kind tag, an int64 length, then the
+raw payload verbatim:
+
+  ``FRAME_BLOCK``   one binned-cache block exactly as stored on disk
+                    (32-byte header + columns; ``unpack_block`` decodes it),
+                    served zero-copy from the worker's mmap view
+  ``FRAME_STAGED``  one packed text-parse batch: the 104-byte native wire
+                    header (``DmlcTpuStagedBatchWireHeader``) + the owned
+                    arena verbatim — the text-path fallback
+  ``FRAME_END``     JSON trailer ``{"blocks": n}`` closing a fetch; a count
+                    mismatch means the stream died mid-part and the client
+                    must discard and re-fetch
+  ``FRAME_ERROR``   JSON ``{"error": msg}``
+
+Deserialization of a STAGED frame goes back through the native codec
+(``DmlcTpuStagedBatchFromWire``): magic/bounds validation happens in C and
+the resulting arrays are zero-rebind views over the receive buffer — the
+bytes that arrived off the socket are the bytes the device put consumes.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import socket
+import struct
+
+import numpy as np
+
+from dmlc_core_tpu._native import check, lib
+from dmlc_core_tpu.data.staging import _NO_FIELD, _StagedBatchOwnedC
+from dmlc_core_tpu.tracker.metrics import (_read_exact, _read_int, _read_str,
+                                           _write_int, _write_str)
+
+DATA_MAGIC = 0xFF9A
+FRAME_END = 0
+FRAME_BLOCK = 1
+FRAME_STAGED = 2
+FRAME_ERROR = -1
+
+WIRE_HEADER_BYTES = 104  # == DMLCTPU_STAGED_WIRE_HEADER_BYTES
+
+_I64 = struct.Struct("@q")
+
+
+def client_handshake(sock: socket.socket) -> None:
+    _write_int(sock, DATA_MAGIC)
+    got = _read_int(sock)
+    if got != DATA_MAGIC:
+        raise ConnectionError(f"data channel handshake failed (got {got:#x})")
+
+
+def server_handshake(sock: socket.socket) -> None:
+    got = _read_int(sock)
+    if got != DATA_MAGIC:
+        raise ConnectionError(f"bad data channel magic {got:#x}")
+    _write_int(sock, DATA_MAGIC)
+
+
+def send_req(sock: socket.socket, req: dict) -> None:
+    _write_str(sock, json.dumps(req))
+
+
+def read_req(sock: socket.socket) -> dict:
+    return json.loads(_read_str(sock))
+
+
+def write_frame(sock: socket.socket, kind: int, *payloads) -> None:
+    """One payload frame: kind, total length, then the payload pieces
+    back-to-back (pieces let the staged header + borrowed arena go out
+    without being glued into a fresh buffer first)."""
+    total = sum(len(p) for p in payloads)
+    _write_int(sock, kind)
+    sock.sendall(_I64.pack(total))
+    for p in payloads:
+        sock.sendall(p)
+
+
+def write_json_frame(sock: socket.socket, kind: int, obj: dict) -> None:
+    write_frame(sock, kind, json.dumps(obj).encode())
+
+
+def read_frame(sock: socket.socket) -> tuple:
+    """Read one frame -> ``(kind, payload)``.  END/ERROR payloads come back
+    as parsed JSON; bulk frames as a writable bytearray (the deserialized
+    arrays alias it, so the receive buffer IS the batch storage)."""
+    kind = _read_int(sock)
+    n = _I64.unpack(_read_exact(sock, _I64.size))[0]
+    if n < 0 or n > (1 << 40):
+        raise ConnectionError(f"insane frame length {n}")
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("data channel closed mid-frame")
+        got += r
+    if kind in (FRAME_END, FRAME_ERROR):
+        return kind, json.loads(bytes(buf).decode())
+    return kind, buf
+
+
+def _declare_wire_sig():
+    L = lib()
+    if getattr(L, "_staged_wire_declared", False):
+        return L
+    L.DmlcTpuStagedBatchWireHeader.argtypes = [
+        ctypes.POINTER(_StagedBatchOwnedC), ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    L.DmlcTpuStagedBatchFromWire.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(_StagedBatchOwnedC)]
+    L._staged_wire_declared = True
+    return L
+
+
+def pack_staged_wire(c: _StagedBatchOwnedC) -> tuple:
+    """(header bytes, arena memoryview) for one owned batch — the arena view
+    borrows the native allocation, so serialization copies nothing."""
+    L = _declare_wire_sig()
+    hdr = (ctypes.c_char * WIRE_HEADER_BYTES)()
+    out_len = ctypes.c_uint64()
+    check(L.DmlcTpuStagedBatchWireHeader(ctypes.byref(c), hdr,
+                                         WIRE_HEADER_BYTES,
+                                         ctypes.byref(out_len)))
+    arena = (ctypes.c_uint8 * int(c.arena_bytes)).from_address(c.arena)
+    return bytes(hdr[:out_len.value]), memoryview(arena)
+
+
+def unwrap_staged_wire(buf: bytearray) -> dict:
+    """Rebind one received STAGED frame into host arrays without copying.
+
+    The native codec validates magic + bounds and yields offsets into the
+    receive buffer; every column is then a numpy view over ``buf`` (which
+    the caller keeps alive through the arrays' base chain).  Shape matches
+    ``DeviceStagingIter._wrap_owned``.
+    """
+    if len(buf) < WIRE_HEADER_BYTES:
+        raise ConnectionError("staged frame shorter than its header")
+    L = _declare_wire_sig()
+    c = _StagedBatchOwnedC()
+    raw = (ctypes.c_char * len(buf)).from_buffer(buf)
+    arena_len = len(buf) - WIRE_HEADER_BYTES
+    check(L.DmlcTpuStagedBatchFromWire(
+        raw, WIRE_HEADER_BYTES,
+        ctypes.byref(raw, WIRE_HEADER_BYTES), arena_len, ctypes.byref(c)))
+    B, nnz = int(c.batch_size), int(c.nnz_pad)
+
+    def arr(off, count, dtype):
+        return np.frombuffer(buf, dtype, count,
+                             offset=WIRE_HEADER_BYTES + int(off))
+
+    return {
+        "label": arr(c.label_off, B, np.float32),
+        "weight": arr(c.weight_off, B, np.float32),
+        "row_ptr": arr(c.row_ptr_off, B + 1, np.int32),
+        "index": arr(c.index_off, nnz, np.int32),
+        "value": arr(c.value_off, nnz, np.float32),
+        "field": (arr(c.field_off, nnz, np.int32)
+                  if c.field_off != _NO_FIELD else None),
+        "qid": (arr(c.qid_off, B, np.int32)
+                if c.qid_off != _NO_FIELD else None),
+        "num_rows": int(c.num_rows),
+        "max_index": int(c.max_index),
+    }

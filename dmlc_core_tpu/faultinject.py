@@ -34,7 +34,11 @@ from . import _native
 
 __all__ = [
     "compiled_in", "arm", "disarm", "snapshot", "injected_total", "armed",
+    "fire",
 ]
+
+#: DmlcTpuFaultFire mode ints -> the spec-grammar mode names
+MODE_NAMES = {0: None, 1: "err", 2: "eof", 3: "503", 4: "corrupt"}
 
 
 def compiled_in() -> bool:
@@ -65,6 +69,25 @@ def snapshot() -> dict:
     _native.check(
         _native.lib().DmlcTpuFaultSnapshotJson(ctypes.byref(out)))
     return json.loads((out.value or b"{}").decode())
+
+
+def fire(point: str) -> int:
+    """Fire the named fault point once on behalf of a Python-side hop
+    (``dataservice.connect``, ``dataservice.block.drop``) and return the
+    armed mode int (0 clean — see :data:`MODE_NAMES`).  The decision stream
+    is the native registry's, so Python hops replay as deterministically as
+    native ones under the same spec+seed.  Returns 0 when the running
+    library predates ``DmlcTpuFaultFire`` (mid-rebuild tolerance)."""
+    L = _native.lib()
+    if not hasattr(L, "DmlcTpuFaultFire"):
+        return 0
+    if not getattr(L, "_fault_fire_declared", False):
+        L.DmlcTpuFaultFire.argtypes = [ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_int)]
+        L._fault_fire_declared = True
+    out = ctypes.c_int()
+    _native.check(L.DmlcTpuFaultFire(point.encode(), ctypes.byref(out)))
+    return int(out.value)
 
 
 def injected_total() -> int:

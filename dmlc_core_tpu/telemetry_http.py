@@ -14,6 +14,12 @@ One small ThreadingHTTPServer per process serving:
 * ``/autotune`` — the autotuner's structured state: armed flag, per-tuner
   knob/progress summaries, and the bounded decision log (JSON; see
   doc/autotune.md).
+* ``/shards`` — the tracker's shard-board dispatch state (per-epoch
+  pending/started/done and steal records), tracker endpoints only: a
+  ``board_provider`` must be attached (the aggregator's).
+* ``/dataservice`` — the staging-service LeaseBoard: worker fleet health
+  and per-client epoch leases (doc/dataservice.md); tracker endpoints
+  only, like ``/shards``.
 
 Workers serve their own process registry; the tracker passes a ``provider``
 returning ``(labels, snapshot)`` pairs so job-wide metrics come out as one
@@ -34,6 +40,8 @@ __all__ = ["serve", "TelemetryServer", "prometheus_text"]
 
 # provider: () -> [(labels, snapshot_dict), ...]
 Provider = Callable[[], List[Tuple[Dict[str, str], dict]]]
+# board provider: () -> {"shards": {...}, "dataservice": {...}}
+BoardProvider = Callable[[], dict]
 
 
 def _sanitize(name: str) -> str:
@@ -148,9 +156,20 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import autotune  # lazy: most servers never need it
                 self._send(200, json.dumps(autotune.state()),
                            "application/json")
+            elif url.path in ("/shards", "/dataservice"):
+                bp = getattr(self.server, "board_provider", None)
+                if bp is None:
+                    self._send(404, "no dispatch board on this endpoint "
+                               "(worker process? the tracker serves "
+                               "/shards and /dataservice)\n", "text/plain")
+                else:
+                    boards = bp()
+                    self._send(200, json.dumps(boards.get(url.path[1:], {})),
+                               "application/json")
             else:
                 self._send(404, "not found: try /metrics /trace /flight "
-                           "/snapshot /autotune\n", "text/plain")
+                           "/snapshot /autotune /shards /dataservice\n",
+                           "text/plain")
         except Exception as exc:  # a scrape must never kill the server
             try:
                 self._send(500, f"error: {exc}\n", "text/plain")
@@ -162,10 +181,12 @@ class TelemetryServer:
     """Handle for a running export endpoint; ``close()`` releases the port."""
 
     def __init__(self, host: str, port: int,
-                 provider: Optional[Provider] = None):
+                 provider: Optional[Provider] = None,
+                 board_provider: Optional[BoardProvider] = None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.provider = provider or _local_provider
+        self._httpd.board_provider = board_provider
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -190,7 +211,10 @@ class TelemetryServer:
 
 
 def serve(port: int = 0, host: str = "127.0.0.1",
-          provider: Optional[Provider] = None) -> TelemetryServer:
+          provider: Optional[Provider] = None,
+          board_provider: Optional[BoardProvider] = None) -> TelemetryServer:
     """Start the endpoint on a daemon thread and return its handle.
-    ``port=0`` binds an ephemeral port (read it back via ``.port``)."""
-    return TelemetryServer(host, port, provider)
+    ``port=0`` binds an ephemeral port (read it back via ``.port``).
+    ``board_provider`` (tracker endpoints) lights up ``/shards`` and
+    ``/dataservice`` — pass ``MetricsAggregator.board_provider``."""
+    return TelemetryServer(host, port, provider, board_provider)

@@ -42,5 +42,6 @@ def run(args) -> None:
             ranks.spawn(one, "worker", i)
 
     tracker = submit(args.num_workers, args.num_servers, spawn_all,
-                     host_ip="127.0.0.1", pscmd=None, extra_envs=args.extra_env)
+                     host_ip="127.0.0.1", pscmd=None, extra_envs=args.extra_env,
+                     data_service=getattr(args, "data_service", 0))
     ranks.join_tracker(tracker)

@@ -207,6 +207,182 @@ class ShardBoard:
             return out
 
 
+class LeaseBoard:
+    """Dispatcher-side ledger for the data-service fleet (doc/dataservice.md).
+
+    Two registries under one lock: the staging-**worker** fleet (elastic
+    join/leave via register/heartbeat/leave; a worker a client reported
+    failing is dead until its next heartbeat proves otherwise) and the
+    per-``(client, epoch)`` **lease** ledgers.  A lease is the data-service
+    analogue of a ShardBoard claim, but per *consumer*: every trainer client
+    must see every shard of its epoch exactly once, independent of which
+    worker serves it or how many clients share the fleet.  Exactly-once is
+    structural, like ShardBoard's: ``lease_assign`` on an already-done shard
+    answers ``done`` (so a client replay skips it), a completed shard is
+    recorded once, and a failed fetch requeues the SAME shard — the client
+    discards the partial stream and re-fetches whole, so worker death never
+    duplicates or drops rows.
+    """
+
+    def __init__(self, keep_epochs: int = 4, dead_after_s: float = 15.0):
+        self._lock = threading.Lock()
+        self._dead_after = float(dead_after_s)
+        self._keep = max(int(keep_epochs), 1)
+        # worker id -> {"host","port","last_seen","dead","served","failed"}
+        self._workers: Dict[str, dict] = {}
+        # (client, epoch) -> {"parts": {part: worker-or-None},
+        #                     "done": {part: worker}, "failovers": [records]}
+        self._ledgers: Dict[Tuple[str, int], dict] = {}
+
+    # ---- worker fleet -------------------------------------------------------
+
+    def _alive(self, now: float) -> Dict[str, dict]:
+        return {w: st for w, st in self._workers.items()
+                if not st["dead"] and now - st["last_seen"] <= self._dead_after}
+
+    def worker_register(self, worker: str, host: str, port: int) -> dict:
+        with self._lock:
+            self._workers[worker] = {
+                "host": str(host), "port": int(port), "last_seen": time.time(),
+                "dead": False, "served": 0, "failed": 0,
+            }
+            alive = len(self._alive(time.time()))
+        telemetry.gauge_set("dataservice.workers_alive", alive)
+        LOGGER.info("data-service worker %s joined at %s:%d (%d alive)",
+                    worker, host, port, alive)
+        return {"ok": True, "workers": alive}
+
+    def worker_heartbeat(self, worker: str) -> dict:
+        with self._lock:
+            st = self._workers.get(worker)
+            if st is None:
+                return {"ok": False}  # tracker restarted: re-register
+            st["last_seen"] = time.time()
+            st["dead"] = False  # a live heartbeat clears a client's report
+            return {"ok": True}
+
+    def worker_leave(self, worker: str) -> dict:
+        """Graceful drain: stop assigning, requeue this worker's leases."""
+        with self._lock:
+            st = self._workers.get(worker)
+            if st is not None:
+                st["dead"] = True
+            requeued = self._requeue_locked(worker)
+        return {"ok": True, "requeued": requeued}
+
+    def _requeue_locked(self, worker: str) -> int:
+        requeued = 0
+        for ledger in self._ledgers.values():
+            for part, w in ledger["parts"].items():
+                if w == worker and part not in ledger["done"]:
+                    ledger["parts"][part] = None
+                    requeued += 1
+        return requeued
+
+    # ---- per-client epoch leases --------------------------------------------
+
+    def _ledger(self, client: str, epoch: int) -> dict:
+        key = (client, epoch)
+        ledger = self._ledgers.get(key)
+        if ledger is None:
+            ledger = {"parts": {}, "done": {}, "failovers": []}
+            self._ledgers[key] = ledger
+            mine = sorted(e for c, e in self._ledgers if c == client)
+            while len(mine) > self._keep:
+                del self._ledgers[(client, mine.pop(0))]
+        return ledger
+
+    def lease_register(self, client: str, epoch: int, parts) -> dict:
+        """Declare the client's shard set for an epoch (idempotent)."""
+        with self._lock:
+            ledger = self._ledger(client, epoch)
+            for p in parts:
+                ledger["parts"].setdefault(int(p), None)
+            pending = sum(1 for p in ledger["parts"]
+                          if p not in ledger["done"])
+            return {"ok": True, "pending": pending}
+
+    def lease_assign(self, client: str, epoch: int, part: int) -> dict:
+        """Lease one shard to the calling client: pick the serving worker.
+
+        ``done`` — this client already finished the shard (replay skips);
+        ``worker`` — fetch from there; ``wait`` — no live workers, poll.
+        The pick is a rendezvous hash of (worker, part) so a given shard
+        lands on the same worker while the fleet is stable (cache-warm
+        affinity) and redistributes minimally when it grows or shrinks.
+        """
+        with self._lock:
+            ledger = self._ledger(client, epoch)
+            if part in ledger["done"]:
+                return {"done": True}
+            alive = self._alive(time.time())
+            if not alive:
+                return {"wait": True}
+            wid = max(alive, key=lambda w: hash((w, int(part))))
+            ledger["parts"][int(part)] = wid
+            alive[wid]["served"] += 1
+            st = alive[wid]
+        telemetry.counter_add("dataservice.leases", 1)
+        return {"worker": {"id": wid, "host": st["host"], "port": st["port"]}}
+
+    def lease_done(self, client: str, epoch: int, part: int,
+                   worker: str) -> dict:
+        with self._lock:
+            ledger = self._ledger(client, epoch)
+            first = int(part) not in ledger["done"]
+            ledger["done"].setdefault(int(part), str(worker))
+            pending = sum(1 for p in ledger["parts"]
+                          if p not in ledger["done"])
+            return {"ok": True, "first": first, "pending": pending}
+
+    def lease_fail(self, client: str, epoch: int, part: int,
+                   worker: str) -> dict:
+        """A client's fetch from ``worker`` died: mark it dead (its next
+        heartbeat revives it), requeue every undone shard it held, and
+        record the failover for the observability plane."""
+        with self._lock:
+            st = self._workers.get(worker)
+            if st is not None:
+                st["dead"] = True
+                st["failed"] += 1
+            requeued = self._requeue_locked(worker)
+            ledger = self._ledger(client, epoch)
+            ledger["failovers"].append({
+                "part": int(part), "worker": str(worker), "t": time.time()})
+            alive = len(self._alive(time.time()))
+        telemetry.counter_add("dataservice.failovers", 1)
+        telemetry.gauge_set("dataservice.workers_alive", alive)
+        LOGGER.warning("data-service worker %s reported dead by client %s "
+                       "(shard %d requeued, %d total, %d workers alive)",
+                       worker, client, part, requeued, alive)
+        return {"ok": True, "requeued": requeued, "workers": alive}
+
+    def state(self) -> dict:
+        """JSON-ready fleet + lease view (job_snapshot, /dataservice)."""
+        now = time.time()
+        with self._lock:
+            workers = {
+                w: {"host": st["host"], "port": st["port"],
+                    "age_s": round(now - st["last_seen"], 3),
+                    "dead": bool(st["dead"]) or
+                    now - st["last_seen"] > self._dead_after,
+                    "served": st["served"], "failed": st["failed"]}
+                for w, st in sorted(self._workers.items())}
+            leases = {}
+            for (client, epoch), ledger in sorted(self._ledgers.items()):
+                leases.setdefault(client, {})[str(epoch)] = {
+                    "shards": len(ledger["parts"]),
+                    "done": len(ledger["done"]),
+                    "pending": sum(1 for p in ledger["parts"]
+                                   if p not in ledger["done"]),
+                    "assigned": {str(p): w for p, w in
+                                 sorted(ledger["parts"].items())
+                                 if w is not None},
+                    "failovers": list(ledger["failovers"]),
+                }
+        return {"workers": workers, "leases": leases}
+
+
 class MetricsAggregator:
     """Accepts worker snapshot pushes and merges them into a job view."""
 
@@ -223,6 +399,7 @@ class MetricsAggregator:
         # rank -> {"host","pid","snapshot","restarted","last_update"}
         self._hosts: Dict[int, dict] = {}
         self.board = ShardBoard()
+        self.leases = LeaseBoard()
         self._closed = False
         self._thread = threading.Thread(
             target=self._serve, name="dmlctpu-metrics-aggregator", daemon=True)
@@ -274,6 +451,12 @@ class MetricsAggregator:
         req = payload.get("shard_req")
         if req is not None:
             _write_str(fd, json.dumps(self._handle_shard_req(rank, req)))
+        # optional data-service dispatcher RPC: same one-reply-after-ack
+        # discipline, so staging workers and trainer clients ride the
+        # existing channel instead of a second tracker port
+        dreq = payload.get("dataservice_req")
+        if dreq is not None:
+            _write_str(fd, json.dumps(self._handle_dataservice_req(dreq)))
 
     def _handle_shard_req(self, rank: int, req: dict) -> dict:
         op = req.get("op")
@@ -292,6 +475,32 @@ class MetricsAggregator:
         if op == "done":
             return self.board.done(rank, epoch, int(req["shard"]))
         return {"error": f"unknown shard op {op!r}"}
+
+    def _handle_dataservice_req(self, req: dict) -> dict:
+        op = req.get("op")
+        b = self.leases
+        if op == "worker_register":
+            return b.worker_register(str(req["worker"]), str(req["host"]),
+                                     int(req["port"]))
+        if op == "worker_heartbeat":
+            return b.worker_heartbeat(str(req["worker"]))
+        if op == "worker_leave":
+            return b.worker_leave(str(req["worker"]))
+        if op == "lease_register":
+            return b.lease_register(str(req["client"]), int(req["epoch"]),
+                                    req.get("parts", []))
+        if op == "lease_assign":
+            return b.lease_assign(str(req["client"]), int(req["epoch"]),
+                                  int(req["part"]))
+        if op == "lease_done":
+            return b.lease_done(str(req["client"]), int(req["epoch"]),
+                                int(req["part"]), str(req.get("worker", "")))
+        if op == "lease_fail":
+            return b.lease_fail(str(req["client"]), int(req["epoch"]),
+                                int(req["part"]), str(req.get("worker", "")))
+        if op == "state":
+            return b.state()
+        return {"error": f"unknown dataservice op {op!r}"}
 
     def flagged_ranks(self, stale_s: float = 30.0) -> set:
         """Ranks whose pending shards are up for grabs: persistent
@@ -349,6 +558,7 @@ class MetricsAggregator:
                 "histograms": {}}
         view["restarted"] = any(h["restarted"] for h in hosts.values())
         view["shards"] = self.board.state()
+        view["dataservice"] = self.leases.state()
         return view
 
     def format_job_table(self, stale_s: float = 30.0) -> str:
@@ -401,6 +611,24 @@ class MetricsAggregator:
             bound = f"{st}-bound {share:.0f}%" if st else "-"
             lines.append(f"{rank:<6}{h['host']:<17}{bound:<16}"
                          f"{busy:>7.2f}   {'; '.join(flags)}".rstrip())
+        # shard-board + data-service dispatch progress, newest epochs last,
+        # so the table answers "where is the work" as well as "who is slow"
+        for e, st in sorted(view["shards"].items(), key=lambda kv: int(kv[0])):
+            lines.append(
+                f"shards e{e}: {st['done']}/{st['shards']} done, "
+                f"{st['pending']} pending, {len(st['stolen'])} stolen")
+        ds = view["dataservice"]
+        if ds["workers"]:
+            alive = sum(1 for w in ds["workers"].values() if not w["dead"])
+            lines.append(f"data-service: {alive}/{len(ds['workers'])} "
+                         f"workers alive")
+            for client, epochs in sorted(ds["leases"].items()):
+                for e, lease in sorted(epochs.items(),
+                                       key=lambda kv: int(kv[0])):
+                    lines.append(
+                        f"  lease {client} e{e}: {lease['done']}/"
+                        f"{lease['shards']} done, {lease['pending']} pending,"
+                        f" {len(lease['failovers'])} failovers")
         return "\n".join(lines)
 
     def provider(self) -> List[Tuple[Dict[str, str], dict]]:
@@ -409,6 +637,12 @@ class MetricsAggregator:
             hosts = {r: dict(h) for r, h in self._hosts.items()}
         return [({"rank": str(r), "host": h["host"]}, h["snapshot"])
                 for r, h in sorted(hosts.items())]
+
+    def board_provider(self) -> dict:
+        """``telemetry_http.serve`` board_provider: lights up the tracker's
+        ``/shards`` and ``/dataservice`` endpoints."""
+        return {"shards": self.board.state(),
+                "dataservice": self.leases.state()}
 
     def close(self) -> None:
         if self._closed:
@@ -462,14 +696,14 @@ class ShardClient:
         self.rank = int(rank)
         self.timeout = float(timeout)
 
-    def _call(self, req: dict) -> dict:
+    def _call(self, req: dict, key: str = "shard_req") -> dict:
         payload = json.dumps({
             "rank": self.rank,
             "host": socket.gethostname(),
             "pid": os.getpid(),
             "restarted": False,
             "snapshot": telemetry.snapshot(),
-            "shard_req": req,
+            key: req,
         })
         with socket.create_connection((self.tracker_uri, self.metrics_port),
                                       timeout=self.timeout) as sock:
@@ -496,6 +730,11 @@ class ShardClient:
     def done(self, epoch: int, shard: int) -> dict:
         return self._call({"op": "done", "epoch": int(epoch),
                            "shard": int(shard)})
+
+    def data_req(self, req: dict) -> dict:
+        """One dispatcher RPC on the tracker's data-service LeaseBoard —
+        same push+reply discipline as the shard ops, different ledger."""
+        return self._call(req, key="dataservice_req")
 
 
 def shard_client_from_env(rank: Optional[int] = None) -> Optional[ShardClient]:
@@ -560,6 +799,11 @@ class MetricsPusher:
     tracker costs a few connect attempts per minute, not a reconnect spin.
     A success snaps the cadence back to ``interval_s``.  Snapshots are
     cumulative, so any successful push repairs the tracker's view.
+
+    A failure streak also re-reads the tracker address from the env
+    contract: a tracker restarted by the launcher (restart-flags path)
+    binds a NEW ephemeral metrics port and republishes it, and an address
+    resolved once at construction would spin on the dead one forever.
     """
 
     def __init__(self, tracker_uri: str, metrics_port: int, rank: int,
@@ -600,7 +844,24 @@ class MetricsPusher:
                 telemetry.counter_add("tracker.pushes_dropped", 1)
             except Exception:  # telemetry compiled out or lib torn down
                 pass
+            if self._failure_streak >= 2:
+                self._re_resolve()
             return False
+
+    def _re_resolve(self) -> None:
+        """Pick up a restarted tracker's republished address from the env
+        (no-op while the env still names the address we already use, or in
+        standalone runs where the contract was never set)."""
+        uri = os.environ.get("DMLC_TRACKER_URI")
+        port = os.environ.get(METRICS_PORT_ENV, "")
+        if uri and uri != self.tracker_uri:
+            LOGGER.info("metrics pusher re-resolved tracker %s -> %s",
+                        self.tracker_uri, uri)
+            self.tracker_uri = uri
+        if port.isdigit() and int(port) != self.metrics_port:
+            LOGGER.info("metrics pusher re-resolved metrics port %d -> %s",
+                        self.metrics_port, port)
+            self.metrics_port = int(port)
 
     def close(self, final_push: bool = True) -> None:
         """Stop the thread; by default push one last snapshot so the tracker

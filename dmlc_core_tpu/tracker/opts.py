@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--local-num-attempt", type=int,
                         default=int(os.environ.get("DMLC_NUM_ATTEMPT", "1")),
                         help="local: restart attempts for failed workers")
+    parser.add_argument("--data-service", type=int,
+                        default=int(os.environ.get("DMLC_DATA_SERVICE", "0")),
+                        help="spawn N staging-service workers next to the "
+                             "tracker (doc/dataservice.md); they register "
+                             "with the tracker's lease board and serve "
+                             "pre-binned batches to DataServiceIter clients")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VALUE exported to every worker (repeatable)")
     parser.add_argument("--log-level", default="INFO",

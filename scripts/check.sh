@@ -203,6 +203,14 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # exactly one counted rebuild with a bit-identical stream after.
   python -m pytest tests/test_binned_cache.py -x -q
 
+  # Dataservice tier: the staging-service suite WITHOUT the slow-marker
+  # filter, so the multi-process proofs run here too — a worker
+  # subprocess streaming a bit-identical epoch (and identical GBDT
+  # forest) to a client subprocess, a mid-epoch worker SIGKILL with a
+  # survivor completing the epoch exactly-once, and one worker serving
+  # two client processes off a single parse (doc/dataservice.md).
+  python -m pytest tests/test_dataservice.py -x -q
+
   # Sparse-pallas tier: the sparse COO histogram kernel and its GBDT
   # wiring, slow marks included — the interpret-mode kernel parity suite,
   # the feature-sort determinism + sharded-layout psum cases, and the
@@ -215,5 +223,5 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + sparse-pallas tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + sparse-pallas tier")
 echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"

@@ -1,0 +1,605 @@
+"""Disaggregated staging service (doc/dataservice.md).
+
+Fast tier: wire-protocol framing + the native staged-batch codec,
+LeaseBoard exactly-once/failover semantics, the dispatcher RPC on the
+0xff98 channel, and a full in-process worker+client epoch proving the
+remote pre-binned stream is BIT-identical to a local cache-hit epoch.
+
+Slow tier (multi-process): a real worker subprocess streaming to a client
+child (bit-identity + identical GBDT forest vs a locally-parsed fit), a
+mid-epoch worker kill with a survivor completing the epoch exactly-once,
+and the fleet-wide single-parse property (one worker, two client
+processes, a single ``.bincache`` file and zero invalidation rebuilds).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+from dmlc_core_tpu import faultinject, telemetry  # noqa: E402
+from dmlc_core_tpu.dataservice import protocol  # noqa: E402
+from dmlc_core_tpu.dataservice.client import DataServiceIter  # noqa: E402
+from dmlc_core_tpu.dataservice.server import (StagingWorker,  # noqa: E402
+                                              spec_key)
+from dmlc_core_tpu.tracker import metrics as tm  # noqa: E402
+
+
+def _write_libsvm(path, rows=600, features=40, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            feats = sorted(rng.choice(features, size=rng.integers(3, 10),
+                                      replace=False))
+            f.write(" ".join([str(rng.integers(0, 2))] +
+                             [f"{j}:{rng.normal():.4f}" for j in feats])
+                    + "\n")
+    return str(path)
+
+
+def _binner():
+    from dmlc_core_tpu.models import QuantileBinner
+    return QuantileBinner(num_bins=32, missing_aware=True, sketch_size=64,
+                          sketch_seed=3)
+
+
+def _batch_digest(batches) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    for b in batches:
+        for f in ("label", "weight", "row_ptr", "index", "ebin", "emask"):
+            h.update(np.asarray(getattr(b, f)).tobytes())
+        h.update(str(int(b.num_rows)).encode())
+    return h.hexdigest()
+
+
+@pytest.fixture()
+def board_env(tmp_path):
+    """Aggregator + env contract + one in-process staging worker."""
+    agg = tm.MetricsAggregator()
+    old = {k: os.environ.get(k) for k in ("DMLC_TRACKER_URI",
+                                          tm.METRICS_PORT_ENV)}
+    os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+    os.environ[tm.METRICS_PORT_ENV] = str(agg.port)
+    worker = StagingWorker(cache_dir=str(tmp_path / "cache"))
+    yield agg, worker
+    worker.close()
+    agg.close()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---- protocol + wire codec ---------------------------------------------------
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        protocol.write_frame(a, protocol.FRAME_BLOCK, b"abc", b"defg")
+        kind, payload = protocol.read_frame(b)
+        assert kind == protocol.FRAME_BLOCK
+        assert bytes(payload) == b"abcdefg"
+        assert isinstance(payload, bytearray)  # writable: arrays alias it
+
+        protocol.write_json_frame(a, protocol.FRAME_END, {"blocks": 7})
+        kind, payload = protocol.read_frame(b)
+        assert kind == protocol.FRAME_END and payload == {"blocks": 7}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_handshake_rejects_wrong_magic():
+    a, b = socket.socketpair()
+    try:
+        tm._write_int(a, 0xBEEF)
+        with pytest.raises(ConnectionError, match="magic"):
+            protocol.server_handshake(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_staged_wire_roundtrip(tmp_path):
+    """pack -> frame bytes -> native FromWire -> zero-copy views carry the
+    exact batch; a corrupted header must be rejected, not decoded."""
+    import ctypes
+
+    from dmlc_core_tpu._native import check
+    from dmlc_core_tpu.data.staging import (_declare_batcher_sig,
+                                            _StagedBatchOwnedC)
+    uri = _write_libsvm(tmp_path / "t.libsvm", rows=100)
+    L = _declare_batcher_sig()
+    h = ctypes.c_void_p()
+    check(L.DmlcTpuStagedBatcherCreate(uri.encode(), 0, 1, b"libsvm", 64,
+                                       256, 0, 0, 0, ctypes.byref(h)))
+    rows = 0
+    frames = []
+    try:
+        while True:
+            c = _StagedBatchOwnedC()
+            if check(L.DmlcTpuStagedBatcherNextOwned(
+                    h, ctypes.byref(c))) != 1:
+                break
+            hdr, arena = protocol.pack_staged_wire(c)
+            assert len(hdr) == protocol.WIRE_HEADER_BYTES
+            buf = bytearray(hdr) + bytearray(arena)
+            L.DmlcTpuStagedBatchFree(ctypes.c_void_p(c.batch))
+            frames.append((bytearray(buf), int(c.num_rows)))
+            rows += int(c.num_rows)
+    finally:
+        L.DmlcTpuStagedBatcherFree(h)
+    assert rows == 100 and frames
+
+    total = 0
+    for buf, want_rows in frames:
+        w = protocol.unwrap_staged_wire(buf)
+        assert w["num_rows"] == want_rows
+        assert w["label"].shape == (64,)
+        rp = w["row_ptr"]
+        assert rp[0] == 0 and (np.diff(rp) >= 0).all()
+        assert w["index"].shape == w["value"].shape
+        # the views alias the receive buffer (zero rebind copies)
+        assert w["label"].base is not None
+        total += w["num_rows"]
+    assert total == 100
+
+    bad = bytearray(frames[0][0])
+    bad[0] ^= 0xFF  # break the magic
+    with pytest.raises(Exception, match="(?i)magic|wire"):
+        protocol.unwrap_staged_wire(bad)
+
+    short = bytearray(frames[0][0][:protocol.WIRE_HEADER_BYTES + 4])
+    with pytest.raises(Exception):
+        protocol.unwrap_staged_wire(short)
+
+
+def test_fault_fire_python_hops():
+    """Python-side hops fire points in the NATIVE registry, so arming specs
+    and replay seeds cover them like any compiled-in point."""
+    if not faultinject.compiled_in():
+        pytest.skip("faults compiled out")
+    assert faultinject.fire("dataservice.connect") == 0  # unarmed: clean
+    with faultinject.armed("dataservice.connect=err@1.0"):
+        assert faultinject.MODE_NAMES[
+            faultinject.fire("dataservice.connect")] == "err"
+    assert faultinject.fire("dataservice.connect") == 0
+
+
+# ---- LeaseBoard semantics ----------------------------------------------------
+
+def test_leaseboard_exactly_once_and_failover():
+    b = tm.LeaseBoard()
+    assert b.lease_assign("c", 0, 0) == {"wait": True}  # no fleet yet
+    b.worker_register("w0", "hostA", 7000)
+    b.worker_register("w1", "hostB", 7001)
+    b.lease_register("c", 0, range(4))
+
+    got = {p: b.lease_assign("c", 0, p)["worker"] for p in range(4)}
+    # stable fleet -> stable placement (cache-warm affinity)
+    again = {p: b.lease_assign("c", 0, p)["worker"] for p in range(4)}
+    assert got == again
+
+    b.lease_done("c", 0, 0, got[0]["id"])
+    assert b.lease_assign("c", 0, 0) == {"done": True}  # replay skips
+
+    # failover: w for part 1 dies -> reassignment lands on the survivor
+    dead = got[1]["id"]
+    r = b.lease_fail("c", 0, 1, dead)
+    assert r["ok"] and r["workers"] == 1
+    r2 = b.lease_assign("c", 0, 1)
+    assert r2["worker"]["id"] != dead
+    led = b.state()["leases"]["c"]["0"]
+    assert led["failovers"] and led["failovers"][0]["part"] == 1
+
+    # a heartbeat revives the reported-dead worker
+    assert b.worker_heartbeat(dead) == {"ok": True}
+    assert not b.state()["workers"][dead]["dead"]
+
+    # graceful leave requeues undone leases and stops assignment
+    b.worker_leave("w0")
+    b.worker_leave("w1")
+    assert b.lease_assign("c", 0, 2) == {"wait": True}
+    assert b.worker_heartbeat("unknown-worker") == {"ok": False}
+
+
+def test_dataservice_rpc_on_metrics_channel(board_env):
+    """The dispatcher ops ride the 0xff98 channel as dataservice_req —
+    push+reply like shard_req, against the LeaseBoard ledger."""
+    agg, worker = board_env
+    sc = tm.ShardClient("127.0.0.1", agg.port, rank=0)
+    st = sc.data_req({"op": "state"})
+    assert worker.worker_id in st["workers"]
+    assert not st["workers"][worker.worker_id]["dead"]
+
+    sc.data_req({"op": "lease_register", "client": "t", "epoch": 0,
+                 "parts": [0, 1]})
+    r = sc.data_req({"op": "lease_assign", "client": "t", "epoch": 0,
+                     "part": 0})
+    assert r["worker"]["port"] == worker.port
+    assert sc.data_req({"op": "nope"}).get("error")
+
+    snap = agg.job_snapshot()
+    assert worker.worker_id in snap["dataservice"]["workers"]
+
+
+# ---- in-process end-to-end ---------------------------------------------------
+
+def test_service_bit_identity_inprocess(board_env, tmp_path):
+    """One worker, one client, loopback TCP: the remote pre-binned epoch is
+    byte-for-byte the local cache-hit epoch, and the observability plane
+    (/shards, /dataservice, format_job_table) sees the fleet."""
+    from dmlc_core_tpu import telemetry_http
+    from dmlc_core_tpu.data.binned_cache import BinnedStagingIter
+    agg, worker = board_env
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    it = DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                         shard_client=tm.ShardClient("127.0.0.1", agg.port,
+                                                     rank=0))
+    remote = list(it)
+    assert remote and it.batches_staged == len(remote)
+
+    cache = str(tmp_path / "cache" / (spec_key(it._spec) + ".bincache"))
+    local = list(BinnedStagingIter(uri, _binner(), cache=cache,
+                                   batch_size=64, nnz_bucket=256))
+    assert _batch_digest(remote) == _batch_digest(local)
+    assert remote[0].cuts_digest == local[0].cuts_digest
+
+    # a second epoch re-leases under a fresh ledger and still matches
+    assert _batch_digest(list(it)) == _batch_digest(local)
+
+    led = agg.leases.state()["leases"][it.client_id]
+    for _epoch, lease in led.items():
+        assert lease["done"] == lease["shards"] and lease["pending"] == 0
+        assert not lease["failovers"]
+
+    table = agg.format_job_table()
+    assert "data-service" in table and "lease" in table
+
+    import urllib.request
+    with telemetry_http.serve(port=0, provider=agg.provider,
+                              board_provider=agg.board_provider) as srv:
+        ds = json.loads(urllib.request.urlopen(
+            srv.url + "/dataservice", timeout=10).read())
+        assert worker.worker_id in ds["workers"]
+        assert it.client_id in ds["leases"]
+        shards = json.loads(urllib.request.urlopen(
+            srv.url + "/shards", timeout=10).read())
+        assert isinstance(shards, dict)
+    with telemetry_http.serve(port=0) as srv:  # worker endpoint: no board
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url + "/shards", timeout=10)
+
+
+def test_staged_mode_inprocess(board_env, tmp_path):
+    """Text-fallback mode: the worker ships packed parse batches, the
+    client bins with its fitted cuts — same rows, same label multiset."""
+    from dmlc_core_tpu.data.binned_cache import BinnedStagingIter
+    agg, worker = board_env
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    binner = _binner()
+    cache = str(tmp_path / "local.bincache")
+    local = list(BinnedStagingIter(uri, binner, cache=cache, batch_size=64,
+                                   nnz_bucket=256))  # also fits the binner
+
+    it = DataServiceIter(uri, binner, batch_size=64, nnz_bucket=256,
+                         mode="staged",
+                         shard_client=tm.ShardClient("127.0.0.1", agg.port,
+                                                     rank=0))
+    staged = list(it)
+    rows = lambda bs: sum(int(b.num_rows) for b in bs)  # noqa: E731
+    assert rows(staged) == rows(local) == 600
+
+    def labels(bs):
+        return np.sort(np.concatenate(
+            [np.asarray(b.label)[:int(b.num_rows)] for b in bs]))
+    assert (labels(staged) == labels(local)).all()
+
+
+def test_worker_failover_inprocess(board_env, tmp_path):
+    """Kill a worker (without drain) once the epoch has leased shards to
+    it: every remaining shard fails over to the survivor, the epoch
+    completes, and visitation stays exactly-once."""
+    agg, w0 = board_env
+    w1 = StagingWorker(cache_dir=str(tmp_path / "cache1"))
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    try:
+        it = DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                             retries=8,
+                             shard_client=tm.ShardClient(
+                                 "127.0.0.1", agg.port, rank=0))
+        it.ensure_meta()
+        V = it._virtual_parts
+        assert V >= 2
+        it._data().data_req({"op": "lease_register",
+                             "client": it.client_id, "epoch": 0,
+                             "parts": list(range(V))})
+        # find which worker part 0 lands on and kill exactly that one,
+        # abruptly (no leave): the client's failed fetch must discover it
+        r = it._data().data_req({"op": "lease_assign",
+                                 "client": it.client_id, "epoch": 0,
+                                 "part": 0})
+        victim = w0 if r["worker"]["id"] == w0.worker_id else w1
+        survivor = w1 if victim is w0 else w0
+        victim.close(leave=False)
+
+        blocks = [it._fetch_part(0, g) for g in range(V)]
+        rows = sum(int(b["num_rows"]) for bs in blocks for b in bs)
+        assert rows == 600
+
+        st = agg.leases.state()
+        lease = st["leases"][it.client_id]["0"]
+        assert lease["done"] == V and lease["pending"] == 0
+        assert len(lease["failovers"]) >= 1
+        assert all(f["worker"] == victim.worker_id
+                   for f in lease["failovers"])
+        assert st["workers"][victim.worker_id]["dead"]
+        assert not st["workers"][survivor.worker_id]["dead"]
+        # failover telemetry reached the shared registry
+        assert telemetry.counter_get("dataservice.failovers") >= 1
+    finally:
+        w1.close()
+
+
+def test_metrics_pusher_re_resolves_restarted_tracker():
+    """Satellite regression: a pusher constructed against a dead address
+    must rejoin a tracker that restarted on a NEW port once the env
+    contract republishes it — two failures trigger the re-resolve."""
+    agg = tm.MetricsAggregator()
+    old = {k: os.environ.get(k) for k in ("DMLC_TRACKER_URI",
+                                          tm.METRICS_PORT_ENV)}
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()  # nothing listens here: the "old" tracker address
+    try:
+        os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+        os.environ[tm.METRICS_PORT_ENV] = str(agg.port)
+        p = tm.MetricsPusher("127.0.0.1", dead_port, rank=5,
+                             interval_s=3600)  # loop parked; push manually
+        assert not p.push()
+        assert p.metrics_port == dead_port  # one failure: no re-resolve yet
+        assert not p.push()
+        assert p.metrics_port == agg.port  # streak of 2 re-read the env
+        assert p.push()
+        assert p._failure_streak == 0
+        deadline = time.time() + 10
+        while 5 not in agg.job_snapshot()["hosts"] and time.time() < deadline:
+            time.sleep(0.05)
+        assert 5 in agg.job_snapshot()["hosts"]
+        p.close(final_push=False)
+    finally:
+        agg.close()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---- multi-process (slow tier) -----------------------------------------------
+
+_CLIENT_CHILD = r"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from dmlc_core_tpu.dataservice.client import DataServiceIter
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+uri, cid = sys.argv[2], sys.argv[3]
+binner = QuantileBinner(num_bins=32, missing_aware=True, sketch_size=64,
+                        sketch_seed=3)
+it = DataServiceIter(uri, binner, batch_size=64, nnz_bucket=256,
+                     client_id=cid, retries=8)
+import hashlib
+h = hashlib.sha256()
+batches = 0
+for b in it:
+    for f in ("label", "weight", "row_ptr", "index", "ebin", "emask"):
+        h.update(np.asarray(getattr(b, f)).tobytes())
+    h.update(str(int(b.num_rows)).encode())
+    batches += 1
+forest = GBDT(num_features=64, num_bins=32, num_trees=2, max_depth=3,
+              missing_aware=True).fit_streamed(lambda: iter(it), binner)
+fh = hashlib.sha256()
+for k in sorted(forest):
+    fh.update(np.asarray(forest[k]).tobytes())
+print("RESULT " + json.dumps({"digest": h.hexdigest(), "batches": batches,
+                              "forest": fh.hexdigest()}), flush=True)
+"""
+
+
+def _spawn_worker(tmp_path, agg, tag):
+    """Start one staging-worker subprocess; returns (proc, data_port)."""
+    env = dict(os.environ)
+    env["DMLC_TRACKER_URI"] = "127.0.0.1"
+    env[tm.METRICS_PORT_ENV] = str(agg.port)
+    env["DMLCTPU_DATASERVICE_CACHE_DIR"] = str(tmp_path / f"cache-{tag}")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dmlc_core_tpu.dataservice.server"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(REPO))
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("DATASERVICE_READY"):
+            return proc, int(line.split(":")[-1])
+        if proc.poll() is not None:
+            break
+    proc.kill()
+    raise AssertionError(f"staging worker {tag} never came up")
+
+
+@pytest.mark.slow
+def test_two_process_bit_identity_and_forest(tmp_path):
+    """Acceptance: worker subprocess streams to a client subprocess over
+    loopback TCP; the client's batches and its trained forest are
+    bit-identical to a fully-local parse+cache+fit."""
+    agg = tm.MetricsAggregator()
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    worker = client = None
+    try:
+        worker, _port = _spawn_worker(tmp_path, agg, "w0")
+        env = dict(os.environ)
+        env["DMLC_TRACKER_URI"] = "127.0.0.1"
+        env[tm.METRICS_PORT_ENV] = str(agg.port)
+        client = subprocess.Popen(
+            [sys.executable, "-c", _CLIENT_CHILD, str(REPO), uri, "c-two"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(REPO))
+        out, err = client.communicate(timeout=600)
+        assert client.returncode == 0, f"client failed:\n{err[-3000:]}"
+        got = next(json.loads(ln[len("RESULT "):])
+                   for ln in out.splitlines() if ln.startswith("RESULT "))
+
+        # local reference: own parse, own cache, same knobs
+        from dmlc_core_tpu.data.binned_cache import BinnedStagingIter
+        from dmlc_core_tpu.models import GBDT
+        import hashlib
+        binner = _binner()
+        lit = BinnedStagingIter(uri, binner,
+                                cache=str(tmp_path / "ref.bincache"),
+                                batch_size=64, nnz_bucket=256)
+        local = list(lit)
+        assert _batch_digest(local) == got["digest"]
+        assert len(local) == got["batches"]
+        forest = GBDT(num_features=64, num_bins=32, num_trees=2,
+                      max_depth=3, missing_aware=True).fit_streamed(
+                          lambda: iter(lit), binner)
+        fh = hashlib.sha256()
+        for k in sorted(forest):
+            fh.update(np.asarray(forest[k]).tobytes())
+        assert fh.hexdigest() == got["forest"]
+
+        lease = agg.leases.state()["leases"]["c-two"]
+        for _e, led in lease.items():
+            assert led["done"] == led["shards"] and not led["failovers"]
+    finally:
+        if client is not None and client.poll() is None:
+            client.kill()
+        if worker is not None:
+            worker.terminate()
+            worker.wait(timeout=10)
+        agg.close()
+
+
+@pytest.mark.slow
+def test_worker_kill_mid_epoch_exactly_once(tmp_path):
+    """Acceptance: two worker subprocesses; the one holding this epoch's
+    next lease is SIGKILLed mid-epoch; the client finishes on the
+    survivor with exactly-once visitation and a recorded failover."""
+    agg = tm.MetricsAggregator()
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    procs = {}
+    try:
+        for i in (0, 1):
+            proc, port = _spawn_worker(tmp_path, agg, f"w{i}")
+            procs[port] = proc
+        deadline = time.time() + 30
+        while len(agg.leases.state()["workers"]) < 2 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert len(agg.leases.state()["workers"]) == 2
+
+        it = DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                             retries=8, client_id="c-kill",
+                             shard_client=tm.ShardClient(
+                                 "127.0.0.1", agg.port, rank=0))
+        it.ensure_meta()
+        V = it._virtual_parts
+        it._data().data_req({"op": "lease_register", "client": "c-kill",
+                             "epoch": 0, "parts": list(range(V))})
+        # fetch the first half normally...
+        blocks = [it._fetch_part(0, g) for g in range(V // 2)]
+        # ...then SIGKILL whichever worker the NEXT part is leased to
+        r = it._data().data_req({"op": "lease_assign", "client": "c-kill",
+                                 "epoch": 0, "part": V // 2})
+        victim_id = r["worker"]["id"]
+        procs[int(r["worker"]["port"])].kill()
+        blocks += [it._fetch_part(0, g) for g in range(V // 2, V)]
+
+        rows = sum(int(b["num_rows"]) for bs in blocks for b in bs)
+        assert rows == 600
+        lease = agg.leases.state()["leases"]["c-kill"]["0"]
+        assert lease["done"] == V and lease["pending"] == 0
+        assert len(lease["failovers"]) >= 1
+        assert all(f["worker"] == victim_id for f in lease["failovers"])
+        # every part completed exactly once, each on exactly one worker
+        board = agg.leases
+        with board._lock:
+            led = board._ledgers[("c-kill", 0)]
+            assert sorted(led["done"]) == list(range(V))
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        agg.close()
+
+
+@pytest.mark.slow
+def test_three_process_single_parse(tmp_path):
+    """Fleet-wide single parse: one worker (in-process, so its telemetry is
+    readable), two concurrent client subprocesses — the dataset is parsed
+    and binned ONCE (a single .bincache file on the worker, zero
+    invalidation rebuilds) and both clients see the identical batch
+    stream."""
+    agg = tm.MetricsAggregator()
+    old = {k: os.environ.get(k) for k in ("DMLC_TRACKER_URI",
+                                          tm.METRICS_PORT_ENV)}
+    os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+    os.environ[tm.METRICS_PORT_ENV] = str(agg.port)
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    worker = None
+    clients = []
+    try:
+        rebuilds0 = telemetry.counter_get("cache.rebuilds")
+        worker = StagingWorker(cache_dir=str(tmp_path / "cache"))
+        env = dict(os.environ)
+        clients = [subprocess.Popen(
+            [sys.executable, "-c", _CLIENT_CHILD, str(REPO), uri,
+             f"c-par{i}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(REPO)) for i in (0, 1)]
+        results = []
+        for i, c in enumerate(clients):
+            out, err = c.communicate(timeout=600)
+            assert c.returncode == 0, f"client {i} failed:\n{err[-3000:]}"
+            results.append(next(
+                json.loads(ln[len("RESULT "):]) for ln in out.splitlines()
+                if ln.startswith("RESULT ")))
+        assert results[0]["digest"] == results[1]["digest"]
+        assert results[0]["forest"] == results[1]["forest"]
+        assert results[0]["batches"] > 0
+        # the whole fleet parsed the text exactly once: the worker built a
+        # single cache file (a missing file is a first build, so
+        # cache.rebuilds — which counts invalidations — must stay put) and
+        # every block both clients consumed was served from it.
+        caches = list((tmp_path / "cache").glob("*.bincache"))
+        assert len(caches) == 1
+        assert telemetry.counter_get("cache.rebuilds") - rebuilds0 == 0
+        assert telemetry.counter_get("dataservice.serve_blocks") > 0
+        assert telemetry.counter_get("dataservice.requests") >= 2
+    finally:
+        for c in clients:
+            if c.poll() is None:
+                c.kill()
+        if worker is not None:
+            worker.close()
+        agg.close()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
