@@ -1120,7 +1120,11 @@ def run_bincache(data: Path) -> dict:
     the marginal cost of writing the cache.  repeat_ok / build_ok are soft
     asserts (red in the round artifact, not a crash): on a 1-core box the
     bin+write pass can't overlap idle cores, so build_ok is expected red
-    there and meaningful on real hosts; forest_identical is exact."""
+    there and meaningful on real hosts; forest_identical is exact.  The
+    codec object on the DETAIL line A/Bs the same cache built under lz4:
+    on-disk ratio, decode seconds absorbed inside the repack stage, and
+    the compressed build/repeat epochs (doc/binned_cache.md "Block
+    codec")."""
     jax, platform = pick_backend()
     import numpy as np
     from dmlc_core_tpu import telemetry
@@ -1230,6 +1234,46 @@ def run_bincache(data: Path) -> dict:
     if not out["forest_identical"]:
         log("[bench] WARNING: forest trained from the binned cache is NOT "
             "bit-identical to the text-path forest")
+
+    # compressed tier (doc/binned_cache.md "Block codec"): rebuild the same
+    # cache under bitshuffle+LZ4 and re-serve it — bit-identity raw-vs-lz4
+    # is the test suite's contract (tests/test_binned_cache.py); the bench
+    # reports the on-disk ratio and the decode time the hit path absorbed
+    # inside the repack stage (decode_s is part of repeat_epoch_s, not an
+    # extra stage).  Local disk is fast, so no local speed gate here — the
+    # >=2x soft gate lives in run_dataservice, on a bandwidth-capped wire.
+    from dmlc_core_tpu.data.binned_cache import resolve_codec
+    if resolve_codec("lz4") != "lz4":
+        out["codec"] = {"skipped": "libdmlctpu built with -DDMLCTPU_CODEC=0"}
+        return out
+    lz4_path = CACHE / (data.name + ".lz4.bincache")
+    if lz4_path.exists():
+        lz4_path.unlink()
+    lz4_it = BinnedStagingIter(uri, binner, cache=str(lz4_path),
+                               codec="lz4", **kw)
+    lz4_build = epoch_secs(lz4_it)
+    dus0 = telemetry.counter_get("cache.codec.decode_us")
+    bin0 = telemetry.counter_get("cache.codec.bytes_in")
+    bout0 = telemetry.counter_get("cache.codec.bytes_out")
+    lz4_repeat = min(epoch_secs(lz4_it) for _ in range(2))
+    raw_b = cache_path.stat().st_size
+    lz4_b = lz4_path.stat().st_size
+    bytes_in = telemetry.counter_get("cache.codec.bytes_in") - bin0
+    bytes_out = telemetry.counter_get("cache.codec.bytes_out") - bout0
+    out["codec"] = {
+        "name": "lz4",
+        "build_epoch_s": round(lz4_build, 3),
+        "repeat_epoch_s": round(lz4_repeat, 3),
+        "raw_cache_mb": round(raw_b / (1 << 20), 1),
+        "lz4_cache_mb": round(lz4_b / (1 << 20), 1),
+        "disk_ratio": round(raw_b / max(lz4_b, 1), 2),
+        "expansion": round(bytes_out / max(bytes_in, 1), 2),
+        "decode_s": round(
+            (telemetry.counter_get("cache.codec.decode_us") - dus0) / 1e6, 3),
+    }
+    if out["codec"]["disk_ratio"] < 1.0:
+        log(f"[bench] WARNING: lz4 bincache is LARGER than raw "
+            f"({lz4_b} vs {raw_b} bytes) — codec not engaging?")
     return out
 
 
@@ -1242,7 +1286,12 @@ def run_dataservice(data: Path) -> dict:
     TCP on a 1-core box serializes the worker's reads against the
     client's repack, so the ratio is a floor, not a target — on real
     hosts the fetch overlaps training and the remote stream is the same
-    bytes (bit-identity is the test suite's job, tests/test_dataservice.py)."""
+    bytes (bit-identity is the test suite's job, tests/test_dataservice.py).
+    A second A/B pins the worker's outbound stream behind the
+    DMLCTPU_DATASERVICE_THROTTLE_MBPS token bucket and serves the epoch
+    raw vs lz4-compressed (codec object on the DETAIL line): with the
+    socket as the bottleneck the compressed wire must reach >=2x the raw
+    wire (codec_wire_ok, soft)."""
     jax, platform = pick_backend()
     import os
     import shutil
@@ -1280,7 +1329,8 @@ def run_dataservice(data: Path) -> dict:
     # the service: in-process lease board + one worker, client on loopback
     agg = tm.MetricsAggregator()
     old = {k: os.environ.get(k) for k in ("DMLC_TRACKER_URI",
-                                          tm.METRICS_PORT_ENV)}
+                                          tm.METRICS_PORT_ENV,
+                                          "DMLCTPU_DATASERVICE_THROTTLE_MBPS")}
     os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
     os.environ[tm.METRICS_PORT_ENV] = str(agg.port)
     svc_dir = CACHE / "dataservice_worker"
@@ -1296,6 +1346,57 @@ def run_dataservice(data: Path) -> dict:
         out["fetched_mb"] = round(
             (telemetry.counter_get("dataservice.fetch_bytes") - fetch0)
             / (1 << 20), 1)
+
+        # compressed-wire A/B (doc/binned_cache.md "Block codec"): cap the
+        # worker's outbound stream with the token-bucket throttle so the
+        # socket — not the parse or the repack — is the bottleneck, then
+        # serve the same epoch raw vs lz4.  Frames cross the wire in the
+        # cache's stored (compressed) form and the client decodes, so the
+        # throttled epoch should speed up by ~the compression ratio.  Soft
+        # gate codec_wire_ok: >=2x, red in the round artifact if the codec
+        # stops paying for itself on a capped link.
+        from dmlc_core_tpu.data.binned_cache import resolve_codec
+        if resolve_codec("lz4") != "lz4":
+            out["codec"] = {
+                "skipped": "libdmlctpu built with -DDMLCTPU_CODEC=0"}
+        else:
+            lz4_it = DataServiceIter(uri, QuantileBinner(**bkw),
+                                     codec="lz4", **kw)
+            epoch_secs(lz4_it)  # worker-side lz4 cache build, unthrottled
+            lz4_plain = min(epoch_secs(lz4_it) for _ in range(2))
+            per_epoch_mb = out["fetched_mb"] / 3.0  # warmup + 2 timed
+            cap_mbps = max(6.0, per_epoch_mb / 2.5)
+            os.environ["DMLCTPU_DATASERVICE_THROTTLE_MBPS"] = (
+                f"{cap_mbps:.1f}")
+            throttled_raw = min(epoch_secs(it) for _ in range(2))
+            throttled_lz4 = min(epoch_secs(lz4_it) for _ in range(2))
+            os.environ.pop("DMLCTPU_DATASERVICE_THROTTLE_MBPS", None)
+            # wall ratio vs net ratio: on a 1-core loopback the epoch wall
+            # includes a serialized repack floor the cap never touches, so
+            # the wall ratio understates the socket win.  The net ratio
+            # divides the time the cap ADDED to each side (throttled minus
+            # the unthrottled epoch) — that is the wire itself, and the
+            # quantity the >=2x soft gate watches.
+            wire_speedup = throttled_raw / max(throttled_lz4, 1e-9)
+            net_raw = max(throttled_raw - served, 0.0)
+            net_lz4 = max(throttled_lz4 - lz4_plain, 1e-9)
+            wire_net = net_raw / net_lz4
+            ok = wire_net >= 2.0 or wire_speedup >= 2.0
+            out["codec"] = {
+                "name": "lz4",
+                "throttle_mbps": round(cap_mbps, 1),
+                "lz4_epoch_s": round(lz4_plain, 3),
+                "throttled_raw_epoch_s": round(throttled_raw, 3),
+                "throttled_lz4_epoch_s": round(throttled_lz4, 3),
+                "wire_speedup": round(wire_speedup, 2),
+                "wire_net_speedup": round(wire_net, 2),
+                "codec_wire_ok": ok,
+            }
+            if not ok:
+                log(f"[bench] WARNING: lz4 wire only {wire_net:.2f}x raw "
+                    f"net of the repack floor ({wire_speedup:.2f}x wall) "
+                    f"under a {cap_mbps:.1f} MB/s cap (want >=2x): "
+                    f"{throttled_lz4:.2f}s vs {throttled_raw:.2f}s")
     finally:
         if worker is not None:
             worker.close()

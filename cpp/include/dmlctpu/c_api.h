@@ -321,6 +321,12 @@ int DmlcTpuBinnedCacheWriterWriteRaw(DmlcTpuBinnedCacheWriterHandle handle,
                                      const int32_t* row_ptr,
                                      const int32_t* index, const float* value,
                                      const int32_t* qid);
+/* select the block codec (block_codec.h id: 0 raw, 1 bitshuffle+LZ4) for
+ * subsequent WriteBlock/WriteRaw calls; incompressible blocks silently
+ * stay raw (per-record cflag 0), so bit-identity never depends on
+ * compressibility */
+int DmlcTpuBinnedCacheWriterSetCodec(DmlcTpuBinnedCacheWriterHandle handle,
+                                     int codec);
 /* write the part map and patch the header sentinels (LAST, so a crash
  * before this leaves an invalid cache that readers reject) */
 int DmlcTpuBinnedCacheWriterClose(DmlcTpuBinnedCacheWriterHandle handle);
@@ -356,6 +362,20 @@ int DmlcTpuBinnedCacheReaderNextBlock(DmlcTpuBinnedCacheReaderHandle handle,
 int DmlcTpuBinnedCacheReaderNextBlockView(
     DmlcTpuBinnedCacheReaderHandle handle, const void** data, uint64_t* size,
     int* borrowed);
+/* arena backing the last NextBlockView result when that record was
+ * compressed and decoded (doc/binned_cache.md "Block codec"): *out is the
+ * CacheArenaPool arena the decoded view points into and ownership moves to
+ * the caller (release with DmlcTpuCacheArenaRelease when the view is no
+ * longer referenced); *out = NULL when the last view was raw.  Untaken
+ * decode arenas are recycled on the next NextBlockView call. */
+int DmlcTpuBinnedCacheReaderTakeArena(DmlcTpuBinnedCacheReaderHandle handle,
+                                      void** out);
+/* toggle inline decode (default 1).  At 0, NextBlock/NextBlockView return
+ * records exactly as stored, compressed payloads included — the staging
+ * dataservice worker's serve mode: wire frames ship stored bytes verbatim
+ * and the client decodes (DmlcTpuBinnedBlockDecode). */
+int DmlcTpuBinnedCacheReaderSetDecode(DmlcTpuBinnedCacheReaderHandle handle,
+                                      int decode);
 /* read backend this open resolved to: 0 stream, 1 mmap, 2 O_DIRECT arena */
 int DmlcTpuBinnedCacheReaderBackend(DmlcTpuBinnedCacheReaderHandle handle,
                                     int* out);
@@ -372,6 +392,37 @@ void DmlcTpuBinnedCacheReaderFree(DmlcTpuBinnedCacheReaderHandle handle);
  * pool is at its DMLCTPU_BINCACHE_ARENA_MB cap; callable from any thread. */
 int DmlcTpuCacheArenaAcquire(uint64_t size, void** out);
 int DmlcTpuCacheArenaRelease(void* ptr);
+
+/* ---- block codec (cpp/src/data/block_codec.h) ---------------------------
+ * Dependency-free bitshuffle+LZ4 block compression for binned cache
+ * records (doc/binned_cache.md "Block codec").  Codec ids are on-disk
+ * format: 0 raw, 1 lz4, 2 reserved for zstd. */
+/* 1 when compression codecs are compiled in (-DDMLCTPU_CODEC=1, default);
+ * 0 in a compiled-out build, where every write falls back to raw */
+int DmlcTpuBlockCodecEnabled(void);
+/* codec id for a knob spelling ("raw"/"lz4"); -1 unknown or not built in */
+int DmlcTpuBlockCodecFromName(const char* name);
+/* canonical name for a codec id ("raw"/"lz4"/"zstd"/"unknown"; static) */
+const char* DmlcTpuBlockCodecName(int codec);
+/* worst-case Encode output size for n input bytes */
+uint64_t DmlcTpuBlockCodecBound(uint64_t n);
+/* compress n bytes into out (cap >= Bound(n)): returns the compressed
+ * size, 0 when incompressible (store raw), -1 on error */
+int64_t DmlcTpuBlockCodecEncode(int codec, const void* in, uint64_t n,
+                                void* out, uint64_t cap);
+/* decompress n bytes into exactly raw_len bytes at out; bounds-checked —
+ * truncated or bit-flipped input returns -1, never overreads.  0 on ok. */
+int64_t DmlcTpuBlockCodecDecode(int codec, const void* in, uint64_t n,
+                                void* out, uint64_t raw_len);
+/* decode one maybe-compressed block record payload (header + columns, as
+ * served by NextBlockView on a remote worker or read off the 0xff9a wire):
+ * when the payload is compressed, *arena is a CacheArenaPool arena holding
+ * [header cflag=0][raw columns] of *out_size bytes — ownership moves to
+ * the caller (DmlcTpuCacheArenaRelease).  When it is already raw, *arena =
+ * NULL and *out_size = size: the caller keeps its own buffer.  -1 on
+ * corrupt payloads (bad codec id, length contradiction, decode failure). */
+int DmlcTpuBinnedBlockDecode(const void* payload, uint64_t size, void** arena,
+                             uint64_t* out_size);
 
 /* ---- telemetry (dmlctpu/telemetry.h) ------------------------------------- */
 /* *out = 1 when telemetry was compiled in (DMLCTPU_TELEMETRY=1), else 0.

@@ -415,6 +415,16 @@ DMLCTPU_STAGE_COUNTER(CacheRebuilds, "cache.rebuilds")
 // is engaged (~0 when it is; ~1+ when every block goes through a decode
 // buffer); stall_attribution surfaces it as the cache stage's copy_ratio.
 DMLCTPU_STAGE_COUNTER(CacheBytesCopied, "cache.bytes_copied")
+// Block codec (block_codec.h, doc/binned_cache.md "Block codec"): counted
+// at decode — compressed bytes in, decompressed bytes out, wall time spent
+// decoding.  bytes_out / bytes_in is the observed compression ratio on
+// every block that actually moved (local stream reads, mmap'd compressed
+// records, dataservice client frames); decode_us lands inside the repack
+// stage's busy window, so stall_attribution shows decode as cache work,
+// not a new stall.
+DMLCTPU_STAGE_COUNTER(CacheCodecBytesIn, "cache.codec.bytes_in")
+DMLCTPU_STAGE_COUNTER(CacheCodecBytesOut, "cache.codec.bytes_out")
+DMLCTPU_STAGE_COUNTER(CacheCodecDecodeUs, "cache.codec.decode_us")
 // Which read backend each reader open chose (mmap/O_DIRECT-arena vs the
 // streaming fallback) — a fleet of stream_opens where mmap was expected is
 // a misconfiguration, not a perf mystery.

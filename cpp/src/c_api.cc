@@ -701,6 +701,83 @@ int DmlcTpuCacheArenaRelease(void* ptr) {
   });
 }
 
+int DmlcTpuBinnedCacheWriterSetCodec(DmlcTpuBinnedCacheWriterHandle handle,
+                                     int codec) {
+  return Guard([&] {
+    static_cast<BinnedCacheWriterCtx*>(handle)->writer->SetCodec(codec);
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderTakeArena(DmlcTpuBinnedCacheReaderHandle handle,
+                                      void** out) {
+  return Guard([&] {
+    *out =
+        static_cast<BinnedCacheReaderCtx*>(handle)->reader->TakeDecodeArena();
+    return 0;
+  });
+}
+
+int DmlcTpuBinnedCacheReaderSetDecode(DmlcTpuBinnedCacheReaderHandle handle,
+                                      int decode) {
+  return Guard([&] {
+    static_cast<BinnedCacheReaderCtx*>(handle)->reader->SetDecode(decode != 0);
+    return 0;
+  });
+}
+
+int DmlcTpuBlockCodecEnabled(void) {
+  return dmlctpu::codec::Enabled() ? 1 : 0;
+}
+
+int DmlcTpuBlockCodecFromName(const char* name) {
+  return dmlctpu::codec::FromName(name);
+}
+
+const char* DmlcTpuBlockCodecName(int codec) {
+  return dmlctpu::codec::Name(codec);
+}
+
+uint64_t DmlcTpuBlockCodecBound(uint64_t n) {
+  return static_cast<uint64_t>(
+      dmlctpu::codec::CompressBound(static_cast<size_t>(n)));
+}
+
+int64_t DmlcTpuBlockCodecEncode(int codec, const void* in, uint64_t n,
+                                void* out, uint64_t cap) {
+  int64_t got = -1;
+  int rc = Guard([&] {
+    got = static_cast<int64_t>(dmlctpu::codec::Compress(
+        codec, static_cast<const uint8_t*>(in), static_cast<size_t>(n),
+        static_cast<uint8_t*>(out), static_cast<size_t>(cap)));
+    return 0;
+  });
+  return rc == 0 ? got : -1;
+}
+
+int64_t DmlcTpuBlockCodecDecode(int codec, const void* in, uint64_t n,
+                                void* out, uint64_t raw_len) {
+  int64_t got = -1;
+  int rc = Guard([&] {
+    got = dmlctpu::codec::Decompress(
+              codec, static_cast<const uint8_t*>(in), static_cast<size_t>(n),
+              static_cast<uint8_t*>(out), static_cast<size_t>(raw_len))
+              ? 0
+              : -1;
+    return 0;
+  });
+  return rc == 0 ? got : -1;
+}
+
+int DmlcTpuBinnedBlockDecode(const void* payload, uint64_t size, void** arena,
+                             uint64_t* out_size) {
+  return Guard([&] {
+    dmlctpu::data::BinnedCacheReader::DecodePayloadToArena(
+        static_cast<const char*>(payload), size, arena, out_size);
+    return 0;
+  });
+}
+
 int DmlcTpuParserCreate(const char* uri, unsigned part, unsigned num_parts,
                         const char* format, DmlcTpuParserHandle* out) {
   return Guard([&] {

@@ -18,13 +18,18 @@
 // torn cache.  total_bytes is additionally validated against the file size
 // on disk, catching truncated copies of an intact build.
 //
-// Block payload bytes are opaque here (the Python layer packs
-// label/weight/row_ptr/index/ebin/emask columns — see
-// dmlc_core_tpu/data/binned_cache.py); this layer owns framing, the part
-// map {part id -> first-record offset, record/row/nnz counts} that lets a
-// ShardBoard thief seek straight to a stolen part, RecordIO recover-mode
-// resync past corrupt spans, and the cache.build_bytes / cache.hit_bytes
-// telemetry.
+// Block payloads start with a BinnedBlockHeader followed by the packed
+// label/weight/row_ptr/index/ebin/emask columns (the Python layer packs
+// them — see dmlc_core_tpu/data/binned_cache.py); the header's cflag is
+// the per-record compression flag (block_codec.h): cflag 0 records carry
+// the columns verbatim (the pre-codec layout, still served as zero-copy
+// borrowed views), non-zero records carry [u64 raw_cols_len][u64 digest]
+// [compressed columns] and are decoded into recycled CacheArenaPool
+// arenas on read, after the digest check (LZ4 alone has no checksum).
+// This layer owns framing, the part map {part id -> first-record offset,
+// record/row/nnz counts} that lets a ShardBoard thief seek straight to a
+// stolen part, RecordIO recover-mode resync past corrupt spans, and the
+// cache.build_bytes / cache.hit_bytes / cache.codec.* telemetry.
 #ifndef DMLCTPU_SRC_DATA_BINNED_CACHE_H_
 #define DMLCTPU_SRC_DATA_BINNED_CACHE_H_
 
@@ -55,6 +60,8 @@
 #include "dmlctpu/recordio.h"
 #include "dmlctpu/stream.h"
 #include "dmlctpu/telemetry.h"
+#include "dmlctpu/timer.h"
+#include "./block_codec.h"
 
 namespace dmlctpu {
 namespace data {
@@ -76,9 +83,41 @@ struct BinnedBlockHeader {
   uint64_t num_rows = 0;
   uint64_t nnz = 0;
   uint32_t flags = 0;  // bit 0: qid column present
-  uint32_t pad0 = 0;
+  // per-record compression flag (block_codec.h codec id).  0 = raw: the
+  // columns follow the header verbatim, exactly the pre-codec layout, so
+  // caches written before this field existed (it was zero padding) read
+  // unchanged and keep the zero-copy borrowed-view path.  Non-zero: the
+  // header stays uncompressed, then [u64 raw_cols_len][u64 payload digest]
+  // [compressed columns].
+  uint32_t cflag = 0;
 };
 static_assert(sizeof(BinnedBlockHeader) == 32, "block header layout");
+
+/*! \brief exact byte size of the column arrays a block header describes
+ *  (the decode target size for compressed records; also validates the
+ *  stored raw_cols_len against a corrupted length field). */
+inline uint64_t BinnedBlockColumnBytes(const BinnedBlockHeader& hdr) {
+  uint64_t rows = hdr.num_rows, nnz = hdr.nnz;
+  return rows * 4 * 2 + (rows + 1) * 4 +
+         ((hdr.flags & 1u) != 0 ? rows * 4 : 0) + nnz * 4 + nnz +
+         (nnz + 7) / 8;
+}
+
+/*! \brief FNV-1a 64 over a compressed record's stored payload.  LZ4 has no
+ *  integrity check of its own, and a flipped literal byte decodes
+ *  "successfully" into a torn column stream — so every compressed record
+ *  stores this digest and the reader verifies it before decompressing,
+ *  turning any storage corruption into the counted rebuild/skip path
+ *  instead of silently wrong batches.  Raw (cflag 0) records are untouched:
+ *  their zero-copy path stays digest-free, exactly the pre-codec contract. */
+inline uint64_t BinnedBlockPayloadDigest(const uint8_t* p, uint64_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (uint64_t i = 0; i < n; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
 
 /*! \brief exact replica of QuantileBinner.transform_entries (gbdt.py): a
  *  fixed-round binary search equal to searchsorted(cuts, v, side="right"),
@@ -272,31 +311,62 @@ class BinnedCacheWriter {
     stream_.reset();
   }
 
+  /*! \brief Select the block codec (block_codec.h id) for subsequent
+   *  WriteBlock/WriteRawBlock calls.  codec::kRaw (the default) writes the
+   *  pre-codec layout byte-for-byte. */
+  void SetCodec(int codec) {
+    TCHECK(codec == codec::kRaw || *codec::Name(codec) != 'u')
+        << "unknown block codec id " << codec;
+    codec_ = codec;
+  }
+
   /*! \brief Append one block for virtual part \p part_id.
    *  \p rows / \p nnz are accounting only (surfaced in the part map so
    *  readers can validate per-part completeness without decoding blocks).
+   *
+   *  With a codec selected, \p data must start with a BinnedBlockHeader
+   *  (WriteRawBlock and the Python packer both guarantee this): the
+   *  columns after the header are compressed, the header's cflag records
+   *  the codec, and an incompressible block silently stays raw (cflag 0)
+   *  so the bit-identity contract never depends on compressibility.
    */
   void WriteBlock(uint32_t part_id, uint64_t rows, uint64_t nnz,
                   const void* data, size_t size) {
     TCHECK(stream_ != nullptr) << "BinnedCacheWriter already closed";
-    DMLCTPU_FAULT_POINT(fp_short, "cache.write.short");
-    if (fp_short.Fire() != fault::Mode::kNone) {
-      // simulate a crash mid-frame: half the payload lands with no record
-      // framing completed, then the handle dies with the header sentinel
-      // still in place — exactly what a power cut mid-build leaves behind
-      stream_->Write(data, size / 2);
-      stream_.reset();
-      throw Error("injected cache.write.short: cache build truncated at "
-                  "part " + std::to_string(part_id));
+    if (codec_ != codec::kRaw && size > sizeof(BinnedBlockHeader)) {
+      const size_t hdr_bytes = sizeof(BinnedBlockHeader);
+      const uint8_t* cols = static_cast<const uint8_t*>(data) + hdr_bytes;
+      const uint64_t cols_len = size - hdr_bytes;
+      const size_t bound = codec::CompressBound(cols_len);
+      comp_buf_.resize(hdr_bytes + 16 + bound);
+      size_t c = codec::Compress(
+          codec_, cols, cols_len,
+          reinterpret_cast<uint8_t*>(&comp_buf_[hdr_bytes + 16]), bound);
+      if (c != 0) {
+        BinnedBlockHeader hdr;
+        std::memcpy(&hdr, data, hdr_bytes);
+        hdr.cflag = static_cast<uint32_t>(codec_);
+        std::memcpy(&comp_buf_[0], &hdr, hdr_bytes);
+        std::memcpy(&comp_buf_[hdr_bytes], &cols_len, 8);
+        // digest over the pristine compressed bytes: anything that flips a
+        // bit between here and the reader (the fault below included) fails
+        // the check instead of decoding into a torn stream
+        uint64_t digest = BinnedBlockPayloadDigest(
+            reinterpret_cast<const uint8_t*>(&comp_buf_[hdr_bytes + 16]), c);
+        std::memcpy(&comp_buf_[hdr_bytes + 8], &digest, 8);
+        DMLCTPU_FAULT_POINT(fp_corrupt, "cache.codec.corrupt");
+        if (fp_corrupt.Fire() != fault::Mode::kNone) {
+          // seeded bit-flip AFTER compression: framing and CRC-free record
+          // walk stay intact, only the codec payload decodes wrong — the
+          // reader must degrade, never serve a torn stream
+          comp_buf_[hdr_bytes + 16 + c / 2] ^= 0x20;
+        }
+        WriteFramed(part_id, rows, nnz, comp_buf_.data(),
+                    hdr_bytes + 16 + c);
+        return;
+      }
     }
-    uint64_t offset = cursor_;
-    writer_->WriteRecord(data, size);  // counting_ advances cursor_
-    auto& e = parts_[part_id];
-    if (e.records == 0) e.offset = offset;
-    e.records += 1;
-    e.rows += rows;
-    e.nnz += nnz;
-    telemetry::stage::CacheBuildBytes().Add(static_cast<int64_t>(size));
+    WriteFramed(part_id, rows, nnz, data, size);
   }
 
   /*! \brief Install the finalized quantile cuts (f32 [num_features,
@@ -406,6 +476,29 @@ class BinnedCacheWriter {
     uint64_t nnz = 0;
   };
 
+  /*! \brief frame one (possibly codec-packed) record and account it */
+  void WriteFramed(uint32_t part_id, uint64_t rows, uint64_t nnz,
+                   const void* data, size_t size) {
+    DMLCTPU_FAULT_POINT(fp_short, "cache.write.short");
+    if (fp_short.Fire() != fault::Mode::kNone) {
+      // simulate a crash mid-frame: half the payload lands with no record
+      // framing completed, then the handle dies with the header sentinel
+      // still in place — exactly what a power cut mid-build leaves behind
+      stream_->Write(data, size / 2);
+      stream_.reset();
+      throw Error("injected cache.write.short: cache build truncated at "
+                  "part " + std::to_string(part_id));
+    }
+    uint64_t offset = cursor_;
+    writer_->WriteRecord(data, size);  // counting_ advances cursor_
+    auto& e = parts_[part_id];
+    if (e.records == 0) e.offset = offset;
+    e.records += 1;
+    e.rows += rows;
+    e.nnz += nnz;
+    telemetry::stage::CacheBuildBytes().Add(static_cast<int64_t>(size));
+  }
+
   std::string uri_;
   std::unique_ptr<Stream> stream_;
   std::unique_ptr<ByteCountingStream> counting_;
@@ -416,6 +509,8 @@ class BinnedCacheWriter {
   uint64_t num_features_ = 0;
   uint32_t num_cuts_ = 0;
   std::string pack_buf_;  // reused across WriteRawBlock calls
+  std::string comp_buf_;  // reused codec output buffer
+  int codec_ = codec::kRaw;
 };
 
 /*! \brief Reader/validator for the binned epoch cache.
@@ -501,6 +596,9 @@ class BinnedCacheReader {
     if (map_base_ != nullptr) ::munmap(map_base_, total_bytes_);
 #endif
     if (arena_ != nullptr) CacheArenaPool::Get()->Release(arena_);
+    if (decode_arena_ != nullptr) {
+      CacheArenaPool::Get()->Release(decode_arena_);
+    }
   }
 
   BinnedCacheReader(const BinnedCacheReader&) = delete;
@@ -544,18 +642,62 @@ class BinnedCacheReader {
 
   /*! \brief Next block as a borrowed view — the zero-copy hit path.
    *
-   *  On the mmap/arena backends a contiguous (cflag 0) record yields
-   *  *borrowed=1: \p *data points straight into the mapping/arena, valid
-   *  until the reader is destroyed, and NO bytes move.  A record that was
+   *  On the mmap/arena backends a contiguous raw record yields *borrowed=1:
+   *  \p *data points straight into the mapping/arena, valid until the
+   *  reader is destroyed, and NO bytes move.  A record that was
    *  magic-split on write is reassembled into an internal buffer
    *  (*borrowed=0, valid until the next call, counted in
    *  cache.bytes_copied) — rare: only payloads containing the aligned
-   *  RecordIO magic word.  On the streaming backend every block lands in
-   *  the internal buffer (*borrowed=0, one counted copy).  The view
-   *  cursor is strict: any framing damage is fatal, never resynced —
-   *  recover-mode readers always take the streaming backend.
+   *  RecordIO magic word.  On the streaming backend every raw block lands
+   *  in the internal buffer (*borrowed=0, one counted copy).
+   *
+   *  A compressed record (header cflag != 0) is decoded into a recycled
+   *  CacheArenaPool arena and served with *borrowed=1 and a cleared cflag:
+   *  the view is valid until the NEXT call unless the caller transfers the
+   *  arena via TakeDecodeArena() (the Python repack loop does, pinning
+   *  each decoded block by a release finalizer, so decode of block N+1
+   *  overlaps repack/H2D of block N while the pool ping-pongs two
+   *  buffers).  Decode corruption throws in strict mode; in recover mode
+   *  the record is skipped and counted like a corrupt span.
+   *
+   *  The zero-copy view cursor is strict about framing: any framing
+   *  damage is fatal, never resynced — recover-mode readers always take
+   *  the streaming backend.
    */
   bool NextBlockView(const char** data, uint64_t* size, int* borrowed) {
+    if (decode_arena_ != nullptr) {
+      // previous decoded view was not taken over by the caller: recycle it
+      CacheArenaPool::Get()->Release(decode_arena_);
+      decode_arena_ = nullptr;
+    }
+    for (;;) {
+      if (!NextRecordView(data, size, borrowed)) return false;
+      if (!decode_) return true;  // stored-bytes mode: records verbatim
+      int r = DecodeView(data, size, borrowed);
+      if (r == 1) return true;
+      // r == 0: corrupt compressed record skipped (recover mode) — resync
+      // to the next record and keep serving
+    }
+  }
+
+  /*! \brief toggle inline decode (default on).  Off, NextBlockView /
+   *  NextBlock return records exactly as stored — compressed payloads
+   *  included — which is what the staging dataservice worker serves: wire
+   *  frames ship the stored bytes verbatim and the CLIENT decodes, so the
+   *  bandwidth win of compression survives the hop. */
+  void SetDecode(bool decode) { decode_ = decode; }
+
+  /*! \brief arena backing the last decoded view, ownership transferred to
+   *  the caller (release via CacheArenaPool); nullptr when the last view
+   *  was raw. */
+  void* TakeDecodeArena() {
+    void* a = decode_arena_;
+    decode_arena_ = nullptr;
+    return a;
+  }
+
+ private:
+  bool NextRecordView(const char** data, uint64_t* size, int* borrowed) {
     if (!valid_) return false;
     if (backend_ == CacheReadBackend::kStream) {
       if (fi_->Tell() >= part_map_offset_) return false;
@@ -614,20 +756,25 @@ class BinnedCacheReader {
     return true;
   }
 
+ public:
   /*! \brief Next block record; false at the part-map boundary / EOF.
-   *  In recover mode corrupt spans are resynced past (counted in
-   *  corrupt_skipped + record.corrupt_skipped) and the caller's per-part
-   *  record accounting detects the loss.  Always copies into \p out
-   *  (counted in cache.bytes_copied) — the zero-copy hit path is
-   *  NextBlockView. */
+   *  In recover mode corrupt spans — framing damage AND compressed records
+   *  that fail decode — are resynced past (counted in corrupt_skipped +
+   *  record.corrupt_skipped) and the caller's per-part record accounting
+   *  detects the loss.  Always copies into \p out (counted in
+   *  cache.bytes_copied) — the zero-copy hit path is NextBlockView. */
   bool NextBlock(std::string* out) {
     if (backend_ == CacheReadBackend::kStream) {
-      if (!valid_ || fi_->Tell() >= part_map_offset_) return false;
-      if (!reader_->NextRecord(out)) return false;
-      telemetry::stage::CacheBytesCopied().Add(
-          static_cast<int64_t>(out->size()));
-      telemetry::stage::CacheHitBytes().Add(static_cast<int64_t>(out->size()));
-      return true;
+      for (;;) {
+        if (!valid_ || fi_->Tell() >= part_map_offset_) return false;
+        if (!reader_->NextRecord(out)) return false;
+        telemetry::stage::CacheBytesCopied().Add(
+            static_cast<int64_t>(out->size()));
+        telemetry::stage::CacheHitBytes().Add(
+            static_cast<int64_t>(out->size()));
+        if (!decode_) return true;  // stored-bytes mode
+        if (DecodeString(out) == 1) return true;
+      }
     }
     const char* data = nullptr;
     uint64_t size = 0;
@@ -639,11 +786,182 @@ class BinnedCacheReader {
   }
 
   uint64_t corrupt_skipped() const {
-    return reader_ != nullptr ? reader_->corrupt_skipped() : 0;
+    return (reader_ != nullptr ? reader_->corrupt_skipped() : 0) +
+           decode_corrupt_skipped_;
+  }
+
+  /*! \brief Decode one maybe-compressed block record payload into \p out
+   *  (header with cflag cleared + raw columns).  Returns 0 and leaves
+   *  \p out empty when the payload is already raw — the caller keeps its
+   *  buffer and no bytes move.  Throws on corruption.  Static: the
+   *  dataservice client uses it on wire frames without a reader. */
+  static bool DecodePayload(const char* data, uint64_t size,
+                            std::string* out) {
+    BinnedBlockHeader hdr;
+    const uint8_t* comp = nullptr;
+    uint64_t comp_len = 0, raw_cols = 0;
+    std::string err;
+    int cid = ParseCompressed(data, size, &hdr, &comp, &comp_len, &raw_cols,
+                              &err);
+    if (cid < 0) throw Error("corrupt binned block: " + err);
+    if (cid == 0) {
+      out->clear();
+      return false;
+    }
+    Stopwatch sw;
+    out->resize(sizeof(hdr) + raw_cols);
+    hdr.cflag = 0;
+    std::memcpy(&(*out)[0], &hdr, sizeof(hdr));
+    if (!codec::Decompress(cid, comp, comp_len,
+                           reinterpret_cast<uint8_t*>(&(*out)[sizeof(hdr)]),
+                           raw_cols)) {
+      throw Error(std::string("corrupt binned block: ") + codec::Name(cid) +
+                  " decode failed");
+    }
+    CountDecode(comp_len, raw_cols, sw);
+    return true;
+  }
+
+  /*! \brief arena twin of DecodePayload: decodes a compressed record
+   *  payload into a pooled CacheArenaPool arena (ownership to the caller —
+   *  release it back to the pool).  Returns false with *arena = nullptr
+   *  when the payload is already raw: the caller keeps its own buffer.
+   *  Throws on corruption. */
+  static bool DecodePayloadToArena(const char* data, uint64_t size,
+                                   void** arena, uint64_t* out_size) {
+    BinnedBlockHeader hdr;
+    const uint8_t* comp = nullptr;
+    uint64_t comp_len = 0, raw_cols = 0;
+    std::string err;
+    int cid = ParseCompressed(data, size, &hdr, &comp, &comp_len, &raw_cols,
+                              &err);
+    if (cid < 0) throw Error("corrupt binned block: " + err);
+    if (cid == 0) {
+      *arena = nullptr;
+      *out_size = size;
+      return false;
+    }
+    Stopwatch sw;
+    const uint64_t out_bytes = sizeof(hdr) + raw_cols;
+    char* a = static_cast<char*>(CacheArenaPool::Get()->Acquire(out_bytes));
+    hdr.cflag = 0;
+    std::memcpy(a, &hdr, sizeof(hdr));
+    if (!codec::Decompress(cid, comp, comp_len,
+                           reinterpret_cast<uint8_t*>(a) + sizeof(hdr),
+                           raw_cols)) {
+      CacheArenaPool::Get()->Release(a);
+      throw Error(std::string("corrupt binned block: ") + codec::Name(cid) +
+                  " decode failed" +
+                  (codec::Enabled() ? "" : " (built with DMLCTPU_CODEC=0)"));
+    }
+    CountDecode(comp_len, raw_cols, sw);
+    *arena = a;
+    *out_size = out_bytes;
+    return true;
   }
 
  private:
   static uint32_t RoundUp4(uint32_t n) { return (n + 3u) & ~3u; }
+
+  /*! \brief classify one record payload.  Returns the codec id (>0) with
+   *  \p comp/\p comp_len/\p raw_cols filled, 0 for a raw record, or -1
+   *  with \p err set for a malformed compressed record (short prefix,
+   *  unknown codec id, or a raw_cols_len that contradicts the header's
+   *  row/nnz accounting — the guard that keeps a corrupted length field
+   *  from driving an oversized arena or an overread). */
+  static int ParseCompressed(const char* data, uint64_t size,
+                             BinnedBlockHeader* hdr, const uint8_t** comp,
+                             uint64_t* comp_len, uint64_t* raw_cols,
+                             std::string* err) {
+    if (size < sizeof(BinnedBlockHeader)) {
+      // shorter than a block header: necessarily a pre-codec opaque record
+      hdr->cflag = 0;
+      return 0;
+    }
+    std::memcpy(hdr, data, sizeof(BinnedBlockHeader));
+    if (hdr->cflag == 0) return 0;
+    int cid = static_cast<int>(hdr->cflag);
+    if (std::strcmp(codec::Name(cid), "unknown") == 0) {
+      *err = "unknown codec id " + std::to_string(cid);
+      return -1;
+    }
+    if (size < sizeof(BinnedBlockHeader) + 16) {
+      *err = "compressed record truncated before raw_cols_len/digest";
+      return -1;
+    }
+    uint64_t stored = 0, digest = 0;
+    std::memcpy(&stored, data + sizeof(BinnedBlockHeader), 8);
+    std::memcpy(&digest, data + sizeof(BinnedBlockHeader) + 8, 8);
+    if (stored != BinnedBlockColumnBytes(*hdr)) {
+      *err = "raw_cols_len " + std::to_string(stored) +
+             " contradicts the block header (expected " +
+             std::to_string(BinnedBlockColumnBytes(*hdr)) + ")";
+      return -1;
+    }
+    *raw_cols = stored;
+    *comp = reinterpret_cast<const uint8_t*>(data) +
+            sizeof(BinnedBlockHeader) + 16;
+    *comp_len = size - sizeof(BinnedBlockHeader) - 16;
+    // verified BEFORE decompression: LZ4 has no checksum of its own, and a
+    // flipped literal byte would otherwise decode into silently wrong bins
+    if (BinnedBlockPayloadDigest(*comp, *comp_len) != digest) {
+      *err = std::string(codec::Name(cid)) + " payload digest mismatch";
+      return -1;
+    }
+    return cid;
+  }
+
+  static void CountDecode(uint64_t comp_len, uint64_t raw_cols,
+                          const Stopwatch& sw) {
+    telemetry::stage::CacheCodecBytesIn().Add(
+        static_cast<int64_t>(comp_len));
+    telemetry::stage::CacheCodecBytesOut().Add(
+        static_cast<int64_t>(raw_cols));
+    telemetry::stage::CacheCodecDecodeUs().Add(
+        static_cast<int64_t>(sw.Elapsed() * 1e6));
+  }
+
+  /*! \brief decode step behind NextBlockView: raw records pass through
+   *  untouched (1); compressed records decode into a pooled arena and the
+   *  view is repointed at it (1); a corrupt compressed record throws in
+   *  strict mode or is counted + skipped (0) in recover mode. */
+  int DecodeView(const char** data, uint64_t* size, int* borrowed) {
+    try {
+      void* a = nullptr;
+      uint64_t n = 0;
+      if (!DecodePayloadToArena(*data, *size, &a, &n)) return 1;
+      decode_arena_ = static_cast<char*>(a);
+      *data = decode_arena_;
+      *size = n;
+      *borrowed = 1;
+      return 1;
+    } catch (const Error& e) {
+      if (!recover_) {
+        throw Error(std::string(e.what()) + " in " + uri_);
+      }
+      decode_corrupt_skipped_ += 1;
+      telemetry::stage::RecordCorruptSkipped().Add(1);
+      return 0;
+    }
+  }
+
+  /*! \brief string-payload twin of DecodeView for the NextBlock copy path */
+  int DecodeString(std::string* out) {
+    try {
+      std::string decoded;
+      if (DecodePayload(out->data(), out->size(), &decoded)) {
+        out->swap(decoded);
+      }
+      return 1;
+    } catch (const Error& e) {
+      if (!recover_) {
+        throw Error(std::string(e.what()) + " in " + uri_);
+      }
+      decode_corrupt_skipped_ += 1;
+      telemetry::stage::RecordCorruptSkipped().Add(1);
+      return 0;
+    }
+  }
 
   /*! \brief strict 8-byte record-head read at pos_ (memcpy: no alignment
    *  assumption, so pre-padding legacy caches still map fine) */
@@ -773,6 +1091,12 @@ class BinnedCacheReader {
   char* arena_ = nullptr;
   uint64_t pos_ = 0;
   std::string view_buf_;
+  // last decoded block's arena: recycled on the next call unless the
+  // caller took ownership via TakeDecodeArena()
+  char* decode_arena_ = nullptr;
+  uint64_t decode_corrupt_skipped_ = 0;
+  // SetDecode(false) serves stored bytes verbatim (dataservice workers)
+  bool decode_ = true;
 };
 
 }  // namespace data

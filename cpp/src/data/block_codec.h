@@ -1,0 +1,351 @@
+// block_codec.h — optional per-block compression for the binned epoch cache
+// (doc/binned_cache.md "Block codec").
+//
+// Binned uint8 ebin columns and near-sorted i32 CSR streams compress 3-6x
+// once bit-planes are regrouped, which is the whole point: cold builds,
+// disk-resident multi-spec caches and dataservice blocks on the 0xff9a wire
+// are NIC/disk bound, not CPU bound (ROADMAP item 5).  The codec here is a
+// from-scratch, dependency-free pair of filters:
+//
+//   * bitshuffle: within 4 KiB blocks, bit j of every byte is gathered into
+//     a contiguous bit-plane (an 8x8 bit-matrix transpose per 8 bytes, so
+//     shuffle == unshuffle up to the scatter/gather direction).  Bin codes
+//     use only the low log2(num_bins) bits, so the high planes become runs
+//     of zeros that LZ4 folds into a handful of matches.
+//   * LZ4 block format: greedy hash-table encoder + a bounds-checked
+//     decoder.  The decoder never reads outside [in, in+n) nor writes
+//     outside [out, out+raw_len) — a truncated or bit-flipped payload
+//     returns false instead of overreading (the no-SIGBUS contract the
+//     reader's truncation checks extend to compressed records).
+//
+// Codec ids are on-disk format (the per-record cflag in BinnedBlockHeader):
+// 0 = raw, 1 = bitshuffle+LZ4, 2 = reserved for zstd (optional per the
+// format spec; wire it here when a vendored zstd lands — no new hard deps).
+//
+// Compiling with -DDMLCTPU_CODEC=0 swaps every function for an inline stub
+// (same surface, checked by scripts/analyze/stubparity.py): Compress never
+// compresses (the writer falls back to raw records) and Decompress refuses,
+// so a compiled-out build still reads every raw cache and fails loudly —
+// not silently wrong — on a compressed one.
+#ifndef DMLCTPU_SRC_DATA_BLOCK_CODEC_H_
+#define DMLCTPU_SRC_DATA_BLOCK_CODEC_H_
+
+#ifndef DMLCTPU_CODEC
+#define DMLCTPU_CODEC 1
+#endif
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace dmlctpu {
+namespace codec {
+
+constexpr int kRaw = 0;   // identity: record payload is the packed columns
+constexpr int kLz4 = 1;   // bitshuffle(1-byte planes) then LZ4 block format
+constexpr int kZstdReserved = 2;  // reserved on-disk id (not built in)
+
+#if DMLCTPU_CODEC
+
+namespace detail {
+
+// 8x8 bit-matrix transpose (Hacker's Delight 7-3): an involution, so the
+// same kernel serves shuffle and unshuffle.
+inline uint64_t Transpose8x8(uint64_t x) {
+  uint64_t t;
+  t = (x ^ (x >> 7)) & 0x00AA00AA00AA00AAull;
+  x = x ^ t ^ (t << 7);
+  t = (x ^ (x >> 14)) & 0x0000CCCC0000CCCCull;
+  x = x ^ t ^ (t << 14);
+  t = (x ^ (x >> 28)) & 0x00000000F0F0F0F0ull;
+  x = x ^ t ^ (t << 28);
+  return x;
+}
+
+constexpr size_t kShuffleBlock = 4096;  // bytes per bit-plane regroup block
+
+inline void BitShuffle(const uint8_t* in, uint8_t* out, size_t n) {
+  size_t done = 0;
+  while (n - done >= 8) {
+    size_t block = n - done;
+    if (block > kShuffleBlock) block = kShuffleBlock;
+    block &= ~static_cast<size_t>(7);
+    const size_t nvec = block / 8;
+    for (size_t i = 0; i < nvec; ++i) {
+      uint64_t x;
+      std::memcpy(&x, in + done + 8 * i, 8);
+      x = Transpose8x8(x);
+      for (int j = 0; j < 8; ++j) {
+        out[done + static_cast<size_t>(j) * nvec + i] =
+            static_cast<uint8_t>(x >> (8 * j));
+      }
+    }
+    done += block;
+  }
+  if (done < n) std::memcpy(out + done, in + done, n - done);  // tail verbatim
+}
+
+inline void BitUnshuffle(const uint8_t* in, uint8_t* out, size_t n) {
+  size_t done = 0;
+  while (n - done >= 8) {
+    size_t block = n - done;
+    if (block > kShuffleBlock) block = kShuffleBlock;
+    block &= ~static_cast<size_t>(7);
+    const size_t nvec = block / 8;
+    for (size_t i = 0; i < nvec; ++i) {
+      uint64_t x = 0;
+      for (int j = 0; j < 8; ++j) {
+        x |= static_cast<uint64_t>(
+                 in[done + static_cast<size_t>(j) * nvec + i])
+             << (8 * j);
+      }
+      x = Transpose8x8(x);
+      std::memcpy(out + done + 8 * i, &x, 8);
+    }
+    done += block;
+  }
+  if (done < n) std::memcpy(out + done, in + done, n - done);
+}
+
+inline uint32_t Lz4Read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t Lz4Hash(uint32_t v) { return (v * 2654435761u) >> 19; }
+
+// Greedy LZ4 block-format encoder: 13-bit hash table of 4-byte sequences,
+// offsets <= 64 KiB, minmatch 4.  Honors the spec's end-of-block rules (the
+// last 5 bytes are literals; no match starts within the last 12 bytes).
+// Returns the compressed size, or 0 when the output would not fit in cap —
+// the caller stores the block raw in that case.
+inline size_t Lz4Compress(const uint8_t* src, size_t n, uint8_t* dst,
+                          size_t cap) {
+  if (n == 0 || n >= (1u << 29)) return 0;  // RecordIO length ceiling anyway
+  uint32_t table[1u << 13];
+  std::memset(table, 0, sizeof(table));  // entries hold offset+1; 0 = empty
+  const uint8_t* ip = src;
+  const uint8_t* anchor = src;
+  const uint8_t* const iend = src + n;
+  const uint8_t* const mflimit = n > 12 ? iend - 12 : src;
+  const uint8_t* const matchlimit = n > 5 ? iend - 5 : src;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + cap;
+  while (ip < mflimit) {
+    const uint32_t h = Lz4Hash(Lz4Read32(ip));
+    const uint8_t* ref = src + table[h] - 1;
+    const bool hit = table[h] != 0 &&
+                     static_cast<size_t>(ip - ref) <= 65535 &&
+                     Lz4Read32(ref) == Lz4Read32(ip);
+    table[h] = static_cast<uint32_t>(ip - src) + 1;
+    if (!hit) {
+      ++ip;
+      continue;
+    }
+    size_t mlen = 4;
+    while (ip + mlen < matchlimit && ref[mlen] == ip[mlen]) ++mlen;
+    const size_t lit = static_cast<size_t>(ip - anchor);
+    // worst-case sequence size: token + length extensions + literals + offset
+    if (op + 1 + lit / 255 + 1 + lit + 2 + mlen / 255 + 1 > oend) return 0;
+    uint8_t* const token = op++;
+    if (lit >= 15) {
+      *token = 0xF0;
+      size_t l = lit - 15;
+      while (l >= 255) {
+        *op++ = 255;
+        l -= 255;
+      }
+      *op++ = static_cast<uint8_t>(l);
+    } else {
+      *token = static_cast<uint8_t>(lit << 4);
+    }
+    std::memcpy(op, anchor, lit);
+    op += lit;
+    const uint32_t off = static_cast<uint32_t>(ip - ref);
+    *op++ = static_cast<uint8_t>(off & 0xff);
+    *op++ = static_cast<uint8_t>(off >> 8);
+    size_t m = mlen - 4;
+    if (m >= 15) {
+      *token |= 0x0F;
+      m -= 15;
+      while (m >= 255) {
+        *op++ = 255;
+        m -= 255;
+      }
+      *op++ = static_cast<uint8_t>(m);
+    } else {
+      *token |= static_cast<uint8_t>(m);
+    }
+    ip += mlen;
+    anchor = ip;
+  }
+  const size_t lit = static_cast<size_t>(iend - anchor);
+  if (op + 1 + lit / 255 + 1 + lit > oend) return 0;
+  uint8_t* const token = op++;
+  if (lit >= 15) {
+    *token = 0xF0;
+    size_t l = lit - 15;
+    while (l >= 255) {
+      *op++ = 255;
+      l -= 255;
+    }
+    *op++ = static_cast<uint8_t>(l);
+  } else {
+    *token = static_cast<uint8_t>(lit << 4);
+  }
+  std::memcpy(op, anchor, lit);
+  op += lit;
+  return static_cast<size_t>(op - dst);
+}
+
+// Bounds-checked LZ4 block decoder: false on any malformed input (length
+// extension past the buffer, offset before the output start, output size
+// mismatch).  Never reads or writes out of bounds.
+inline bool Lz4Decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                          size_t raw_len) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + raw_len;
+  while (ip < iend) {
+    const uint8_t token = *ip++;
+    size_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return false;
+        b = *ip++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (lit > static_cast<size_t>(iend - ip) ||
+        lit > static_cast<size_t>(oend - op)) {
+      return false;
+    }
+    std::memcpy(op, ip, lit);
+    ip += lit;
+    op += lit;
+    if (ip >= iend) break;  // final sequence: literals only
+    if (iend - ip < 2) return false;
+    const size_t off = static_cast<size_t>(ip[0]) |
+                       (static_cast<size_t>(ip[1]) << 8);
+    ip += 2;
+    if (off == 0 || off > static_cast<size_t>(op - dst)) return false;
+    size_t mlen = (token & 0x0F) + 4;
+    if ((token & 0x0F) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return false;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    if (mlen > static_cast<size_t>(oend - op)) return false;
+    const uint8_t* match = op - off;
+    for (size_t i = 0; i < mlen; ++i) op[i] = match[i];  // overlap-safe
+    op += mlen;
+  }
+  return op == oend;
+}
+
+}  // namespace detail
+
+inline bool Enabled() { return true; }
+
+/*! \brief codec id for a knob spelling ("raw" / "lz4"); -1 when unknown or
+ *  not built in ("zstd" stays -1 until a vendored zstd lands) */
+inline int FromName(const char* name) {
+  if (name == nullptr || *name == '\0') return kRaw;
+  if (std::strcmp(name, "raw") == 0) return kRaw;
+  if (std::strcmp(name, "lz4") == 0) return kLz4;
+  return -1;
+}
+
+inline const char* Name(int codec) {
+  if (codec == kRaw) return "raw";
+  if (codec == kLz4) return "lz4";
+  if (codec == kZstdReserved) return "zstd";
+  return "unknown";
+}
+
+/*! \brief worst-case Compress output size for n input bytes */
+inline size_t CompressBound(size_t n) { return n + n / 255 + 16; }
+
+/*! \brief compress n bytes into out (cap >= CompressBound(n) to never
+ *  fail on expansion).  Returns the compressed size, or 0 when the input
+ *  is incompressible / the codec is unknown — the caller stores raw. */
+inline size_t Compress(int codec, const uint8_t* in, size_t n, uint8_t* out,
+                       size_t cap) {
+  if (codec != kLz4 || n == 0) return 0;
+  thread_local std::string shuffled;
+  shuffled.resize(n);
+  detail::BitShuffle(in, reinterpret_cast<uint8_t*>(&shuffled[0]), n);
+  const size_t c = detail::Lz4Compress(
+      reinterpret_cast<const uint8_t*>(shuffled.data()), n, out, cap);
+  if (c == 0 || c >= n) return 0;  // no win: keep the record raw (cflag 0)
+  return c;
+}
+
+/*! \brief decompress n bytes into exactly raw_len output bytes.  False on
+ *  any corruption/truncation — never reads past in+n or writes past
+ *  out+raw_len. */
+inline bool Decompress(int codec, const uint8_t* in, size_t n, uint8_t* out,
+                       size_t raw_len) {
+  if (codec != kLz4) return false;
+  if (raw_len == 0) return n == 0;
+  thread_local std::string shuffled;
+  shuffled.resize(raw_len);
+  if (!detail::Lz4Decompress(
+          in, n, reinterpret_cast<uint8_t*>(&shuffled[0]), raw_len)) {
+    return false;
+  }
+  detail::BitUnshuffle(reinterpret_cast<const uint8_t*>(shuffled.data()),
+                       out, raw_len);
+  return true;
+}
+
+#else
+
+inline bool Enabled() { return false; }
+
+inline int FromName(const char* name) {
+  if (name == nullptr || *name == '\0') return kRaw;
+  if (std::strcmp(name, "raw") == 0) return kRaw;
+  return -1;  // compression codecs are compiled out
+}
+
+inline const char* Name(int codec) {
+  if (codec == kRaw) return "raw";
+  if (codec == kLz4) return "lz4";
+  if (codec == kZstdReserved) return "zstd";
+  return "unknown";
+}
+
+inline size_t CompressBound(size_t n) { return n + n / 255 + 16; }
+
+inline size_t Compress(int codec, const uint8_t* in, size_t n, uint8_t* out,
+                       size_t cap) {
+  (void)codec;
+  (void)in;
+  (void)n;
+  (void)out;
+  (void)cap;
+  return 0;  // never compresses: every record lands raw (cflag 0)
+}
+
+inline bool Decompress(int codec, const uint8_t* in, size_t n, uint8_t* out,
+                       size_t raw_len) {
+  (void)codec;
+  (void)in;
+  (void)n;
+  (void)out;
+  (void)raw_len;
+  return false;  // a compressed record in a codec-less build is unreadable
+}
+
+#endif  // DMLCTPU_CODEC
+
+}  // namespace codec
+}  // namespace dmlctpu
+#endif  // DMLCTPU_SRC_DATA_BLOCK_CODEC_H_

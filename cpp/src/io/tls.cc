@@ -1,5 +1,6 @@
 #include "./tls.h"
 
+#include <arpa/inet.h>
 #include <dlfcn.h>
 
 #include <algorithm>
@@ -35,6 +36,7 @@ struct Api {
   int (*SSL_set_fd)(void*, int) = nullptr;
   long (*SSL_ctrl)(void*, int, long, void*) = nullptr;  // NOLINT
   int (*SSL_set1_host)(void*, const char*) = nullptr;
+  void* (*SSL_get0_param)(void*) = nullptr;
   int (*SSL_connect)(void*) = nullptr;
   int (*SSL_read)(void*, void*, int) = nullptr;
   int (*SSL_write)(void*, const void*, int) = nullptr;
@@ -43,6 +45,7 @@ struct Api {
   // libcrypto
   unsigned long (*ERR_get_error)() = nullptr;  // NOLINT
   void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;  // NOLINT
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*) = nullptr;
 };
 
 template <typename F>
@@ -57,10 +60,15 @@ const Api& GetApi() {
     // RTLD_LOCAL: all access goes through dlsym on these handles; promoting
     // OpenSSL symbols to global scope could cross-bind against another
     // OpenSSL copy in the host process (CPython's _ssl, other extensions)
+    // every symbol below is in both the 3.x and 1.1 stable APIs
+    // (TLS_client_method/SSL_set1_host appeared in 1.1.0), so 1.1 images
+    // are a safe fallback for the same self-declared ABI
     void* ssl = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
     if (ssl == nullptr) ssl = ::dlopen("libssl.so", RTLD_NOW | RTLD_LOCAL);
+    if (ssl == nullptr) ssl = ::dlopen("libssl.so.1.1", RTLD_NOW | RTLD_LOCAL);
     void* crypto = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
     if (crypto == nullptr) crypto = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+    if (crypto == nullptr) crypto = ::dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_LOCAL);
     if (ssl == nullptr || crypto == nullptr) return a;
     a.ok = Load(ssl, "TLS_client_method", &a.TLS_client_method) &&
            Load(ssl, "SSL_CTX_new", &a.SSL_CTX_new) &&
@@ -75,13 +83,16 @@ const Api& GetApi() {
            Load(ssl, "SSL_set_fd", &a.SSL_set_fd) &&
            Load(ssl, "SSL_ctrl", &a.SSL_ctrl) &&
            Load(ssl, "SSL_set1_host", &a.SSL_set1_host) &&
+           Load(ssl, "SSL_get0_param", &a.SSL_get0_param) &&
            Load(ssl, "SSL_connect", &a.SSL_connect) &&
            Load(ssl, "SSL_read", &a.SSL_read) &&
            Load(ssl, "SSL_write", &a.SSL_write) &&
            Load(ssl, "SSL_shutdown", &a.SSL_shutdown) &&
            Load(ssl, "SSL_get_error", &a.SSL_get_error) &&
            Load(crypto, "ERR_get_error", &a.ERR_get_error) &&
-           Load(crypto, "ERR_error_string_n", &a.ERR_error_string_n);
+           Load(crypto, "ERR_error_string_n", &a.ERR_error_string_n) &&
+           Load(crypto, "X509_VERIFY_PARAM_set1_ip_asc",
+                &a.X509_VERIFY_PARAM_set1_ip_asc);
     return a;
   }();
   return api;
@@ -102,8 +113,8 @@ std::string LastError() {
 void* ClientCtx() {
   static void* ctx = [] {
     const Api& a = GetApi();
-    TCHECK(a.ok) << "TLS: libssl.so.3/libcrypto.so.3 not loadable in this "
-                 << "environment";
+    TCHECK(a.ok) << "TLS: libssl/libcrypto (3.x or 1.1) not loadable in "
+                 << "this environment";
     void* c = a.SSL_CTX_new(a.TLS_client_method());
     TCHECK(c != nullptr) << "TLS: SSL_CTX_new failed: " << LastError();
     const char* verify = std::getenv("DMLCTPU_TLS_VERIFY");
@@ -137,11 +148,21 @@ Connection::Connection(int fd, const std::string& host) {
   // not run if the constructor throws)
   try {
     TCHECK_EQ(a.SSL_set_fd(ssl_, fd), 1) << "TLS: SSL_set_fd failed";
-    // SNI (SSL_set_tlsext_host_name is a macro over SSL_ctrl) + hostname
-    // verification binding
-    a.SSL_ctrl(ssl_, kCtrlSetTlsextHostname, kTlsextNametypeHostName,
-               const_cast<char*>(host.c_str()));
-    a.SSL_set1_host(ssl_, host.c_str());
+    // peer-identity binding: X509_check_host never matches IP SANs, so an
+    // IP-literal endpoint must bind through the verify param's IP channel;
+    // IP literals also get no SNI (RFC 6066 forbids it)
+    unsigned char ipbuf[16];
+    bool is_ip = ::inet_pton(AF_INET, host.c_str(), ipbuf) == 1 ||
+                 ::inet_pton(AF_INET6, host.c_str(), ipbuf) == 1;
+    if (is_ip) {
+      a.X509_VERIFY_PARAM_set1_ip_asc(a.SSL_get0_param(ssl_), host.c_str());
+    } else {
+      // SNI (SSL_set_tlsext_host_name is a macro over SSL_ctrl) + hostname
+      // verification binding
+      a.SSL_ctrl(ssl_, kCtrlSetTlsextHostname, kTlsextNametypeHostName,
+                 const_cast<char*>(host.c_str()));
+      a.SSL_set1_host(ssl_, host.c_str());
+    }
     int rc = a.SSL_connect(ssl_);
     TCHECK_EQ(rc, 1) << "TLS: handshake with " << host
                      << " failed: " << LastError();

@@ -1079,6 +1079,19 @@ TESTCASE(binned_cache_truncated_copy_is_invalid) {
   EXPECT_TRUE(r.error().find("truncated") != std::string::npos);
 }
 
+namespace {
+
+// opaque filler payload for framing-layer tests: bytes 28..31 are the
+// BinnedBlockHeader cflag field (the block-codec id), so they are zeroed
+// to keep the record classified raw and served verbatim
+std::string OpaquePayload(size_t n, char fill) {
+  std::string p(n, fill);
+  if (p.size() >= 32) std::memset(&p[28], 0, 4);
+  return p;
+}
+
+}  // namespace
+
 TESTCASE(binned_cache_corrupt_block_recover_resync) {
   TemporaryDirectory tmp;
   std::string f = tmp.path + "/resync.bincache";
@@ -1086,7 +1099,8 @@ TESTCASE(binned_cache_corrupt_block_recover_resync) {
     data::BinnedCacheWriter w(f, "{}");
     for (uint32_t part = 0; part < 3; ++part) {
       for (int k = 0; k < 2; ++k) {
-        std::string payload(48 + part * 8 + k, 'a' + static_cast<char>(part));
+        std::string payload =
+            OpaquePayload(48 + part * 8 + k, 'a' + static_cast<char>(part));
         w.WriteBlock(part, 1, 4, payload.data(), payload.size());
       }
     }
@@ -1116,7 +1130,7 @@ TESTCASE(binned_cache_corrupt_block_recover_resync) {
     // (WriteBlock payloads are verbatim — the fill char identifies the part)
     rec.SeekTo(offsets[2]);
     EXPECT_TRUE(rec.NextBlock(&blk));
-    EXPECT_EQV(blk, std::string(48 + 2 * 8, 'c'));
+    EXPECT_EQV(blk, OpaquePayload(48 + 2 * 8, 'c'));
   }
 }
 
@@ -1189,8 +1203,8 @@ std::vector<std::string> BuildViewCache(const std::string& f) {
   data::BinnedCacheWriter w(f, "{\"zc\":1}");
   for (uint32_t part = 0; part < 3; ++part) {
     for (int k = 0; k < 2; ++k) {
-      payloads.emplace_back(40 + part * 12 + k,
-                            static_cast<char>('a' + part * 2 + k));
+      payloads.push_back(OpaquePayload(40 + part * 12 + k,
+                                       static_cast<char>('a' + part * 2 + k)));
       w.WriteBlock(part, 1, 4, payloads.back().data(),
                    payloads.back().size());
     }
@@ -1352,6 +1366,176 @@ TESTCASE(binned_cache_odirect_arena_backend) {
   // a direct-arena reader returns its arena to the pool on destruction
   if (got == data::CacheReadBackend::kDirectArena)
     EXPECT_TRUE(data::CacheArenaPool::Get()->pooled_bytes() > pooled0);
+}
+
+// ---- the block codec tier (doc/binned_cache.md "Block codec") -------------
+
+namespace {
+
+// a realistic cache: WriteRawBlock packs genuine headers + column streams
+// (the shape the codec operates on); smooth feature values keep the ebin /
+// CSR streams compressible the way real epoch data is
+void BuildRealCache(const std::string& f, const char* codec_name) {
+  data::BinnedCacheWriter w(f, "{\"codec_test\":1}");
+  int cid = codec::FromName(codec_name);
+  TCHECK(cid >= 0) << "codec " << codec_name << " not built in";
+  w.SetCodec(cid);
+  std::vector<float> cuts(4 * 8);
+  for (size_t i = 0; i < cuts.size(); ++i) cuts[i] = static_cast<float>(i % 8);
+  w.SetCuts(cuts.data(), 4, 8);
+  for (uint32_t part = 0; part < 2; ++part) {
+    const uint64_t rows = 64, nnz = rows * 3;
+    std::vector<float> label(rows), weight(rows, 1.f), value(nnz);
+    std::vector<int32_t> rp(rows + 1, 0), idx(nnz);
+    for (uint64_t r = 0; r < rows; ++r) {
+      label[r] = static_cast<float>(r % 2);
+      rp[r + 1] = static_cast<int32_t>((r + 1) * 3);
+      for (uint64_t j = 0; j < 3; ++j) {
+        idx[r * 3 + j] = static_cast<int32_t>(j);
+        value[r * 3 + j] = static_cast<float>((r + j + part) % 8) * 0.9f;
+      }
+    }
+    w.WriteRawBlock(part, 0, rows, nnz, label.data(), weight.data(),
+                    rp.data(), idx.data(), value.data(), nullptr);
+  }
+  w.Close();
+}
+
+}  // namespace
+
+TESTCASE(block_codec_roundtrip_and_incompressible) {
+  // compressible input round-trips bit-identically through bitshuffle+LZ4
+  std::vector<uint8_t> src(100000);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = static_cast<uint8_t>(i % 7);
+  std::vector<uint8_t> comp(codec::CompressBound(src.size()));
+  size_t c = codec::Compress(codec::kLz4, src.data(), src.size(), comp.data(),
+                             comp.size());
+  std::vector<uint8_t> out(src.size(), 0);
+  if (!codec::Enabled()) {
+    // -DDMLCTPU_CODEC=0: Compress never wins (records stay raw), Decompress
+    // never lies, and the lz4 knob spelling is rejected up front
+    EXPECT_EQV(c, 0u);
+    EXPECT_TRUE(!codec::Decompress(codec::kLz4, comp.data(), 16, out.data(),
+                                   out.size()));
+    EXPECT_EQV(codec::FromName("lz4"), -1);
+    EXPECT_EQV(codec::FromName("raw"), codec::kRaw);
+    return;
+  }
+  EXPECT_TRUE(c > 0);
+  EXPECT_TRUE(c < src.size() / 4);  // repetitive planes compress hard
+  EXPECT_TRUE(codec::Decompress(codec::kLz4, comp.data(), c, out.data(),
+                                out.size()));
+  EXPECT_TRUE(out == src);
+  // truncated input fails cleanly: bounds-checked, no overread/overwrite
+  EXPECT_TRUE(!codec::Decompress(codec::kLz4, comp.data(), c / 2, out.data(),
+                                 out.size()));
+  EXPECT_TRUE(!codec::Decompress(codec::kLz4, comp.data(), 0, out.data(),
+                                 out.size()));
+  // incompressible input: Compress reports no win, the writer stores raw
+  uint32_t s = 123456789u;
+  for (size_t i = 0; i < src.size(); ++i) {
+    s = s * 1664525u + 1013904223u;
+    src[i] = static_cast<uint8_t>(s >> 24);
+  }
+  EXPECT_EQV(codec::Compress(codec::kLz4, src.data(), src.size(), comp.data(),
+                             comp.size()),
+             0u);
+}
+
+TESTCASE(binned_cache_codec_compressed_bit_identity) {
+  TemporaryDirectory tmp;
+  std::string raw_f = tmp.path + "/raw.bincache";
+  std::string lz4_f = tmp.path + "/lz4.bincache";
+  BuildRealCache(raw_f, "raw");
+  BuildRealCache(lz4_f, codec::Enabled() ? "lz4" : "raw");
+  // raw ground truth via the streaming backend
+  std::vector<std::string> truth;
+  {
+    ScopedEnv off("DMLCTPU_BINCACHE_MMAP", "0");
+    data::BinnedCacheReader r(raw_f);
+    truth = DrainBlocks(&r);
+  }
+  EXPECT_EQV(truth.size(), 2u);
+  if (codec::Enabled())  // the disk win the bench gates on
+    EXPECT_TRUE(SlurpFile(lz4_f).size() < SlurpFile(raw_f).size());
+  uint64_t in0 = telemetry::stage::CacheCodecBytesIn().Value();
+  {  // streaming decode path (NextBlock) is bit-identical to raw
+    ScopedEnv off("DMLCTPU_BINCACHE_MMAP", "0");
+    data::BinnedCacheReader r(lz4_f);
+    EXPECT_TRUE(DrainBlocks(&r) == truth);
+  }
+  {  // mmap view path: compressed records decode into a pooled arena and
+    // come back borrowed=1, bit-identical, recycled on the next call
+    data::BinnedCacheReader r(lz4_f);
+    EXPECT_TRUE(r.valid());
+    EXPECT_TRUE(r.backend() == data::CacheReadBackend::kMmap);
+    const char* data = nullptr;
+    uint64_t size = 0;
+    int borrowed = 0;
+    size_t n = 0;
+    while (r.NextBlockView(&data, &size, &borrowed)) {
+      EXPECT_EQV(borrowed, 1);
+      EXPECT_EQV(std::string(data, size), truth[n]);
+      ++n;
+    }
+    EXPECT_EQV(n, truth.size());
+  }
+  if (codec::Enabled() && telemetry::Enabled())
+    EXPECT_TRUE(telemetry::stage::CacheCodecBytesIn().Value() > in0);
+  {  // SetDecode(false) is the dataservice serve mode: stored bytes ship
+    // verbatim (cflag intact) and DecodePayload restores them client-side
+    data::BinnedCacheReader r(lz4_f);
+    r.SetDecode(false);
+    std::string blk;
+    size_t n = 0;
+    bool saw_compressed = false;
+    while (r.NextBlock(&blk)) {
+      data::BinnedBlockHeader hdr;
+      std::memcpy(&hdr, blk.data(), sizeof(hdr));
+      saw_compressed = saw_compressed || hdr.cflag != 0;
+      std::string decoded;
+      if (data::BinnedCacheReader::DecodePayload(blk.data(), blk.size(),
+                                                 &decoded))
+        blk.swap(decoded);
+      EXPECT_EQV(blk, truth[n]);
+      ++n;
+    }
+    EXPECT_EQV(n, truth.size());
+    EXPECT_EQV(saw_compressed, codec::Enabled());
+  }
+}
+
+TESTCASE(binned_cache_codec_corrupt_decode_strict_and_recover) {
+  if (!codec::Enabled() || !fault::Enabled()) return;
+  TemporaryDirectory tmp;
+  std::string f = tmp.path + "/corrupt.bincache";
+  std::string err;
+  // seeded bit-flip after compression: framing stays intact, only the
+  // codec payload decodes wrong
+  EXPECT_TRUE(fault::ArmSpec("cache.codec.corrupt=err@1.0:n=1;seed=11", &err));
+  BuildRealCache(f, "lz4");
+  fault::DisarmAll();
+  {  // strict: the damaged record is fatal mid-stream, uri in the error
+    data::BinnedCacheReader r(f);
+    EXPECT_TRUE(r.valid());
+    std::string blk;
+    EXPECT_THROWS(while (r.NextBlock(&blk)) {});
+  }
+  {  // recover: the damaged record is counted + skipped, the rest decodes
+    data::BinnedCacheReader r(f, /*recover=*/true);
+    EXPECT_TRUE(r.valid());
+    EXPECT_EQV(DrainBlocks(&r).size(), 1u);
+    EXPECT_TRUE(r.corrupt_skipped() >= 1);
+  }
+  {  // a truncated copy of a compressed cache is rejected at validation —
+    // never mapped, never decoded, no SIGBUS / overread
+    std::string whole = SlurpFile(f);
+    std::string g = tmp.path + "/cut.bincache";
+    WriteFile(g, whole.substr(0, whole.size() - 7));
+    data::BinnedCacheReader cut(g);
+    EXPECT_TRUE(!cut.valid());
+    EXPECT_TRUE(cut.error().find("truncated") != std::string::npos);
+  }
 }
 
 TESTCASE(cache_arena_pool_recycles_by_bucket) {

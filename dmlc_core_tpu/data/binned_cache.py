@@ -62,10 +62,12 @@ LOGGER = logging.getLogger("dmlc_core_tpu.binned_cache")
 CACHE_META_VERSION = 1
 
 # block payload prefix — mirrors BinnedBlockHeader (binned_cache.h); native
-# byte order on both sides, with meta["byte_order"] guarding foreign opens
+# byte order on both sides, with meta["byte_order"] guarding foreign opens.
+# cflag (ex-pad0, always 0 pre-codec) names the block codec; every decoded
+# payload handed to unpack_block has it cleared back to 0.
 _HDR_DTYPE = np.dtype([("part_id", np.uint32), ("seq", np.uint32),
                        ("num_rows", np.uint64), ("nnz", np.uint64),
-                       ("flags", np.uint32), ("pad0", np.uint32)])
+                       ("flags", np.uint32), ("cflag", np.uint32)])
 _HDR_BYTES = _HDR_DTYPE.itemsize
 assert _HDR_BYTES == 32
 
@@ -122,8 +124,82 @@ def _declare_binned_cache_sig():
     L.DmlcTpuBinnedCacheReaderCorruptSkipped.restype = ctypes.c_int64
     L.DmlcTpuBinnedCacheReaderFree.argtypes = [ctypes.c_void_p]
     L.DmlcTpuBinnedCacheReaderFree.restype = None
+    L.DmlcTpuBinnedCacheWriterSetCodec.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int]
+    L.DmlcTpuBinnedCacheReaderTakeArena.argtypes = [ctypes.c_void_p,
+                                                    P(ctypes.c_void_p)]
+    L.DmlcTpuBinnedCacheReaderSetDecode.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int]
+    L.DmlcTpuBlockCodecEnabled.argtypes = []
+    L.DmlcTpuBlockCodecFromName.argtypes = [ctypes.c_char_p]
+    L.DmlcTpuBlockCodecName.argtypes = [ctypes.c_int]
+    L.DmlcTpuBlockCodecName.restype = ctypes.c_char_p
+    L.DmlcTpuBlockCodecBound.argtypes = [ctypes.c_uint64]
+    L.DmlcTpuBlockCodecBound.restype = ctypes.c_uint64
+    L.DmlcTpuBlockCodecEncode.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint64]
+    L.DmlcTpuBlockCodecEncode.restype = ctypes.c_int64
+    L.DmlcTpuBlockCodecDecode.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint64]
+    L.DmlcTpuBlockCodecDecode.restype = ctypes.c_int64
+    L.DmlcTpuBinnedBlockDecode.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, P(ctypes.c_void_p),
+        P(ctypes.c_uint64)]
     L._binned_cache_declared = True
     return L
+
+
+# ---- block codec ------------------------------------------------------------
+
+def codec_from_env() -> str:
+    """The build-side codec the ``DMLCTPU_BINCACHE_CODEC`` env knob selects
+    (default ``raw``); doc/analysis.md knob registry."""
+    return os.environ.get("DMLCTPU_BINCACHE_CODEC", "raw") or "raw"
+
+
+def resolve_codec(codec: Optional[str]) -> str:
+    """Normalize a codec request (``None`` defers to the env knob) to a
+    canonical name the native side accepts.  Unknown names raise; a
+    non-raw codec on a library built with ``-DDMLCTPU_CODEC=0`` warns and
+    falls back to ``raw`` (the stub can still READ raw caches but must not
+    write compressed blocks it could never decode back)."""
+    L = _declare_binned_cache_sig()
+    name = (codec_from_env() if codec is None else codec).strip().lower()
+    if name == "":
+        name = "raw"
+    cid = int(L.DmlcTpuBlockCodecFromName(name.encode()))
+    if cid < 0:
+        raise ValueError(f"unknown bin-cache codec {name!r} "
+                         f"(supported: raw, lz4)")
+    if cid != 0 and not int(L.DmlcTpuBlockCodecEnabled()):
+        LOGGER.warning("bin-cache codec %r requested but libdmlctpu was "
+                       "built with DMLCTPU_CODEC=0; writing raw", name)
+        return "raw"
+    return "raw" if cid == 0 else name
+
+
+def decode_block_payload(buf: Union[bytes, np.ndarray]):
+    """Decode one maybe-compressed block record payload into the raw layout
+    :func:`unpack_block` expects.  Raw payloads are returned unchanged (no
+    bytes move); compressed payloads decode into a pooled native arena and
+    come back as a uint8 view whose finalizer recycles the arena.  The
+    dataservice client runs every wire frame through this — workers ship
+    stored (possibly compressed) bytes verbatim and never decode."""
+    L = _declare_binned_cache_sig()
+    a = np.frombuffer(buf, np.uint8) if not isinstance(buf, np.ndarray) \
+        else buf
+    arena, out_size = ctypes.c_void_p(), ctypes.c_uint64()
+    check(L.DmlcTpuBinnedBlockDecode(
+        a.ctypes.data_as(ctypes.c_void_p), a.shape[0],
+        ctypes.byref(arena), ctypes.byref(out_size)))
+    if not arena.value:
+        return buf
+    n = int(out_size.value)
+    cbuf = (ctypes.c_uint8 * n).from_address(arena.value)
+    weakref.finalize(cbuf, _arena_release, L, int(arena.value))
+    return np.frombuffer(cbuf, np.uint8, n)
 
 
 # ---- digests & meta ---------------------------------------------------------
@@ -140,13 +216,14 @@ def cuts_digest_of(cuts) -> str:
 _INVALIDATION_FIELDS = (
     "version", "byte_order", "num_bins", "missing_aware", "sketch_size",
     "sketch_seed", "source_bytes", "num_parts", "virtual_parts", "format",
-    "with_qid",
+    "with_qid", "codec",
 )
 
 
 def _compose_meta(uri: str, binner, *, source_bytes: int, num_parts: int,
                   virtual_parts: int, format: str,  # noqa: A002
-                  with_qid: bool, cuts: np.ndarray) -> dict:
+                  with_qid: bool, cuts: np.ndarray,
+                  codec: str = "raw") -> dict:
     pad_bin = int(np.searchsorted(cuts[0], np.float32(0.0), side="right") + 1
                   ) if cuts.size else 1
     return {
@@ -158,6 +235,7 @@ def _compose_meta(uri: str, binner, *, source_bytes: int, num_parts: int,
         "virtual_parts": int(virtual_parts),
         "format": str(format),
         "with_qid": bool(with_qid),
+        "codec": str(codec),
         "num_bins": int(binner.num_bins),
         "missing_aware": bool(binner.missing_aware),
         "sketch_size": int(binner.sketch_size),
@@ -202,6 +280,12 @@ class _NativeWriter:
         self._handle = ctypes.c_void_p()
         check(self._lib.DmlcTpuBinnedCacheWriterCreate(
             path.encode(), meta_json.encode(), ctypes.byref(self._handle)))
+
+    def set_codec(self, codec: str) -> None:
+        cid = int(self._lib.DmlcTpuBlockCodecFromName(codec.encode()))
+        if cid < 0:
+            raise ValueError(f"unknown bin-cache codec {codec!r}")
+        check(self._lib.DmlcTpuBinnedCacheWriterSetCodec(self._handle, cid))
 
     def set_cuts(self, cuts: np.ndarray) -> None:
         cuts = np.ascontiguousarray(cuts, np.float32)
@@ -299,6 +383,10 @@ class _NativeReader:
             check(self._lib.DmlcTpuBinnedCacheReaderMetaJson(
                 self._handle, ctypes.byref(s)))
             self.meta = json.loads((s.value or b"{}").decode())
+            # pre-codec caches carry no codec key; they ARE raw caches, so
+            # normalizing here (not at comparison sites) keeps them serving
+            # zero-copy with no rebuild
+            self.meta.setdefault("codec", "raw")
             check(self._lib.DmlcTpuBinnedCacheReaderPartMapJson(
                 self._handle, ctypes.byref(s)))
             self.part_map = {int(p["id"]): p for p in
@@ -324,7 +412,14 @@ class _NativeReader:
         buffer base chain pins the native handle).  Non-borrowed scratch
         (streaming backend, reassembled magic-split records) is copied out
         here — counted in ``cache.bytes_copied`` — so callers always get a
-        stable array either way."""
+        stable array either way.
+
+        A compressed record comes back already decoded into a pooled arena;
+        taking the arena here (``DmlcTpuBinnedCacheReaderTakeArena``) and
+        pinning it by a release finalizer lets the native reader decode the
+        NEXT record into a fresh pool buffer while this view is still
+        queued in the repacker — the double-buffered decode/repack
+        overlap."""
         data, size = ctypes.c_void_p(), ctypes.c_uint64()
         borrowed = ctypes.c_int()
         rc = check(self._lib.DmlcTpuBinnedCacheReaderNextBlockView(
@@ -335,7 +430,16 @@ class _NativeReader:
         n = int(size.value)
         if n == 0 or not data.value:
             return np.empty(0, np.uint8)
+        arena = ctypes.c_void_p()
+        check(self._lib.DmlcTpuBinnedCacheReaderTakeArena(
+            self._handle, ctypes.byref(arena)))
         cbuf = (ctypes.c_uint8 * n).from_address(data.value)
+        if arena.value:
+            # decoded block: the view rides the arena we now own, not the
+            # reader's mapping; recycle it once every view is garbage
+            weakref.finalize(cbuf, _arena_release, self._lib,
+                             int(arena.value))
+            return np.frombuffer(cbuf, np.uint8, n)
         cbuf._owner = self._keep  # view -> handle keepalive, never the reverse
         a = np.frombuffer(cbuf, np.uint8, n)
         if not borrowed.value:
@@ -351,6 +455,12 @@ class _NativeReader:
         check(self._lib.DmlcTpuBinnedCacheReaderBackend(self._handle,
                                                         ctypes.byref(out)))
         return int(out.value)
+
+    def set_decode(self, decode: bool) -> None:
+        """Toggle inline decode; False serves records exactly as stored
+        (compressed payloads included) — the dataservice worker's mode."""
+        check(self._lib.DmlcTpuBinnedCacheReaderSetDecode(
+            self._handle, 1 if decode else 0))
 
     def seek_to(self, offset: int) -> None:
         check(self._lib.DmlcTpuBinnedCacheReaderSeekTo(self._handle, offset))
@@ -680,8 +790,14 @@ def _drain_host(it: DeviceStagingIter) -> Iterator[dict]:
 def build_bin_cache(uri: str, cache_path: str, binner, *,
                     num_parts: int = 1, format: str = "auto",  # noqa: A002
                     batch_size: int = 4096, nnz_bucket: int = 1 << 16,
-                    with_qid: bool = False, buffer_mb: int = 64) -> dict:
+                    with_qid: bool = False, buffer_mb: int = 64,
+                    codec: Optional[str] = None) -> dict:
     """Build the binned cache for ``uri`` at ``cache_path``; returns meta.
+
+    ``codec`` selects the optional block codec (``"raw"`` / ``"lz4"``;
+    ``None`` defers to ``DMLCTPU_BINCACHE_CODEC``): non-raw builds write
+    bitshuffle+LZ4-compressed block records (doc/binned_cache.md, "Block
+    codec") that readers decode back bit-identically.
 
     An unfitted ``binner`` (``cuts is None``) gets a sketch pass first —
     one full parse feeding ``partial_fit_sparse`` then ``finalize()`` — so
@@ -713,14 +829,16 @@ def build_bin_cache(uri: str, cache_path: str, binner, *,
             it.close()
         binner.finalize()
 
+    codec = resolve_codec(codec)
     cuts = np.ascontiguousarray(np.asarray(binner.cuts), np.float32)
     meta = _compose_meta(uri, binner, source_bytes=total, num_parts=num_parts,
                          virtual_parts=V, format=format, with_qid=with_qid,
-                         cuts=cuts)
+                         cuts=cuts, codec=codec)
     tmp = f"{cache_path}.tmp.{os.getpid()}"
     writer = _NativeWriter(tmp, json.dumps(meta))
     t0 = time.monotonic()
     try:
+        writer.set_codec(codec)
         writer.set_cuts(cuts)
         for g in range(num_parts * V):
             it = DeviceStagingIter(uri, part=g, num_parts=num_parts * V,
@@ -819,10 +937,11 @@ class BinnedStagingIter:
                  format: str = "auto", sharding=None,  # noqa: A002
                  prefetch: int = 2, prefetch_depth: Optional[int] = None,
                  with_qid: bool = False, buffer_mb: int = 64,
-                 recover: bool = False):
+                 recover: bool = False, codec: Optional[str] = None):
         self._uri = uri
         self._binner = binner
         self._cache_path = cache or uri.split("#", 1)[0] + ".bincache"
+        self._codec = resolve_codec(codec)
         self._batch_size = int(batch_size)
         self._nnz_bucket = int(nnz_bucket)
         self._nnz_max = int(nnz_max)
@@ -854,6 +973,7 @@ class BinnedStagingIter:
             "virtual_parts": V,
             "format": str(self._format),
             "with_qid": self._with_qid,
+            "codec": self._codec,
             "num_bins": int(self._binner.num_bins),
             "missing_aware": bool(self._binner.missing_aware),
             "sketch_size": int(self._binner.sketch_size),
@@ -928,7 +1048,7 @@ class BinnedStagingIter:
                         num_parts=self._num_parts, format=self._format,
                         batch_size=self._batch_size,
                         nnz_bucket=self._nnz_bucket, with_qid=self._with_qid,
-                        buffer_mb=self._buffer_mb)
+                        buffer_mb=self._buffer_mb, codec=self._codec)
 
     @property
     def meta(self) -> Optional[dict]:
@@ -980,38 +1100,62 @@ class BinnedStagingIter:
         pad_bin = int(self._meta.get("pad_bin", 1))
         rp = _Repacker(self._batch_size, self._nnz_bucket, self._nnz_max,
                        pad_bin, self._with_qid)
+        emitted = 0
         r = _NativeReader(self._cache_path, self._recover)
         try:
             def send(batch) -> bool:
+                nonlocal emitted
                 t2 = time.monotonic()
                 ok = emit(batch)
+                emitted += 1
                 telemetry.counter_add("cache.wait_us",
                                       int((time.monotonic() - t2) * 1e6))
                 return ok
 
-            for g in self._my_parts():
-                ent = self._part_map.get(g)
-                if ent is None:
-                    continue
-                t0 = time.monotonic()
-                r.seek_to(int(ent["offset"]))
-                for _ in range(int(ent["records"])):
-                    buf = r.next_block_view()
-                    if buf is None:
-                        break
-                    outs = list(rp.feed(unpack_block(buf)))
-                    telemetry.counter_add(
-                        "cache.busy_us",
-                        int((time.monotonic() - t0) * 1e6))
-                    for b in outs:
-                        if not send(b):
-                            return
+            try:
+                for g in self._my_parts():
+                    ent = self._part_map.get(g)
+                    if ent is None:
+                        continue
                     t0 = time.monotonic()
-                telemetry.counter_add("cache.busy_us",
-                                      int((time.monotonic() - t0) * 1e6))
-            for b in rp.flush():
-                if not send(b):
-                    return
+                    r.seek_to(int(ent["offset"]))
+                    for _ in range(int(ent["records"])):
+                        buf = r.next_block_view()
+                        if buf is None:
+                            break
+                        outs = list(rp.feed(unpack_block(buf)))
+                        telemetry.counter_add(
+                            "cache.busy_us",
+                            int((time.monotonic() - t0) * 1e6))
+                        for b in outs:
+                            if not send(b):
+                                return
+                        t0 = time.monotonic()
+                    telemetry.counter_add("cache.busy_us",
+                                          int((time.monotonic() - t0) * 1e6))
+                for b in rp.flush():
+                    if not send(b):
+                        return
+            except NativeError as e:
+                # strict-mode read corruption (torn framing OR a compressed
+                # record that fails decode — the cache.codec.corrupt fault
+                # lands here).  Before the first emitted batch: invalidate
+                # the cache (one counted rebuild; the next ensure_cache sees
+                # a missing file, an uncounted first build) and serve this
+                # epoch bit-identically from the text path.  Mid-epoch a
+                # silent restart would tear the batch stream — re-raise.
+                if emitted:
+                    raise
+                LOGGER.warning(
+                    "bin cache %s unreadable (%s); invalidating and serving"
+                    " this epoch from the text-parse path", self._cache_path,
+                    e)
+                telemetry.counter_add("cache.rebuilds", 1)
+                try:
+                    os.remove(self._cache_path)
+                except OSError:
+                    pass
+                self._produce_host_text(emit)
         finally:
             r.close()
 

@@ -916,7 +916,8 @@ class DeviceStagingIter:
                  reorder: bool = True, buffer_mb: int = 64,
                  prefetch_depth: Optional[int] = None,
                  autotune: Optional[bool] = None,
-                 bin_cache=None, binner=None):
+                 bin_cache=None, binner=None,
+                 bin_cache_codec: Optional[str] = None):
         if bin_cache is not None and binner is None:
             raise ValueError("bin_cache= needs binner= (a QuantileBinner; "
                              "see doc/binned_cache.md)")
@@ -950,6 +951,9 @@ class DeviceStagingIter:
         # from the quantized columnar cache (built on first use) instead of
         # parsing text — epoch 2+ does zero parse and zero binning work
         self._bin_cache = bin_cache
+        # block codec the cache build writes under (None defers to the
+        # DMLCTPU_BINCACHE_CODEC knob; doc/binned_cache.md "Block codec")
+        self._bin_cache_codec = bin_cache_codec
         self._binner = binner
         self._binned = None  # lazily-built BinnedStagingIter delegate
         self._prefetch = max(prefetch_depth if prefetch_depth is not None
@@ -1277,7 +1281,8 @@ class DeviceStagingIter:
                 nnz_max=self._nnz_max, part=self._part,
                 num_parts=self._num_parts, format=self._format,
                 sharding=self._sharding, prefetch_depth=self._prefetch,
-                with_qid=self._with_qid, buffer_mb=self._buffer_mb)
+                with_qid=self._with_qid, buffer_mb=self._buffer_mb,
+                codec=self._bin_cache_codec)
         yield from self._binned
 
     def _iter_epoch(self) -> Iterator[PaddedBatch]:

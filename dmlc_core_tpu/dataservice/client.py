@@ -71,9 +71,17 @@ class DataServiceIter:
                  mode: str = "binned", client_id: Optional[str] = None,
                  shard_client: Optional[tracker_metrics.ShardClient] = None,
                  retries: Optional[int] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 codec: Optional[str] = None):
         if mode not in ("binned", "staged"):
             raise ValueError(f"mode must be 'binned' or 'staged', not {mode!r}")
+        # codec negotiation: the requested block codec rides the dataset
+        # spec (None defers to DMLCTPU_BINCACHE_CODEC), the worker builds
+        # its cache under it and ships the stored — possibly compressed —
+        # frames verbatim; THIS side decodes.  resolve_codec also drops to
+        # raw when the local libdmlctpu cannot decode (DMLCTPU_CODEC=0).
+        from dmlc_core_tpu.data.binned_cache import resolve_codec
+        self._codec = resolve_codec(codec) if mode == "binned" else "raw"
         self._binner = binner
         self._mode = mode
         self._sharding = sharding
@@ -92,7 +100,7 @@ class DataServiceIter:
         self._spec = {
             "uri": uri, "format": format, "batch_size": int(batch_size),
             "nnz_bucket": int(nnz_bucket), "nnz_max": int(nnz_max),
-            "with_qid": bool(with_qid),
+            "with_qid": bool(with_qid), "codec": self._codec,
             "binner": None if mode == "staged" else {
                 "num_bins": int(binner.num_bins),
                 "missing_aware": bool(binner.missing_aware),
@@ -164,6 +172,19 @@ class DataServiceIter:
                                + str(reply.get("error")))
         if self._mode == "binned":
             meta = reply["meta"]
+            served_codec = meta.get("codec", "raw")
+            if served_codec != "raw":
+                # the worker's cache IS compressed: a client whose native
+                # library cannot decode must fail loudly here, not on the
+                # first corrupt-looking block
+                from dmlc_core_tpu.data.binned_cache import \
+                    _declare_binned_cache_sig
+                L = _declare_binned_cache_sig()
+                if not int(L.DmlcTpuBlockCodecEnabled()):
+                    raise RuntimeError(
+                        f"service cache is {served_codec}-compressed but "
+                        "this client's libdmlctpu was built with "
+                        "DMLCTPU_CODEC=0 and cannot decode it")
             if self._binner.cuts is None:
                 self._binner.cuts = jnp.asarray(_cuts_from_meta(meta))
             elif cuts_digest_of(self._binner.cuts) != meta["cuts_digest"]:
@@ -185,7 +206,8 @@ class DataServiceIter:
     def _fetch_from(self, worker: dict, part: int) -> List:
         """One whole shard off one worker, fully buffered; raises on ANY
         break so the caller can fail the lease and re-fetch elsewhere."""
-        from dmlc_core_tpu.data.binned_cache import unpack_block
+        from dmlc_core_tpu.data.binned_cache import (decode_block_payload,
+                                                     unpack_block)
         _fire("dataservice.connect")
         sock = socket.create_connection((worker["host"], worker["port"]),
                                         timeout=self._timeout_s)
@@ -211,8 +233,10 @@ class DataServiceIter:
                 _fire("dataservice.block.drop")
                 nbytes += len(payload)
                 if kind == protocol.FRAME_BLOCK:
-                    blocks.append(unpack_block(
-                        np.frombuffer(payload, np.uint8)))
+                    # frames carry stored bytes; compressed records decode
+                    # here (never on the worker), counted in cache.codec.*
+                    blocks.append(unpack_block(decode_block_payload(
+                        np.frombuffer(payload, np.uint8))))
                 elif kind == protocol.FRAME_STAGED:
                     blocks.append(protocol.unwrap_staged_wire(payload))
                 else:

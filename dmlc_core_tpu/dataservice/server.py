@@ -48,13 +48,56 @@ CACHE_DIR_ENV = "DMLCTPU_DATASERVICE_CACHE_DIR"
 
 def spec_key(spec: dict) -> str:
     """Stable digest of a dataset spec — the served-dataset registry key
-    and the cache file name, so equal specs share one cache."""
-    canon = json.dumps(
-        {k: spec.get(k) for k in ("uri", "format", "batch_size",
-                                  "nnz_bucket", "nnz_max", "with_qid",
-                                  "binner")},
-        sort_keys=True)
+    and the cache file name, so equal specs share one cache.  ``codec``
+    (absent = raw, the pre-codec wire) is part of the key: clients asking
+    for differently-compressed caches must not collide on one file."""
+    canon_dict = {k: spec.get(k) for k in ("uri", "format", "batch_size",
+                                           "nnz_bucket", "nnz_max",
+                                           "with_qid", "binner")}
+    canon_dict["codec"] = spec.get("codec") or "raw"
+    canon = json.dumps(canon_dict, sort_keys=True)
     return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+class _TokenBucket:
+    """Outbound-bandwidth pacer for A/B benches: every sent payload is
+    charged against a shared MB/s budget (50 ms burst allowance), so a
+    loopback fetch behaves like a capped network link.  Enabled by the
+    ``DMLCTPU_DATASERVICE_THROTTLE_MBPS`` env knob (doc/analysis.md)."""
+
+    def __init__(self, mbps: float):
+        self._rate = float(mbps) * 1e6
+        self._cap = max(self._rate * 0.05, float(1 << 16))
+        self._tokens = self._cap
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def charge(self, nbytes: int) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self._cap,
+                               self._tokens + (now - self._t) * self._rate)
+            self._t = now
+            self._tokens -= nbytes
+            if self._tokens < 0:
+                # pay the debt down before the next send; holding the lock
+                # serializes all senders against the one simulated pipe
+                time.sleep(-self._tokens / self._rate)
+                self._t = time.monotonic()
+                self._tokens = 0.0
+
+
+_THROTTLES: Dict[str, _TokenBucket] = {}
+
+
+def _throttle() -> Optional[_TokenBucket]:
+    mbps = os.environ.get("DMLCTPU_DATASERVICE_THROTTLE_MBPS", "")
+    if not mbps or float(mbps) <= 0:
+        return None
+    tb = _THROTTLES.get(mbps)
+    if tb is None:
+        tb = _THROTTLES[mbps] = _TokenBucket(float(mbps))
+    return tb
 
 
 class _ServedDataset:
@@ -93,7 +136,8 @@ class _ServedDataset:
                         nnz_bucket=int(spec["nnz_bucket"]),
                         nnz_max=int(spec.get("nnz_max", 0)),
                         format=spec.get("format", "auto"),
-                        with_qid=bool(spec.get("with_qid", False)))
+                        with_qid=bool(spec.get("with_qid", False)),
+                        codec=spec.get("codec", "raw"))
                     it.ensure_cache()
                     if it._fallback_text:
                         raise RuntimeError(
@@ -117,20 +161,29 @@ class _ServedDataset:
             self._serve_staged(sock, part)
 
     def _serve_blocks(self, sock: socket.socket, part: int) -> None:
-        """Stream one global virtual part's raw cache blocks, zero-copy from
-        the reader's mmap view straight into sendall."""
+        """Stream one global virtual part's cache blocks exactly as stored,
+        zero-copy from the reader's mmap view straight into sendall.
+
+        ``set_decode(False)`` keeps compressed records compressed on the
+        wire — the CLIENT decodes (``decode_block_payload``), so the codec's
+        bandwidth win survives the hop and the worker never spends decode
+        CPU on the serve path."""
         from dmlc_core_tpu.data.binned_cache import _NativeReader
         it = self._iter
         ent = it._part_map.get(int(part))
+        tb = _throttle()
         sent = 0
         if ent is not None:
             r = _NativeReader(self.cache_path)
             try:
+                r.set_decode(False)
                 r.seek_to(int(ent["offset"]))
                 for _ in range(int(ent["records"])):
                     buf = r.next_block_view()
                     if buf is None:
                         break
+                    if tb is not None:
+                        tb.charge(int(buf.nbytes) + 12)
                     protocol.write_frame(sock, protocol.FRAME_BLOCK,
                                          memoryview(buf))
                     sent += 1
@@ -168,6 +221,9 @@ class _ServedDataset:
                     break
                 try:
                     hdr, arena = protocol.pack_staged_wire(c)
+                    tb = _throttle()
+                    if tb is not None:
+                        tb.charge(len(hdr) + len(arena) + 12)
                     protocol.write_frame(sock, protocol.FRAME_STAGED,
                                          hdr, arena)
                 finally:

@@ -278,11 +278,23 @@ def stall_attribution(before: dict, after: dict,
     cache_busy, cache_wait = us("cache.busy_us"), us("cache.wait_us")
     cache_hit = d.get("cache.hit_bytes", 0)
     if cache_busy or cache_wait or cache_hit:
-        stages["cache"] = {"busy_s": round(cache_busy, 6),
-                           "wait_s": round(cache_wait, 6),
-                           "copy_ratio": round(
-                               d.get("cache.bytes_copied", 0) / cache_hit, 4)
-                           if cache_hit else 0.0}
+        cache_stage = {"busy_s": round(cache_busy, 6),
+                       "wait_s": round(cache_wait, 6),
+                       "copy_ratio": round(
+                           d.get("cache.bytes_copied", 0) / cache_hit, 4)
+                       if cache_hit else 0.0}
+        # block-codec decode accounting (doc/binned_cache.md "Block
+        # codec"): when compressed records decoded in the interval,
+        # codec_ratio = decompressed bytes out per stored byte in (the
+        # compression ratio as observed at serve time) and decode_s the
+        # decode wall time — already INSIDE busy_s, the decode runs in the
+        # repack stage, so it is a breakdown, not a fifth stage
+        codec_in = d.get("cache.codec.bytes_in", 0)
+        if codec_in:
+            cache_stage["codec_ratio"] = round(
+                d.get("cache.codec.bytes_out", 0) / codec_in, 4)
+            cache_stage["decode_s"] = round(us("cache.codec.decode_us"), 6)
+        stages["cache"] = cache_stage
 
     sharded = d.get("shard.parts", 0) > 0
     candidates = [n for n in stages if not (sharded and n == "parse")]
@@ -392,6 +404,10 @@ def format_stall_table(attr: dict) -> str:
         pct = attr["bound"].get(name)
         lines.append(f"{name:<8}{st['busy_s']:>9.3f}{st['wait_s']:>10.3f}"
                      f"{'' if pct is None else f'{pct:>8.1f}'}")
+    cache = attr["stages"].get("cache", {})
+    if "codec_ratio" in cache:
+        lines.append(f"codec   {cache['codec_ratio']:.2f}x expansion, "
+                     f"{cache['decode_s']:.3f}s decode (inside cache busy)")
     if attr["table"]:
         lines.append(attr["table"])
     return "\n".join(lines)

@@ -20,6 +20,7 @@ from .common import Finding, read_text, strip_cxx_comments
 REGISTERED = {
     "cpp/include/dmlctpu/telemetry.h": "DMLCTPU_TELEMETRY",
     "cpp/include/dmlctpu/fault.h": "DMLCTPU_FAULTS",
+    "cpp/src/data/block_codec.h": "DMLCTPU_CODEC",
 }
 
 CPP_KEYWORDS = {

@@ -156,6 +156,30 @@ for t in test_core test_data; do
     exit 1
   fi
 done
+
+# Codec-opt-out tier: block_codec.h promises the same compile-out contract
+# (-DDMLCTPU_CODEC=0 stubs bitshuffle+LZ4 out).  Build and run the data
+# suite against the stubbed header: writers must store every record raw,
+# the lz4 knob spelling must be refused, compressed caches must read as
+# corrupt — and the raw paths must stay bit-identical.  (The ASan/UBSan
+# tier above covers the codec-ON decode paths, bounds checks included.)
+mkdir -p build/nocodec
+for t in test_data; do
+  nc_bin=build/nocodec/$t
+  if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+    cmake -S . -B build/nocodec -G Ninja -DCMAKE_BUILD_TYPE=Release \
+          -DDMLCTPU_CODEC=OFF >/dev/null
+    ninja -C build/nocodec "$t" >/dev/null
+  else
+    g++ -O1 -g -std=c++20 -DDMLCTPU_CODEC=0 -pthread -rdynamic \
+        -I cpp/include -I cpp cpp/tests/"$t".cc cpp/src/*.cc \
+        cpp/src/io/*.cc cpp/src/data/*.cc -ldl -o "$nc_bin"
+  fi
+  if ! "$nc_bin" >/tmp/dmlctpu_check_nocodec_$t.log 2>&1; then
+    echo "check.sh: NOCODEC SUITE FAILED: $t (log: /tmp/dmlctpu_check_nocodec_$t.log)" >&2
+    exit 1
+  fi
+done
 flock -u 9
 
 if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
@@ -224,4 +248,4 @@ fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
 py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + sparse-pallas tier")
-echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + $py)"
+echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + nocodec tier + $py)"

@@ -281,6 +281,66 @@ def test_service_bit_identity_inprocess(board_env, tmp_path):
             urllib.request.urlopen(srv.url + "/shards", timeout=10)
 
 
+def test_service_codec_compressed_bit_identity(board_env, tmp_path):
+    """Compressed frames ship end-to-end: the worker builds an lz4 cache
+    for the negotiated spec and serves stored bytes verbatim (decode never
+    runs worker-side), the client decodes inside its repack stage, and the
+    epoch is bit-identical to raw service."""
+    from dmlc_core_tpu.data.binned_cache import resolve_codec
+    if resolve_codec("lz4") != "lz4":
+        pytest.skip("libdmlctpu built with -DDMLCTPU_CODEC=0")
+    agg, worker = board_env
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    raw_it = DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                             shard_client=tm.ShardClient("127.0.0.1",
+                                                         agg.port, rank=0))
+    raw = list(raw_it)
+
+    in0 = telemetry.counter_get("cache.codec.bytes_in")
+    it = DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                         codec="lz4",
+                         shard_client=tm.ShardClient("127.0.0.1", agg.port,
+                                                     rank=0))
+    got = list(it)
+    assert _batch_digest(got) == _batch_digest(raw)
+    # the codec is negotiated into the spec: distinct cache artifacts, and
+    # the compressed one is the smaller file the capped link benefits from
+    assert spec_key(it._spec) != spec_key(raw_it._spec)
+    raw_f = tmp_path / "cache" / (spec_key(raw_it._spec) + ".bincache")
+    lz4_f = tmp_path / "cache" / (spec_key(it._spec) + ".bincache")
+    assert lz4_f.stat().st_size < raw_f.stat().st_size
+    if telemetry.enabled():
+        # set_decode(False) keeps the worker off the decode path; the only
+        # decoder in this process is the client's repack stage
+        assert telemetry.counter_get("cache.codec.bytes_in") > in0
+    # second epoch: fresh leases, still identical
+    assert _batch_digest(list(it)) == _batch_digest(raw)
+
+
+def test_service_throttle_token_bucket_and_epoch(board_env, tmp_path,
+                                                 monkeypatch):
+    """The loopback throttle behaves like a capped pipe: sends past the
+    burst allowance debt-sleep at the configured rate, and a throttled
+    epoch still serves a bit-identical stream."""
+    from dmlc_core_tpu.dataservice.server import _TokenBucket
+    tb = _TokenBucket(1.0)  # 1 MB/s simulated link, 64 KiB burst
+    t0 = time.monotonic()
+    tb.charge(150_000)
+    tb.charge(150_000)
+    assert time.monotonic() - t0 >= 0.15  # ~235 KB of debt at 1 MB/s
+
+    agg, worker = board_env
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    ref = list(DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                               shard_client=tm.ShardClient(
+                                   "127.0.0.1", agg.port, rank=0)))
+    monkeypatch.setenv("DMLCTPU_DATASERVICE_THROTTLE_MBPS", "8")
+    got = list(DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                               shard_client=tm.ShardClient(
+                                   "127.0.0.1", agg.port, rank=0)))
+    assert _batch_digest(got) == _batch_digest(ref)
+
+
 def test_staged_mode_inprocess(board_env, tmp_path):
     """Text-fallback mode: the worker ships packed parse batches, the
     client bins with its fitted cuts — same rows, same label multiset."""

@@ -274,12 +274,12 @@ def _drain_binned_bits(it):
             for b in it]
 
 
-def _binned_iter(path, cache):
+def _binned_iter(path, cache, **kw):
     from dmlc_core_tpu.models import QuantileBinner
     binner = QuantileBinner(num_bins=16, missing_aware=True, sketch_size=64,
                             sketch_seed=3)
     return dt.BinnedStagingIter(path, binner, cache=cache, batch_size=128,
-                                nnz_bucket=512)
+                                nnz_bucket=512, **kw)
 
 
 @faults_on
@@ -323,6 +323,33 @@ def test_cache_write_short_sustained_degrades_to_text(libsvm_file, tmp_path):
     # disarmed: the same iterator recovers by building for real
     assert _drain_binned_bits(it) == ref
     assert not it._fallback_text
+    assert cache.exists()
+
+
+@faults_on
+def test_cache_codec_corrupt_degrades_to_text_not_torn(libsvm_file, tmp_path):
+    """``cache.codec.corrupt`` flips one bit in a compressed record AFTER
+    compression: the build succeeds and framing stays intact, but the first
+    serve hits a digest mismatch.  The iterator must degrade — one counted
+    rebuild, cache invalidated, the epoch served bit-identically from the
+    text path — and never emit a torn stream.  The next epoch (fault gone)
+    rebuilds for real and serves from the cache."""
+    from dmlc_core_tpu.data.binned_cache import resolve_codec
+    if resolve_codec("lz4") != "lz4":
+        pytest.skip("libdmlctpu built with -DDMLCTPU_CODEC=0")
+    ref = _drain_binned_bits(_binned_iter(libsvm_file,
+                                          str(tmp_path / "clean.bincache")))
+    cache = tmp_path / "poisoned.bincache"
+    it = _binned_iter(libsvm_file, str(cache), codec="lz4")
+    rebuilds0 = telemetry.counter_get("cache.rebuilds")
+    with faultinject.armed("cache.codec.corrupt=err@1.0:n=1;seed=5"):
+        got = _drain_binned_bits(it)
+    assert got == ref, "degraded epoch diverged: a torn stream escaped"
+    assert telemetry.counter_get("cache.rebuilds") == rebuilds0 + 1
+    assert not cache.exists()  # the poisoned artifact was invalidated
+    # disarmed: the rebuild is a first build (uncounted) and serves clean
+    assert _drain_binned_bits(it) == ref
+    assert telemetry.counter_get("cache.rebuilds") == rebuilds0 + 1
     assert cache.exists()
 
 
