@@ -1416,6 +1416,136 @@ def run_dataservice(data: Path) -> dict:
     return out
 
 
+def run_serving() -> dict:
+    """The online-scoring gate (doc/serving.md): micro-batched concurrent
+    scoring vs naive one-request-at-a-time sequential scoring, per request
+    size 1/8/64 rows.  Headline = the batch-1 high-fan-in case (the auction
+    shape micro-batching exists for): 16 closed-loop client threads
+    submitting single-row requests must reach >=3x the naive QPS with
+    p99 <= 5x p50 (serving_ok, soft).  A second gate is exact: after one
+    warmup sweep over every bucket geometry the timed runs touch, the
+    steady-state ``models.predict_retrace`` delta must be ZERO — the
+    bucketed-padding contract means no live request ever recompiles."""
+    jax, platform = pick_backend()
+    import threading
+
+    import numpy as np
+
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.models import SparseLinearModel
+    from dmlc_core_tpu.serving import (MicroBatchQueue, ScoringEngine,
+                                       ScoringIterator, pack_snapshot)
+
+    F, NNZ = 1000, 16
+    model = SparseLinearModel(num_features=F)
+    snap = pack_snapshot("linear", {"num_features": F}, model.init())
+    engine = ScoringEngine.from_snapshot_bytes(snap)
+    rng = np.random.default_rng(11)
+
+    def make_req(rows):
+        return [(rng.integers(0, F, NNZ).astype(np.int32).tolist(),
+                 (rng.random(NNZ) + 0.1).astype(np.float32).tolist())
+                for _ in range(rows)]
+
+    def naive(req_rows, n_requests):
+        """Sequential round trips, no coalescing: pack one request, score
+        it, block for the host result, repeat."""
+        it = ScoringIterator(max_batch=128)
+        reqs = [make_req(req_rows) for _ in range(n_requests)]
+        t0 = time.monotonic()
+        for r in reqs:
+            batch, _ = it.pack(r)
+            engine.score(batch)
+        return n_requests * req_rows / (time.monotonic() - t0)
+
+    def micro(req_rows, n_requests, threads=4, window=None):
+        """Pipelined closed-loop fan-in: each client thread keeps up to
+        ``window`` requests in flight (submit, then wait the oldest), so
+        the queue sees a standing backlog to coalesce into full
+        micro-batches — the auction fan-in shape.  The window scales
+        inversely with request size (~max_batch rows in flight per
+        thread), so big requests don't pile up a latency-inflating
+        backlog micro-batching can't drain."""
+        from collections import deque as _dq
+        if window is None:
+            window = max(2, min(64, 256 // req_rows))
+        q = MicroBatchQueue(lambda: engine, max_batch=256, max_delay_us=200)
+        lat_us: list = []
+        lock = threading.Lock()
+        per = max(window, n_requests // threads)
+
+        def client():
+            inflight: _dq = _dq()
+            mine = []
+
+            def harvest():
+                t_sub, fut = inflight.popleft()
+                fut.result(timeout=60)
+                mine.append((time.monotonic_ns() - t_sub) // 1000)
+
+            for _ in range(per):
+                inflight.append((time.monotonic_ns(),
+                                 q.submit(make_req(req_rows))))
+                if len(inflight) >= window:
+                    harvest()
+            while inflight:
+                harvest()
+            with lock:
+                lat_us.extend(mine)
+
+        ts = [threading.Thread(target=client) for _ in range(threads)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.monotonic() - t0
+        q.close()
+        lat = np.asarray(lat_us)
+        return (len(lat_us) * req_rows / wall,
+                float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
+
+    # warmup sweep: compile every reachable bucket geometry, then pin the
+    # retrace counter — the timed sweep must not move it.  Every request
+    # row carries exactly NNZ entries, so a micro-batch of r rows packs to
+    # the (pow2(r), NNZ*pow2(r)) bucket: sweeping pow-2 row counts up to
+    # the queue's max_batch covers everything coalescing can produce.
+    it = ScoringIterator(max_batch=256)
+    r = 1
+    while r <= 256:
+        batch, _ = it.pack(make_req(r))
+        engine.score(batch)
+        r *= 2
+    sizes = (1, 8, 64)
+    before = telemetry.snapshot()
+
+    out: dict = {"platform": platform, "sizes": {}}
+    for s in sizes:
+        n_req = max(64, 2048 // s)
+        nv = naive(s, n_req)
+        mq, p50, p99 = micro(s, n_req)
+        out["sizes"][str(s)] = {
+            "naive_rows_s": round(nv), "micro_rows_s": round(mq),
+            "qps_speedup": round(mq / max(nv, 1e-9), 2),
+            "p50_us": round(p50), "p99_us": round(p99)}
+
+    delta = telemetry.counters_delta(before, telemetry.snapshot())
+    head = out["sizes"]["1"]
+    out["qps_speedup"] = head["qps_speedup"]
+    out["p50_us"], out["p99_us"] = head["p50_us"], head["p99_us"]
+    out["p99_over_p50"] = round(head["p99_us"] / max(head["p50_us"], 1), 2)
+    out["retrace_steady_delta"] = int(delta.get("models.predict_retrace", 0))
+    out["serving_ok"] = (out["qps_speedup"] >= 3.0
+                         and out["p99_over_p50"] <= 5.0
+                         and out["retrace_steady_delta"] == 0)
+    if not out["serving_ok"]:
+        log(f"[bench] WARNING: serving gate missed (want >=3x naive QPS, "
+            f"p99 <= 5x p50, zero retraces): speedup "
+            f"{out['qps_speedup']}x, p99/p50 {out['p99_over_p50']}, "
+            f"retraces {out['retrace_steady_delta']}")
+    return out
+
+
 # ---- device-phase isolation -------------------------------------------------
 # The real chip sits behind the axon tunnel, which (a) rate-shapes H2D
 # (~1.9 GB/s burst, ~0.2 GB/s sustained, slow token refill) and (b) can wedge
@@ -1454,6 +1584,7 @@ phase("autotune", lambda: bench.run_autotune_convergence(data))
 phase("bincache", lambda: bench.run_bincache(bench.make_float_libsvm_dataset()))
 phase("dataservice",
       lambda: bench.run_dataservice(bench.make_float_libsvm_dataset()))
+phase("serving", bench.run_serving)
 # NOTE gbdt runs LAST (after h2d/pallas/allreduce): it is the compile-
 # heaviest phase on TPU (up to three full forest compiles for the
 # histogram A/B), and a tunnel-throttled compile must starve only
@@ -1680,7 +1811,8 @@ def run_device_phases() -> dict:
         # still keeps everything completed
         run_child("tpu", timeout=900)
     missing = {"staging", "csv_staging", "recordio_staging", "autotune",
-               "h2d", "pallas_segment", "models", "gbdt"} - set(phases)
+               "h2d", "pallas_segment", "models", "gbdt",
+               "serving"} - set(phases)
     if missing:
         log(f"[bench] filling {sorted(missing)} on the CPU backend")
         # same tail-phase budget as the TPU child: models+gbdt run last in
@@ -1805,6 +1937,7 @@ def main() -> None:
         "autotune": phases.get("autotune"),
         "bincache": phases.get("bincache"),
         "dataservice": phases.get("dataservice"),
+        "serving": phases.get("serving"),
         "telemetry_overhead": overhead,
         "faults_overhead": faults_overhead,
         "tpu_probe": probe_summary,
@@ -1851,6 +1984,12 @@ def main() -> None:
             "bytes_copied_per_byte_served"),
         "dataservice_served_vs_local": (phases.get("dataservice") or {}).get(
             "served_vs_local_hit"),
+        "serving_qps_speedup": (phases.get("serving") or {}).get(
+            "qps_speedup"),
+        "serving_p99_over_p50": (phases.get("serving") or {}).get(
+            "p99_over_p50"),
+        "serving_retrace_delta": (phases.get("serving") or {}).get(
+            "retrace_steady_delta"),
         "tpu_probe_ok": probe_summary["ok"],
         "detail": "full numbers on the DETAIL line above",
     }

@@ -333,8 +333,12 @@ namespace {
 void EnsureBindingFaultPoints() {
   DMLCTPU_FAULT_POINT(ds_connect, "dataservice.connect");
   DMLCTPU_FAULT_POINT(ds_drop, "dataservice.block.drop");
+  DMLCTPU_FAULT_POINT(serve_snap_drop, "serving.snapshot.drop");
+  DMLCTPU_FAULT_POINT(serve_malformed, "serving.request.malformed");
   (void)ds_connect;
   (void)ds_drop;
+  (void)serve_snap_drop;
+  (void)serve_malformed;
 }
 }  // namespace
 

@@ -42,7 +42,7 @@ import queue
 import threading
 import time
 import weakref
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Iterator, Optional
 
 import numpy as np
@@ -394,6 +394,68 @@ jax.tree_util.register_dataclass(
     data_fields=["label", "weight", "row_ptr", "index", "value", "num_rows",
                  "field", "qid"],
     meta_fields=[])
+
+
+def bucket_pow2(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
+    """Smallest power of two >= max(n, lo), clamped to ``hi`` — but never
+    below ``n`` itself (a ceiling must not truncate real data).
+
+    The bucketing rule shared by the serving request packer and the
+    geometry-stable predict paths: padding every ad-hoc batch up to a
+    pow-2 (rows, nnz) bucket keeps the set of distinct jit geometries
+    logarithmic in the request-size range, so XLA compiles each shape
+    exactly once instead of retracing per request.
+    """
+    n = int(n)
+    b = 1 << max(0, max(n, int(lo)) - 1).bit_length()
+    if hi is not None:
+        b = min(b, int(hi))
+    return max(b, n)
+
+
+def pad_batch_to_bucket(batch, row_bucket: Optional[int] = None,
+                        nnz_bucket: Optional[int] = None,
+                        min_rows: int = 1, min_nnz: int = 8):
+    """Pad a :class:`PaddedBatch` (or pre-binned ``BinnedBatch``) up to a
+    pow-2 (rows, nnz) bucket geometry, preserving every padding invariant.
+
+    Added rows carry ``weight == 0`` and empty spans (``row_ptr`` repeats
+    its last value); added nonzero lanes carry ``value == 0`` (PaddedBatch)
+    or ``emask == False`` (BinnedBatch), so segment reductions and sparse
+    forest routing are unaffected — real-row outputs are bit-identical to
+    scoring the unpadded batch.  ``num_rows`` is left alone.  Explicit
+    ``row_bucket``/``nnz_bucket`` override the pow-2 rule (clamped up to
+    the real extent, never down).  Returns ``batch`` unchanged when it is
+    already on-bucket.
+    """
+    rows = batch.batch_size
+    nnz = int(batch.index.shape[0])
+    rb = (bucket_pow2(rows, min_rows) if row_bucket is None
+          else max(int(row_bucket), rows))
+    nb = (bucket_pow2(nnz, min_nnz) if nnz_bucket is None
+          else max(int(nnz_bucket), nnz))
+    if rb == rows and nb == nnz:
+        return batch
+    pr, pn = rb - rows, nb - nnz
+    kw = {}
+    if pr:
+        kw["label"] = jnp.pad(batch.label, (0, pr))
+        kw["weight"] = jnp.pad(batch.weight, (0, pr))
+        kw["row_ptr"] = jnp.concatenate(
+            [batch.row_ptr,
+             jnp.full((pr,), batch.row_ptr[-1], batch.row_ptr.dtype)])
+        if batch.qid is not None:
+            kw["qid"] = jnp.pad(batch.qid, (0, pr))
+    if pn:
+        kw["index"] = jnp.pad(batch.index, (0, pn))
+        if hasattr(batch, "ebin"):
+            kw["ebin"] = jnp.pad(batch.ebin, (0, pn))
+            kw["emask"] = jnp.pad(batch.emask, (0, pn))
+        else:
+            kw["value"] = jnp.pad(batch.value, (0, pn))
+            if batch.field is not None:
+                kw["field"] = jnp.pad(batch.field, (0, pn))
+    return _dc_replace(batch, **kw)
 
 
 class _StagedBatchC(ctypes.Structure):

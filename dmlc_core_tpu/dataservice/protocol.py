@@ -17,6 +17,10 @@ raw payload verbatim:
   ``FRAME_END``     JSON trailer ``{"blocks": n}`` closing a fetch; a count
                     mismatch means the stream died mid-part and the client
                     must discard and re-fetch
+  ``FRAME_SNAPSHOT`` one packed model snapshot (serving/snapshot.py wire
+                    format) pushed by a training job to a ScoringServer;
+                    the receiver digest-checks the payload before the
+                    atomic model swap (doc/serving.md)
   ``FRAME_ERROR``   JSON ``{"error": msg}``
 
 Deserialization of a STAGED frame goes back through the native codec
@@ -42,6 +46,7 @@ DATA_MAGIC = 0xFF9A
 FRAME_END = 0
 FRAME_BLOCK = 1
 FRAME_STAGED = 2
+FRAME_SNAPSHOT = 3
 FRAME_ERROR = -1
 
 WIRE_HEADER_BYTES = 104  # == DMLCTPU_STAGED_WIRE_HEADER_BYTES

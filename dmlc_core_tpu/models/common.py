@@ -9,6 +9,22 @@ import jax
 import jax.numpy as jnp
 
 
+def count_predict_retrace() -> None:
+    """Bump ``models.predict_retrace`` as a TRACE-TIME side effect.
+
+    Call this from inside a jitted predict body: the Python statement runs
+    only while jax traces the function (once per new input geometry), never
+    on cached-executable calls — so the counter is an exact census of
+    predict recompiles.  Steady-state serving must hold it at zero; see
+    doc/serving.md.
+    """
+    from .. import telemetry
+    try:
+        telemetry.counter_add("models.predict_retrace", 1)
+    except Exception:  # counting must never break tracing
+        pass
+
+
 def logistic_nll(margin: jax.Array, label: jax.Array) -> jax.Array:
     """Per-row binary-cross-entropy from margins, overflow-stable.
 
@@ -50,6 +66,24 @@ class SGDModelMixin:
     def predict(self, params: dict, batch) -> jax.Array:
         m = self.margins(params, batch)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _predict_padded(self, params: dict, batch) -> jax.Array:
+        count_predict_retrace()
+        return self.predict(params, batch)
+
+    def predict_bucketed(self, params: dict, batch,
+                         row_bucket=None, nnz_bucket=None) -> jax.Array:
+        """Geometry-stable predict: pad the batch up to its pow-2
+        (rows, nnz) bucket, score under ONE jit cache entry per bucket,
+        slice back to the real rows.  An ad-hoc request stream then costs
+        O(log(size range)) compiles total instead of one per distinct
+        geometry; ``models.predict_retrace`` counts the traces that do
+        happen.  Real-row outputs are bit-identical to ``predict`` (pad
+        rows have weight 0 / value-0 lanes, inert in the margins)."""
+        from ..data.staging import pad_batch_to_bucket
+        padded = pad_batch_to_bucket(batch, row_bucket, nnz_bucket)
+        return self._predict_padded(params, padded)[:batch.batch_size]
 
     @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
     def train_step(self, params: dict, batch) -> Tuple[dict, jax.Array]:

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from .common import count_predict_retrace
 from ..ops.pallas_segment import (histogram_gh, histogram_gh_sparse_kernel,
                                   segment_sum, sparse_hist_layout)
 
@@ -1548,6 +1549,7 @@ class GBDT:
                         base, row_id, findex, ebin, emask):
         """All-trees sparse margins in ONE jitted fori_loop (the sparse
         mirror of `margins`; one dispatch, XLA-fusable)."""
+        count_predict_retrace()
         rows = base.shape[0]
         rid = row_id.astype(jnp.int32)
         fi = findex.astype(jnp.int32)
@@ -2002,6 +2004,7 @@ class GBDT:
     def _margins_multi_sparse_impl(self, feature, threshold, default_right,
                                    leaf, base, row_id, findex, ebin, emask,
                                    rows_template) -> jax.Array:
+        count_predict_retrace()
         K = self.num_class
         rows = rows_template.shape[0]
 
@@ -2022,6 +2025,29 @@ class GBDT:
                 self.margins_multi_batch(params, batch, binner), axis=1)
         m = self.margins_batch(params, batch, binner)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
+
+    def margins_batch_bucketed(self, params: dict, batch,
+                               binner: QuantileBinner,
+                               row_bucket=None, nnz_bucket=None) -> jax.Array:
+        """Geometry-stable ``margins_batch``: pad the staged batch up to
+        its pow-2 (rows, nnz) bucket before routing, so an ad-hoc request
+        stream reuses one compiled sparse-routing executable per bucket
+        instead of retracing per geometry (``models.predict_retrace``
+        counts the traces).  Real-row margins are bit-identical — padding
+        lanes are value-0 / emask-False and padding rows route to leaves
+        that are sliced away."""
+        from ..data.staging import pad_batch_to_bucket
+        padded = pad_batch_to_bucket(batch, row_bucket, nnz_bucket)
+        return self.margins_batch(params, padded, binner)[:batch.batch_size]
+
+    def predict_batch_bucketed(self, params: dict, batch,
+                               binner: QuantileBinner,
+                               row_bucket=None, nnz_bucket=None) -> jax.Array:
+        """Bucketed-geometry ``predict_batch`` (see
+        :meth:`margins_batch_bucketed`); the serving engine's route."""
+        from ..data.staging import pad_batch_to_bucket
+        padded = pad_batch_to_bucket(batch, row_bucket, nnz_bucket)
+        return self.predict_batch(params, padded, binner)[:batch.batch_size]
 
     def predict_staged(self, params: dict, uri: str,
                        binner: QuantileBinner, batch_size: int = 65536,
@@ -2067,6 +2093,7 @@ class GBDT:
 
     @functools.partial(jax.jit, static_argnums=0)
     def margins(self, params: dict, bins: jax.Array) -> jax.Array:
+        count_predict_retrace()
         # forests checkpointed before default_right existed predict as
         # missing-left everywhere (the exact pre-feature behavior)
         default_right = params.get("default_right")
@@ -2087,6 +2114,7 @@ class GBDT:
         """All softmax trees in ONE jitted fori_loop: tree i accumulates
         into class column i % K via a one-hot outer product (dynamic
         column updates are not fori-friendly)."""
+        count_predict_retrace()
         K = self.num_class
         rows = bins.shape[0]
 
@@ -2113,6 +2141,19 @@ class GBDT:
             return jax.nn.softmax(self.margins_multi(params, bins), axis=1)
         m = self.margins(params, bins)
         return jax.nn.sigmoid(m) if self.objective == "logistic" else m
+
+    def predict_bucketed(self, params: dict, bins: jax.Array,
+                         row_bucket=None) -> jax.Array:
+        """Dense ``predict`` padded up to a pow-2 row bucket — one
+        compiled forest executable per bucket rather than one per distinct
+        row count (padding rows densify to bin 0 and are sliced away)."""
+        from ..data.staging import bucket_pow2
+        rows = bins.shape[0]
+        rb = (bucket_pow2(rows) if row_bucket is None
+              else max(int(row_bucket), rows))
+        if rb != rows:
+            bins = jnp.pad(bins, ((0, rb - rows), (0, 0)))
+        return self.predict(params, bins)[:rows]
 
     def feature_importance(self, params: dict,
                            kind: str = "gain") -> jax.Array:

@@ -1,0 +1,20 @@
+"""Low-latency online scoring (doc/serving.md).
+
+The serving side of the repo: a :class:`ScoringEngine` that scores sparse
+requests against one immutable model snapshot under bucketed static batch
+geometries (no per-request recompiles), a :class:`MicroBatchQueue` that
+trades <=1 ms of queueing for batch occupancy, and a :class:`ScoringServer`
+that exposes ``/score`` next to ``/metrics`` and hot-swaps model snapshots
+pushed from a live training job over the 0xff9a channel — serving never
+restarts; in-flight requests finish on the old model.
+"""
+from .bucketing import ScoringIterator
+from .engine import ScoringEngine
+from .queue import MicroBatchQueue
+from .server import ScoringServer, push_snapshot
+from .snapshot import pack_snapshot, snapshot_digest, unpack_snapshot
+
+__all__ = [
+    "ScoringIterator", "ScoringEngine", "MicroBatchQueue", "ScoringServer",
+    "push_snapshot", "pack_snapshot", "snapshot_digest", "unpack_snapshot",
+]

@@ -1,0 +1,118 @@
+"""ScoringIterator — pack ad-hoc sparse requests into bucketed batches.
+
+The serving mirror of the staging pipeline's static-shape discipline
+(data/staging.py): a request batch of R rows / N nonzeros is packed into
+the pow-2 bucket geometry ``(bucket_pow2(R), bucket_pow2(N))``, so the
+whole request-size range compiles to a logarithmic set of XLA executables
+— predict never retraces in steady state (``models.predict_retrace``).
+
+Host buffers are RECYCLED per geometry: each (rows, nnz) bucket keeps one
+pinned numpy arena that every pack reuses (pad tails rewritten each time,
+no per-request allocation), and the filled arena feeds the same
+``_device_put_maybe_donated`` the training staging path uses, so the host
+->device copy follows the donated-put fast path where the backend has one.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..data.staging import (PaddedBatch, _device_put_maybe_donated,
+                            bucket_pow2)
+
+#: one scoring request row: (indices, values[, fields])
+Request = Sequence
+
+
+class _Arena:
+    """Recycled host buffers for one (rows, nnz, with_field) geometry."""
+
+    __slots__ = ("label", "weight", "row_ptr", "index", "value", "field")
+
+    def __init__(self, rows: int, nnz: int, with_field: bool):
+        self.label = np.zeros(rows, np.float32)
+        self.weight = np.zeros(rows, np.float32)
+        self.row_ptr = np.zeros(rows + 1, np.int32)
+        self.index = np.zeros(nnz, np.int32)
+        self.value = np.zeros(nnz, np.float32)
+        self.field = np.zeros(nnz, np.int32) if with_field else None
+
+
+class ScoringIterator:
+    """Packs streams of sparse request rows into bucketed device batches.
+
+    ``pack(rows)`` accepts a list of ``(index, value)`` or
+    ``(index, value, field)`` tuples (one per scoring row) and returns a
+    device-resident :class:`PaddedBatch` on the row/nnz bucket grid, plus
+    the real row count.  Padding follows every staging invariant: pad rows
+    carry weight 0 and empty spans, pad lanes carry value 0.
+
+    Arena recycling contract (same as the native staging pool): the batch
+    returned by one ``pack()`` is valid until the NEXT ``pack()`` on this
+    iterator — score it and harvest results before packing again.
+    """
+
+    def __init__(self, max_batch: int = 512, min_nnz: int = 8,
+                 with_field: bool = False):
+        self.max_batch = int(max_batch)
+        self.min_nnz = int(min_nnz)
+        self.with_field = bool(with_field)
+        self._arenas: Dict[Tuple[int, int], _Arena] = {}
+        self.packs = 0
+
+    def geometry(self, rows: int, nnz: int) -> Tuple[int, int]:
+        """(row_bucket, nnz_bucket) a request of this size packs into."""
+        return (bucket_pow2(rows, 1, self.max_batch),
+                bucket_pow2(nnz, self.min_nnz))
+
+    def pack(self, rows: List[Request]) -> Tuple[PaddedBatch, int]:
+        if not rows:
+            raise ValueError("pack() of an empty request list")
+        if len(rows) > self.max_batch:
+            raise ValueError(f"{len(rows)} rows exceed max_batch="
+                             f"{self.max_batch}")
+        t0 = time.monotonic_ns()
+        total_nnz = sum(len(r[0]) for r in rows)
+        rb, nb = self.geometry(len(rows), total_nnz)
+        key = (rb, nb)
+        arena = self._arenas.get(key)
+        if arena is None:
+            arena = self._arenas[key] = _Arena(rb, nb, self.with_field)
+            telemetry.counter_add("serve.arena_alloc", 1)
+        # overwrite the live region, zero the pad tails (recycled buffers
+        # may hold the previous pack's data)
+        arena.label[:] = 0.0
+        arena.weight[:len(rows)] = 1.0
+        arena.weight[len(rows):] = 0.0
+        k = 0
+        for r, req in enumerate(rows):
+            idx, val = req[0], req[1]
+            n = len(idx)
+            if n != len(val):
+                raise ValueError(f"row {r}: {n} indices vs "
+                                 f"{len(val)} values")
+            arena.row_ptr[r] = k
+            arena.index[k:k + n] = idx
+            arena.value[k:k + n] = val
+            if arena.field is not None:
+                arena.field[k:k + n] = (req[2] if len(req) > 2 and
+                                        req[2] is not None else 0)
+            k += n
+        arena.row_ptr[len(rows):] = k
+        arena.index[k:] = 0
+        arena.value[k:] = 0.0
+        if arena.field is not None:
+            arena.field[k:] = 0
+        leaves = PaddedBatch(
+            label=arena.label, weight=arena.weight, row_ptr=arena.row_ptr,
+            index=arena.index, value=arena.value,
+            num_rows=np.int32(len(rows)),
+            field=arena.field if arena.field is not None else None)
+        batch = _device_put_maybe_donated(leaves)
+        self.packs += 1
+        telemetry.counter_add("serve.pack_us",
+                              (time.monotonic_ns() - t0) // 1000)
+        return batch, len(rows)

@@ -296,6 +296,17 @@ def stall_attribution(before: dict, after: dict,
             cache_stage["decode_s"] = round(us("cache.codec.decode_us"), 6)
         stages["cache"] = cache_stage
 
+    # online scoring (doc/serving.md): when the interval served /score
+    # traffic (device scoring time or micro-batch queueing moved), a
+    # ``serve`` stage joins the table — busy is time inside the jitted
+    # predict dispatch, wait the requests' time parked in the micro-batch
+    # queue, so a latency-bound server shows up as serve-bound instead of
+    # an idle training pipeline
+    serve_busy, serve_wait = us("serve.score_busy_us"), us("serve.queue_wait_us")
+    if serve_busy or serve_wait or d.get("serve.rows", 0):
+        stages["serve"] = {"busy_s": round(serve_busy, 6),
+                           "wait_s": round(serve_wait, 6)}
+
     sharded = d.get("shard.parts", 0) > 0
     candidates = [n for n in stages if not (sharded and n == "parse")]
     total_busy = sum(stages[n]["busy_s"] for n in candidates)

@@ -20,6 +20,11 @@ One small ThreadingHTTPServer per process serving:
 * ``/dataservice`` — the staging-service LeaseBoard: worker fleet health
   and per-client epoch leases (doc/dataservice.md); tracker endpoints
   only, like ``/shards``.
+* ``POST /score`` — online scoring (doc/serving.md); serving endpoints
+  only: a ``score_provider`` must be attached (the ScoringServer's).
+  With a ``health_gate`` attached, ``/score`` and ``/metrics`` answer
+  503 + Retry-After while a snapshot swap is mid-flight or before the
+  first model loads, instead of hanging.
 
 Workers serve their own process registry; the tracker passes a ``provider``
 returning ``(labels, snapshot)`` pairs so job-wide metrics come out as one
@@ -42,6 +47,13 @@ __all__ = ["serve", "TelemetryServer", "prometheus_text"]
 Provider = Callable[[], List[Tuple[Dict[str, str], dict]]]
 # board provider: () -> {"shards": {...}, "dataservice": {...}}
 BoardProvider = Callable[[], dict]
+# score provider: (request body) -> (status, body, content type); serving
+# endpoints attach one to light up POST /score
+ScoreProvider = Callable[[bytes], Tuple[int, str, str]]
+# health gate: () -> None when healthy, else a reason string; /score and
+# /metrics answer 503 + Retry-After with the reason instead of hanging
+# (snapshot swap mid-flight, no model loaded yet)
+HealthGate = Callable[[], Optional[str]]
 
 
 def _sanitize(name: str) -> str:
@@ -133,10 +145,52 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _gated(self) -> bool:
+        """503 the request when the server's health gate objects (swap in
+        flight / no model loaded).  Returns True when the 503 was sent."""
+        gate = getattr(self.server, "health_gate", None)
+        reason = gate() if gate is not None else None
+        if reason is None:
+            return False
+        self.send_response(503)
+        self.send_header("Retry-After", "1")
+        body = f"unavailable: {reason}\n".encode()
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return True
+
+    def do_POST(self):  # noqa: N802 (http.server contract)
+        try:
+            url = urlparse(self.path)
+            if url.path != "/score":
+                self._send(404, "not found: POST /score\n", "text/plain")
+                return
+            sp = getattr(self.server, "score_provider", None)
+            if sp is None:
+                self._send(404, "no scoring engine on this endpoint "
+                           "(telemetry-only server? a ScoringServer "
+                           "serves /score)\n", "text/plain")
+                return
+            if self._gated():
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            code, text, ctype = sp(body)
+            self._send(code, text, ctype)
+        except Exception as exc:  # a request must never kill the server
+            try:
+                self._send(500, f"error: {exc}\n", "text/plain")
+            except OSError:
+                pass
+
     def do_GET(self):  # noqa: N802 (http.server contract)
         try:
             url = urlparse(self.path)
             if url.path in ("/metrics", "/metrics/"):
+                if self._gated():
+                    return
                 text = prometheus_text(self.server.provider())
                 self._send(200, text, "text/plain; version=0.0.4")
             elif url.path == "/trace":
@@ -182,11 +236,15 @@ class TelemetryServer:
 
     def __init__(self, host: str, port: int,
                  provider: Optional[Provider] = None,
-                 board_provider: Optional[BoardProvider] = None):
+                 board_provider: Optional[BoardProvider] = None,
+                 score_provider: Optional[ScoreProvider] = None,
+                 health_gate: Optional[HealthGate] = None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.provider = provider or _local_provider
         self._httpd.board_provider = board_provider
+        self._httpd.score_provider = score_provider
+        self._httpd.health_gate = health_gate
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -212,9 +270,15 @@ class TelemetryServer:
 
 def serve(port: int = 0, host: str = "127.0.0.1",
           provider: Optional[Provider] = None,
-          board_provider: Optional[BoardProvider] = None) -> TelemetryServer:
+          board_provider: Optional[BoardProvider] = None,
+          score_provider: Optional[ScoreProvider] = None,
+          health_gate: Optional[HealthGate] = None) -> TelemetryServer:
     """Start the endpoint on a daemon thread and return its handle.
     ``port=0`` binds an ephemeral port (read it back via ``.port``).
     ``board_provider`` (tracker endpoints) lights up ``/shards`` and
-    ``/dataservice`` — pass ``MetricsAggregator.board_provider``."""
-    return TelemetryServer(host, port, provider, board_provider)
+    ``/dataservice`` — pass ``MetricsAggregator.board_provider``.
+    ``score_provider``/``health_gate`` (serving endpoints) light up
+    ``POST /score`` and the 503-on-swap contract — a ScoringServer
+    passes its own (doc/serving.md)."""
+    return TelemetryServer(host, port, provider, board_provider,
+                           score_provider, health_gate)

@@ -235,6 +235,14 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # two client processes off a single parse (doc/dataservice.md).
   python -m pytest tests/test_dataservice.py -x -q
 
+  # Serving tier: the online-scoring suite WITHOUT the slow-marker
+  # filter, so the two-process hot-swap proof runs here too — a scoring
+  # server subprocess hammered by client threads while a new snapshot
+  # lands over the wire, every response bit-identical to the snapshot it
+  # names, plus the steady-state zero-retrace census, the 503-never-hang
+  # contracts, and both serving fault points armed (doc/serving.md).
+  python -m pytest tests/test_serving.py -x -q
+
   # Sparse-pallas tier: the sparse COO histogram kernel and its GBDT
   # wiring, slow marks included — the interpret-mode kernel parity suite,
   # the feature-sort determinism + sharded-layout psum cases, and the
@@ -247,5 +255,5 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + sparse-pallas tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + sparse-pallas tier")
 echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + nocodec tier + $py)"

@@ -49,6 +49,87 @@ def test_checkpoint_recordio_container(tmp_path):
     assert b"treedef" in records[0]
 
 
+def _sparse_batch(rows=16, features=24, seed=0, nnz_per=4):
+    import jax.numpy as jnp
+    from dmlc_core_tpu.data.staging import PaddedBatch
+    rng = np.random.RandomState(seed)
+    ptr = np.arange(rows + 1, dtype=np.int32) * nnz_per
+    idx = rng.randint(0, features, rows * nnz_per).astype(np.int32)
+    val = (rng.rand(rows * nnz_per) + 0.1).astype(np.float32)
+    return PaddedBatch(
+        label=jnp.asarray((rng.rand(rows) > 0.5).astype(np.float32)),
+        weight=jnp.ones(rows, jnp.float32),
+        row_ptr=jnp.asarray(ptr), index=jnp.asarray(idx),
+        value=jnp.asarray(val), num_rows=jnp.int32(rows),
+        field=jnp.asarray(idx % 3))
+
+
+def test_every_family_roundtrip_predict_bit_identity(tmp_path):
+    """save -> load -> predict is BIT-identical for every model family —
+    the contract the serving hot-swap path depends on (a snapshot built
+    from restored params must score like the live training job's)."""
+    from dmlc_core_tpu.models import (FactorizationMachine,
+                                      FieldAwareFactorizationMachine)
+    F = 24
+    batch = _sparse_batch(features=F, seed=3)
+    cases = [
+        ("linear", SparseLinearModel(F)),
+        ("fm", FactorizationMachine(F, num_factors=4)),
+        ("ffm", FieldAwareFactorizationMachine(F, num_fields=3,
+                                               num_factors=2)),
+    ]
+    for name, model in cases:
+        params = model.init() if name == "linear" else model.init(seed=7)
+        uri = str(tmp_path / f"{name}.ckpt")
+        checkpoint.save(params, uri)
+        restored = checkpoint.load(uri, like=params)
+        want = np.asarray(model.predict(params, batch))
+        got = np.asarray(model.predict(restored, batch))
+        np.testing.assert_array_equal(got, want), name
+
+
+def test_gbdt_from_bin_cache_roundtrip_bit_identity(tmp_path):
+    """A GBDT fitted from pre-binned (bin-cache) batches checkpoints and
+    predicts bit-identically, and the binner's cuts digest survives a
+    serving-snapshot round trip — so a hot-swapped forest routes on the
+    exact bin vocabulary it trained under."""
+    import jax.numpy as jnp
+    from dmlc_core_tpu.data.binned_cache import BinnedBatch
+    from dmlc_core_tpu.models import GBDT, QuantileBinner
+    from dmlc_core_tpu.serving import pack_snapshot, unpack_snapshot
+    F = 24
+    batch = _sparse_batch(rows=128, features=F, seed=9)
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    binner.partial_fit_sparse(np.asarray(batch.index),
+                              np.asarray(batch.value), F)
+    binner.finalize()
+    # the pre-binned route a bin-cache epoch serves (_entry_bins skips
+    # transform_entries after the digest check)
+    ebin = binner.transform_entries(batch.index, batch.value)
+    binned = BinnedBatch(
+        label=batch.label, weight=batch.weight, row_ptr=batch.row_ptr,
+        index=batch.index, ebin=ebin.astype(jnp.uint8),
+        emask=(batch.value != 0), num_rows=batch.num_rows,
+        cuts_digest=binner.cuts_digest())
+    model = GBDT(num_features=F, num_trees=3, max_depth=3,
+                 missing_aware=True)
+    params = model.fit_batch(binned, binner)
+    uri = str(tmp_path / "gbdt.ckpt")
+    checkpoint.save(params, uri)
+    restored = checkpoint.load(uri, like=params)
+    want = np.asarray(model.predict_batch(params, binned, binner))
+    got = np.asarray(model.predict_batch(restored, binned, binner))
+    np.testing.assert_array_equal(got, want)
+    # cuts digest survives the serving snapshot round trip
+    snap = pack_snapshot("gbdt", {"num_features": F, "num_trees": 3,
+                                  "max_depth": 3, "missing_aware": True},
+                         restored, binner=binner)
+    _, _, params2, binner2 = unpack_snapshot(snap)
+    assert binner2.cuts_digest() == binner.cuts_digest()
+    got2 = np.asarray(model.predict_batch(params2, binned, binner2))
+    np.testing.assert_array_equal(got2, want)
+
+
 def test_ffm_params_checkpoint_roundtrip(tmp_path):
     """The FFM param pytree (3-D factor table included) checkpoints
     through the RecordIO substrate like every other model family."""

@@ -1,0 +1,569 @@
+"""Online scoring path (doc/serving.md).
+
+Fast tier: pow-2 bucket math and padded-batch identity, steady-state
+zero-retrace predict across every model family, request packing with
+recycled arenas, snapshot pack/unpack round trips, micro-batch queue
+correctness under concurrent submitters, the settle/propose/hold queue
+tuner, the /score HTTP surface (400/503 contracts, fault points), and an
+in-process hot swap proving in-flight responses stay bit-identical to
+their snapshot of record.
+
+Slow tier: a two-process train -> push-snapshot -> score run where a
+fresh snapshot lands mid-load and every response remains bit-identical
+to direct scoring against the snapshot it names.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+REPO = Path(__file__).resolve().parent.parent
+
+from dmlc_core_tpu import faultinject, telemetry  # noqa: E402
+from dmlc_core_tpu.data.staging import (PaddedBatch,  # noqa: E402
+                                        bucket_pow2, pad_batch_to_bucket)
+from dmlc_core_tpu.models import (GBDT, FactorizationMachine,  # noqa: E402
+                                  FieldAwareFactorizationMachine,
+                                  QuantileBinner, SparseLinearModel)
+from dmlc_core_tpu.serving import (MicroBatchQueue,  # noqa: E402
+                                   ScoringEngine, ScoringIterator,
+                                   pack_snapshot, push_snapshot,
+                                   snapshot_digest, unpack_snapshot)
+from dmlc_core_tpu.serving.queue import MicroBatchTuner  # noqa: E402
+from dmlc_core_tpu.serving.server import ScoringServer  # noqa: E402
+
+F = 24  # feature space shared by the little fixtures
+
+
+def _sparse_batch(rows, seed=0, nnz_per=4, with_field=False):
+    rng = np.random.RandomState(seed)
+    ptr = np.arange(rows + 1, dtype=np.int32) * nnz_per
+    idx = rng.randint(0, F, rows * nnz_per).astype(np.int32)
+    val = (rng.rand(rows * nnz_per) + 0.1).astype(np.float32)
+    return PaddedBatch(
+        label=jnp.asarray((rng.rand(rows) > 0.5).astype(np.float32)),
+        weight=jnp.ones(rows, jnp.float32),
+        row_ptr=jnp.asarray(ptr), index=jnp.asarray(idx),
+        value=jnp.asarray(val), num_rows=jnp.int32(rows),
+        field=jnp.asarray(idx % 3) if with_field else None)
+
+
+def _linear_engine(seed=0, objective="logistic"):
+    w = np.random.RandomState(seed).randn(F).astype(np.float32)
+    snap = pack_snapshot(
+        "linear", {"num_features": F, "objective": objective},
+        {"w": w, "b": np.float32(0.25)})
+    return ScoringEngine.from_snapshot_bytes(snap), snap
+
+
+def _gbdt_snapshot(seed=0, num_trees=3):
+    binner = QuantileBinner(num_bins=16, missing_aware=True)
+    batch = _sparse_batch(256, seed=seed)
+    binner.partial_fit_sparse(np.asarray(batch.index),
+                              np.asarray(batch.value), F)
+    binner.finalize()
+    model = GBDT(num_features=F, num_trees=num_trees, max_depth=3,
+                 missing_aware=True)
+    params = model.fit_batch(batch, binner)
+    cfg = {"num_features": F, "num_trees": num_trees, "max_depth": 3,
+           "missing_aware": True}
+    return pack_snapshot("gbdt", cfg, params, binner=binner), \
+        model, params, binner
+
+
+def _requests(n, seed=0, nnz=3):
+    rng = np.random.RandomState(seed)
+    return [(sorted(rng.choice(F, nnz, replace=False).tolist()),
+             (rng.rand(nnz) + 0.1).astype(float).tolist())
+            for _ in range(n)]
+
+
+# ---- bucket math + padding invariants --------------------------------------
+
+def test_bucket_pow2():
+    assert [bucket_pow2(n) for n in (1, 2, 3, 5, 8, 9, 1000)] == \
+        [1, 2, 4, 8, 8, 16, 1024]
+    assert bucket_pow2(3, lo=8) == 8
+    assert bucket_pow2(100, hi=64) == 100  # ceiling never truncates data
+    assert bucket_pow2(10, hi=64) == 16
+
+
+def test_pad_batch_to_bucket_invariants():
+    b = _sparse_batch(5, nnz_per=3)  # 5 rows, 15 nnz -> bucket (8, 16)
+    p = pad_batch_to_bucket(b)
+    assert p.batch_size == 8 and p.index.shape[0] == 16
+    assert int(p.num_rows) == 5
+    np.testing.assert_array_equal(np.asarray(p.row_ptr[:6]),
+                                  np.asarray(b.row_ptr))
+    assert np.all(np.asarray(p.row_ptr[6:]) == 15)  # empty pad spans
+    assert np.all(np.asarray(p.weight[5:]) == 0.0)
+    assert np.all(np.asarray(p.value[15:]) == 0.0)
+    # already on-bucket -> returned unchanged
+    q = pad_batch_to_bucket(p)
+    assert q is p
+
+
+def test_padded_predict_bit_identity():
+    """Real-row predictions are BIT-identical after bucket padding, for
+    the margins families and the sparse GBDT route alike."""
+    batch = _sparse_batch(5, seed=3, with_field=True)
+    lin = SparseLinearModel(F)
+    fm = FactorizationMachine(F, num_factors=4)
+    ffm = FieldAwareFactorizationMachine(F, num_fields=3, num_factors=2)
+    for model in (lin, fm, ffm):
+        params = model.init() if model is lin else model.init(seed=1)
+        want = np.asarray(model.predict(params, batch))
+        got = np.asarray(model.predict_bucketed(params, batch))
+        np.testing.assert_array_equal(got, want)
+    snap, model, params, binner = _gbdt_snapshot()
+    want = np.asarray(model.predict_batch(params, batch, binner))
+    got = np.asarray(model.predict_batch_bucketed(params, batch, binner))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_steady_state_zero_retrace():
+    """A mixed-geometry request stream costs one trace per bucket; a
+    second pass over the same mix adds ZERO predict retraces — the
+    acceptance gate for models.predict_retrace."""
+    lin_eng, _ = _linear_engine()
+    gsnap, *_ = _gbdt_snapshot()
+    gb_eng = ScoringEngine.from_snapshot_bytes(gsnap)
+    it = ScoringIterator()
+
+    def one_epoch():
+        for rows, nnz in ((1, 3), (2, 5), (7, 2), (13, 4), (64, 3)):
+            batch, _ = it.pack(_requests(rows, seed=rows, nnz=nnz))
+            lin_eng.score(batch)
+            batch, _ = it.pack(_requests(rows, seed=rows, nnz=nnz))
+            gb_eng.score(batch)
+
+    one_epoch()  # warm the bucket set
+    before = telemetry.counter_get("models.predict_retrace")
+    one_epoch()
+    after = telemetry.counter_get("models.predict_retrace")
+    assert after == before, f"steady-state retraces: {after - before}"
+
+
+def test_retrace_counter_counts_new_geometries():
+    model = SparseLinearModel(F)
+    params = model.init()
+    before = telemetry.counter_get("models.predict_retrace")
+    # a geometry far off any bucket every other test uses
+    model.predict_bucketed(params, _sparse_batch(173, seed=9, nnz_per=11))
+    mid = telemetry.counter_get("models.predict_retrace")
+    assert mid == before + 1
+    model.predict_bucketed(params, _sparse_batch(173, seed=10, nnz_per=11))
+    assert telemetry.counter_get("models.predict_retrace") == mid
+
+
+# ---- request packing -------------------------------------------------------
+
+def test_scoring_iterator_pack_and_arena_recycling():
+    it = ScoringIterator()
+    reqs = [([1, 5], [1.0, 2.0]), ([2, 3, 7], [0.5, 0.25, 4.0])]
+    batch, n = it.pack(reqs)
+    assert n == 2 and batch.batch_size == 2
+    assert batch.index.shape[0] == 8  # 5 nnz -> min_nnz=8 bucket
+    np.testing.assert_array_equal(np.asarray(batch.row_ptr),
+                                  [0, 2, 5])
+    np.testing.assert_array_equal(np.asarray(batch.index),
+                                  [1, 5, 2, 3, 7, 0, 0, 0])
+    np.testing.assert_array_equal(
+        np.asarray(batch.value), [1.0, 2.0, 0.5, 0.25, 4.0, 0, 0, 0])
+    before = telemetry.counter_get("serve.arena_alloc")
+    batch2, _ = it.pack([([4], [9.0]), ([6], [8.0])])  # same geometry
+    assert telemetry.counter_get("serve.arena_alloc") == before
+    np.testing.assert_array_equal(np.asarray(batch2.value),
+                                  [9.0, 8.0, 0, 0, 0, 0, 0, 0])
+
+
+def test_scoring_iterator_rejects_bad_rows():
+    it = ScoringIterator(max_batch=4)
+    with pytest.raises(ValueError):
+        it.pack([])
+    with pytest.raises(ValueError):
+        it.pack([([1, 2], [1.0])])  # index/value length mismatch
+    with pytest.raises(ValueError):
+        it.pack([([1], [1.0])] * 5)  # over max_batch
+
+
+# ---- snapshots -------------------------------------------------------------
+
+def test_snapshot_roundtrip_all_families():
+    batch = _sparse_batch(6, seed=5, with_field=True)
+    cases = [
+        ("linear", SparseLinearModel(F), {"num_features": F}),
+        ("fm", FactorizationMachine(F, num_factors=4),
+         {"num_features": F, "num_factors": 4}),
+        ("ffm", FieldAwareFactorizationMachine(F, num_fields=3,
+                                               num_factors=2),
+         {"num_features": F, "num_fields": 3, "num_factors": 2}),
+    ]
+    for family, model, cfg in cases:
+        params = model.init() if family == "linear" else model.init(seed=2)
+        data = pack_snapshot(family, cfg, params)
+        fam2, cfg2, params2, binner2 = unpack_snapshot(data)
+        assert fam2 == family and binner2 is None
+        want = np.asarray(model.predict(params, batch))
+        got = np.asarray(model.predict(params2, batch))
+        np.testing.assert_array_equal(got, want)
+    snap, model, params, binner = _gbdt_snapshot()
+    fam2, cfg2, params2, binner2 = unpack_snapshot(snap)
+    assert binner2.cuts_digest() == binner.cuts_digest()
+    want = np.asarray(model.predict_batch(params, batch, binner))
+    got = np.asarray(model.predict_batch(params2, batch, binner2))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_snapshot_torn_payload_detected():
+    _, snap = _linear_engine()
+    assert snapshot_digest(snap[:-4]) != snapshot_digest(snap)
+    with pytest.raises(ValueError):
+        unpack_snapshot(snap[:-4])  # truncated
+    with pytest.raises(ValueError):
+        unpack_snapshot(b"junk" + snap)  # bad magic
+
+
+# ---- micro-batch queue -----------------------------------------------------
+
+def test_micro_batch_queue_concurrent_correctness():
+    eng, _ = _linear_engine(seed=4)
+    q = MicroBatchQueue(lambda: eng, max_batch=64, max_delay_us=2000)
+    try:
+        reqs = [_requests(np.random.RandomState(i).randint(1, 5) + 0,
+                          seed=100 + i) for i in range(24)]
+        futs = []
+        errs = []
+
+        def submit(rows):
+            try:
+                futs.append((rows, q.submit(rows)))
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [threading.Thread(target=submit, args=(r,))
+                   for r in reqs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        it = ScoringIterator()
+        for rows, fut in futs:
+            scores, digest, seq = fut.result(timeout=30)
+            assert digest == eng.digest
+            solo, _ = it.pack(rows)
+            np.testing.assert_array_equal(scores, eng.score(solo))
+    finally:
+        q.close()
+
+
+def test_micro_batch_queue_batches():
+    """Requests inside one delay window coalesce into one device batch."""
+    eng, _ = _linear_engine()
+    q = MicroBatchQueue(lambda: eng, max_batch=256, max_delay_us=50000)
+    try:
+        futs = [q.submit(_requests(2, seed=i)) for i in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+        assert q.batches < 8  # coalesced, not one batch per request
+    finally:
+        q.close()
+
+
+def test_micro_batch_tuner_policy():
+    """The queue tuner speaks the AutoTuner dialect: propose a doubling,
+    settle it against the QPS baseline, revert + block on regression,
+    converge after two holds."""
+    q = MicroBatchQueue(lambda: None, max_batch=64, max_delay_us=1000)
+    try:
+        t = MicroBatchTuner(q, margin=0.05, max_max_batch=128,
+                            max_delay_cap_us=1000)
+        r1 = t.decide(1000.0)  # baseline + first step
+        assert r1["action"] == "step" and r1["knob"] == "max_batch"
+        assert q.max_batch == 128
+        r2 = t.decide(500.0)  # 50% regression -> revert
+        assert r2["action"] == "revert" and q.max_batch == 64
+        assert t.reverts == 1
+        # max_batch blocked, max_delay_us at cap -> holds from here on
+        r3 = t.decide(1000.0)
+        r4 = t.decide(1000.0)
+        assert r3["action"] == "hold" and r4["action"] == "hold"
+        assert t.converged
+    finally:
+        q.close()
+
+
+def test_micro_batch_tuner_accepts_improvement():
+    q = MicroBatchQueue(lambda: None, max_batch=32, max_delay_us=1000)
+    try:
+        t = MicroBatchTuner(q, max_max_batch=64, max_delay_cap_us=1000)
+        assert t.decide(1000.0)["action"] == "step"
+        r = t.decide(2000.0)  # better -> accept, nothing left to try
+        assert r["action"] in ("accept", "step")
+        assert q.max_batch == 64 and t.accepts == 1
+    finally:
+        q.close()
+
+
+# ---- HTTP surface ----------------------------------------------------------
+
+def _post(url, body, timeout=30):
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers={"Content-Type":
+                                          "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _score_http(url, rows, timeout=30, retries=50):
+    body = json.dumps({"rows": [{"index": list(map(int, i)),
+                                 "value": list(map(float, v))}
+                                for i, v in rows]}).encode()
+    for _ in range(retries):
+        try:
+            return json.loads(_post(url + "/score", body,
+                                    timeout=timeout).read())
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+            time.sleep(0.05)  # swap mid-flight: retry
+    raise AssertionError("/score stayed 503")
+
+
+def test_scoring_server_http_contracts():
+    with ScoringServer(max_delay_us=200) as srv:
+        url = f"http://127.0.0.1:{srv.http_port}"
+        # 503 (not a hang) before the first snapshot, on BOTH endpoints
+        for path, kw in (("/metrics", {}), ("/score", {"data": b"{}"})):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + path, method="POST" if kw else "GET", **kw),
+                    timeout=10)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After")
+        eng, snap = _linear_engine(seed=7)
+        rep = push_snapshot("127.0.0.1", srv.port, snap)
+        assert rep["ok"] and rep["digest"] == snapshot_digest(snap)
+        rows = _requests(3, seed=42)
+        doc = _score_http(url, rows)
+        assert doc["model"] == snapshot_digest(snap)
+        it = ScoringIterator()
+        solo, _ = it.pack(rows)
+        np.testing.assert_array_equal(
+            np.asarray(doc["scores"], np.float32), eng.score(solo))
+        # /metrics serves again once a model is live
+        text = urllib.request.urlopen(url + "/metrics", timeout=10) \
+            .read().decode()
+        assert "dmlctpu_serve_rows_total" in text
+
+
+def test_scoring_server_malformed_400_never_touches_queue():
+    with ScoringServer(max_delay_us=200) as srv:
+        _, snap = _linear_engine()
+        push_snapshot("127.0.0.1", srv.port, snap)
+        url = f"http://127.0.0.1:{srv.http_port}/score"
+        before_req = telemetry.counter_get("serve.requests")
+        before_mal = telemetry.counter_get("serve.malformed")
+        bad = [b"not json", b"{}", b'{"rows": []}', b'{"rows": "x"}',
+               b'{"rows": [{"index": [1], "value": [1.0, 2.0]}]}',
+               b'{"rows": [{"index": [-1], "value": [1.0]}]}',
+               b'{"rows": [{"index": ["a"], "value": [1.0]}]}']
+        for body in bad:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, body, timeout=10)
+            assert ei.value.code == 400
+        assert telemetry.counter_get("serve.requests") == before_req
+        assert telemetry.counter_get("serve.malformed") == \
+            before_mal + len(bad)
+
+
+def test_scoring_server_malformed_fault_point():
+    with ScoringServer(max_delay_us=200) as srv:
+        _, snap = _linear_engine()
+        push_snapshot("127.0.0.1", srv.port, snap)
+        url = f"http://127.0.0.1:{srv.http_port}/score"
+        good = json.dumps(
+            {"rows": [{"index": [1], "value": [1.0]}]}).encode()
+        faultinject.arm("serving.request.malformed=err@1.0;seed=3")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, good, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            faultinject.arm("")
+        assert json.loads(_post(url, good, timeout=10).read())["scores"]
+
+
+def test_torn_snapshot_push_keeps_old_model():
+    """serving.snapshot.drop: a corrupted push is rejected by digest and
+    the old model keeps serving (the hot-swap safety contract)."""
+    with ScoringServer(max_delay_us=200) as srv:
+        eng, snap = _linear_engine(seed=11)
+        assert push_snapshot("127.0.0.1", srv.port, snap)["ok"]
+        url = f"http://127.0.0.1:{srv.http_port}"
+        before = telemetry.counter_get("serve.swap_rejected")
+        _, snap2 = _linear_engine(seed=12)
+        faultinject.arm("serving.snapshot.drop=corrupt@1.0;seed=5")
+        try:
+            rep = push_snapshot("127.0.0.1", srv.port, snap2, seq=2)
+        finally:
+            faultinject.arm("")
+        assert not rep["ok"] and "digest mismatch" in rep["error"]
+        assert telemetry.counter_get("serve.swap_rejected") == before + 1
+        doc = _score_http(url, _requests(2, seed=1))
+        assert doc["model"] == snapshot_digest(snap)  # old model lives
+
+
+def test_503_during_swap_regression():
+    """While a swap is mid-flight /score and /metrics answer 503
+    immediately (no hang) and recover once the swap lands."""
+    with ScoringServer(max_delay_us=200) as srv:
+        _, snap = _linear_engine()
+        push_snapshot("127.0.0.1", srv.port, snap)
+        url = f"http://127.0.0.1:{srv.http_port}"
+        srv._swapping = True  # pin the gate open
+        t0 = time.monotonic()
+        for path in ("/metrics", "/score"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                if path == "/score":
+                    _post(url + path, b"{}", timeout=10)
+                else:
+                    urllib.request.urlopen(url + path, timeout=10)
+            assert ei.value.code == 503
+        assert time.monotonic() - t0 < 5  # immediate, not a hang
+        srv._swapping = False
+        assert urllib.request.urlopen(url + "/metrics",
+                                      timeout=10).status == 200
+
+
+def test_hot_swap_in_process_bit_identity():
+    """Scores streamed across a swap: every response is bit-identical to
+    direct scoring against the snapshot it names, and both models are
+    observed."""
+    snap_a, *_ = _gbdt_snapshot(seed=21, num_trees=2)
+    snap_b, *_ = _gbdt_snapshot(seed=22, num_trees=3)
+    dig = {snapshot_digest(snap_a): ScoringEngine.from_snapshot_bytes(snap_a),
+           snapshot_digest(snap_b): ScoringEngine.from_snapshot_bytes(snap_b)}
+    with ScoringServer(max_delay_us=500) as srv:
+        assert push_snapshot("127.0.0.1", srv.port, snap_a, seq=1)["ok"]
+        url = f"http://127.0.0.1:{srv.http_port}"
+        rows = _requests(4, seed=77)
+        got = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                got.append(_score_http(url, rows))
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            while len(got) < 5:
+                time.sleep(0.01)
+            assert push_snapshot("127.0.0.1", srv.port, snap_b,
+                                 seq=2)["ok"]
+            deadline = time.time() + 30
+            while time.time() < deadline and not any(
+                    d["model"] == snapshot_digest(snap_b) for d in got):
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        seen = {d["model"] for d in got}
+        assert seen == set(dig), f"saw {seen}"
+        it = ScoringIterator()
+        solo, _ = it.pack(rows)
+        want = {d: e.score(solo) for d, e in dig.items()}
+        for doc in got:
+            np.testing.assert_array_equal(
+                np.asarray(doc["scores"], np.float32), want[doc["model"]])
+
+
+# ---- two-process hot swap (the acceptance proof) ---------------------------
+
+def _spawn_scoring_server():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dmlc_core_tpu.serving.server"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(REPO))
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SCORING_READY"):
+            _, snap_port, http_port = line.split()
+            return proc, int(snap_port), int(http_port)
+        if proc.poll() is not None:
+            break
+    proc.kill()
+    raise AssertionError("scoring server never came up")
+
+
+@pytest.mark.slow
+def test_two_process_hot_swap_bit_identity():
+    """Acceptance: a training job (this process) pushes a fresh snapshot
+    to a scoring-server SUBPROCESS mid-load; no in-flight response is
+    dropped or corrupted — every response matches direct scoring against
+    the snapshot it names, old model included."""
+    snap_a, *_ = _gbdt_snapshot(seed=31, num_trees=2)
+    snap_b, *_ = _gbdt_snapshot(seed=32, num_trees=4)
+    proc = None
+    try:
+        proc, snap_port, http_port = _spawn_scoring_server()
+        url = f"http://127.0.0.1:{http_port}"
+        assert push_snapshot("127.0.0.1", snap_port, snap_a, seq=1)["ok"]
+        rows = _requests(6, seed=55)
+        got = []
+        stop = threading.Event()
+        errs = []
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    got.append(_score_http(url, rows))
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            while len(got) < 10:
+                time.sleep(0.01)
+            # the mid-load push: training finished a better forest
+            assert push_snapshot("127.0.0.1", snap_port, snap_b,
+                                 seq=2)["ok"]
+            deadline = time.time() + 60
+            while time.time() < deadline and not any(
+                    d["model"] == snapshot_digest(snap_b) for d in got):
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert not errs
+        dig = {snapshot_digest(s): ScoringEngine.from_snapshot_bytes(s)
+               for s in (snap_a, snap_b)}
+        seen = {d["model"] for d in got}
+        assert seen == set(dig), f"saw {seen}"  # both models served
+        it = ScoringIterator()
+        solo, _ = it.pack(rows)
+        want = {d: e.score(solo) for d, e in dig.items()}
+        for doc in got:  # NO dropped or corrupted in-flight response
+            np.testing.assert_array_equal(
+                np.asarray(doc["scores"], np.float32), want[doc["model"]])
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=30)
