@@ -533,7 +533,7 @@ import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
 from jax.sharding import Mesh
-from dmlc_core_tpu.parallel import collective_bench
+from dmlc_core_tpu.parallel import collective_bench, collective_sweep
 mesh = Mesh(np.asarray(jax.devices()), ("data",))
 out = collective_bench(mesh, "allreduce", mib_per_device=16.0, iters=5)
 # primary metric goes out FIRST: a failure in the extra ops must never
@@ -546,6 +546,14 @@ for op in ("allgather", "reducescatter", "ppermute"):
                                             iters=3)["bus_gbps"], 3)
     except Exception as e:  # noqa: BLE001
         others[op] = f"error: {str(e)[-120:]}"
+try:
+    # small/large payload sweep: the latency- vs bandwidth-bound regimes
+    others["allreduce_sweep"] = [
+        {"payload_mib": round(r["bytes"] / (1 << 20), 3),
+         "bus_gbps": round(r["bus_gbps"], 3)}
+        for r in collective_sweep(mesh, "allreduce", (0.25, 16.0), iters=3)]
+except Exception as e:  # noqa: BLE001
+    others["allreduce_sweep"] = f"error: {str(e)[-120:]}"
 print("EXTRAS " + json.dumps(others), flush=True)
 """
 
@@ -582,6 +590,167 @@ def run_allreduce() -> dict:
     result["platform"] = "cpu"
     result["note"] = ("single real device: ICI allreduce unavailable; "
                      "measured on a virtual 8-device CPU host mesh")
+    return result
+
+
+def mesh_collective_scaling(devices, counts=None,
+                            payloads_mib=(0.25, 16.0),
+                            iters: int = 5, warmup: int = 2) -> dict:
+    """1->N scale-out curves for the MeshPlan collectives: flat psum vs
+    the hierarchical ppermute route (reduce-scatter -> host tree ->
+    allgather) at a small and a large payload per device count, plus the
+    2-D (host, chip) plan at the full count.
+
+    The hier >= 1.5x flat expectation at the large payload is a SOFT
+    gate: on the virtual CPU mesh every "device" shares one memory bus
+    and XLA's flat psum is a shared-memory reduction, so the hierarchy
+    has no ICI/DCN asymmetry to exploit.  The gate targets real
+    multi-host pods; off-hardware it is reported, never enforced."""
+    from dmlc_core_tpu.parallel import MeshPlan, plan_allreduce_bench
+    devices = list(devices)
+    if counts is None:
+        counts = [n for n in (1, 2, 4, 8) if n <= len(devices)]
+    rows = []
+
+    def row(plan, n, mib, axes):
+        flat = plan_allreduce_bench(plan, strategy="flat",
+                                    mib_per_device=mib, iters=iters,
+                                    warmup=warmup)
+        hier = plan_allreduce_bench(plan, strategy="hier",
+                                    mib_per_device=mib, iters=iters,
+                                    warmup=warmup)
+        rows.append({"devices": n, "axes": axes, "payload_mib": mib,
+                     "flat_bus_gbps": round(flat["bus_gbps"], 3),
+                     "hier_bus_gbps": round(hier["bus_gbps"], 3)})
+
+    for n in counts:
+        plan = MeshPlan.build(devices=devices[:n])
+        for mib in payloads_mib:
+            row(plan, n, mib, list(plan.axes))
+    nmax = counts[-1]
+    if nmax >= 4:  # 2-D (host, chip) plan: the hierarchical route's home
+        plan2 = MeshPlan.build(devices=devices[:nmax], hosts=2)
+        for mib in payloads_mib:
+            row(plan2, nmax, mib, list(plan2.axes))
+    big = max(payloads_mib)
+    large = [r for r in rows
+             if r["devices"] == nmax and r["payload_mib"] == big
+             and r["flat_bus_gbps"] > 0]
+    ratio = max((r["hier_bus_gbps"] / r["flat_bus_gbps"] for r in large),
+                default=0.0)
+    out = {"platform": devices[0].platform, "devices": nmax,
+           "rows": rows, "hier_vs_flat_large": round(ratio, 3),
+           "hier_gate_ok": ratio >= 1.5}
+    if not out["hier_gate_ok"]:
+        out["hier_gate_note"] = (
+            "soft gate: hier < 1.5x flat at the large payload — expected "
+            "off-hardware (virtual CPU mesh has no ICI/DCN asymmetry; "
+            "flat psum is a shared-memory reduction)")
+    return out
+
+
+def mesh_gbdt_scaling(devices, histogram: str = "xla", counts=None,
+                      rows: int = 40960, num_features: int = 16,
+                      num_bins: int = 64, trees: int = 3,
+                      depth: int = 5) -> dict:
+    """Trees/s scaling curve for the plan-routed GBDT fit over 1->N
+    devices, plus the chunked-overlap A/B at the full count.  The
+    overlap route (DMLCTPU_MESH_OVERLAP_CHUNKS > 1) must keep the
+    forest BIT-identical to the unchunked explicit route — checked here
+    on every run, not just in tests."""
+    import time
+
+    import numpy as np
+
+    import jax
+
+    from dmlc_core_tpu.models import GBDT
+    from dmlc_core_tpu.parallel import MeshPlan
+    devices = list(devices)
+    if counts is None:
+        counts = [n for n in (1, 2, 4, 8) if n <= len(devices)]
+    rng = np.random.default_rng(5)
+    # pre-binned u8 codes, as QuantileBinner.transform would hand over —
+    # GBDT.fit takes bin codes, not raw features
+    x = rng.integers(0, num_bins, (rows, num_features)).astype(np.uint8)
+    y = (rng.random(rows) < 0.5).astype(np.float32)
+
+    def fit_rate(plan):
+        m = GBDT(num_features=num_features, num_trees=trees,
+                 max_depth=depth, num_bins=num_bins, learning_rate=0.4,
+                 histogram=histogram, histogram_mesh=plan)
+        b = jax.device_put(x, plan.data_sharding())
+        lab = jax.device_put(y, plan.data_sharding())
+        jax.block_until_ready(m.fit(b, lab)["leaf"])  # warmup/compile
+        t0 = time.monotonic()
+        forest = m.fit(b, lab)
+        jax.block_until_ready(forest["leaf"])
+        return round(rows * trees / (time.monotonic() - t0)), forest
+
+    out = {"rows": rows, "platform": devices[0].platform,
+           "histogram": histogram, "scaling": []}
+    nmax = counts[-1]
+    f1 = None
+    for n in counts:
+        plan = MeshPlan.build(devices=devices[:n], overlap_chunks=1)
+        rate, forest = fit_rate(plan)
+        out["scaling"].append({"devices": n, "row_trees_s": rate})
+        if n == nmax:
+            out["row_trees_s_unchunked"], f1 = rate, forest
+    plan_k4 = MeshPlan.build(devices=devices[:nmax], overlap_chunks=4)
+    rate4, f4 = fit_rate(plan_k4)
+    out["row_trees_s_overlap"] = rate4
+    out["overlap_chunks"] = plan_k4.overlap_chunks
+    out["overlap_forest_identical"] = all(
+        bool((np.asarray(f1[k]) == np.asarray(f4[k])).all())
+        for k in ("feature", "threshold", "leaf"))
+    return out
+
+
+_MESH_CHILD = r"""
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import bench
+devices = jax.devices()
+# collectives first: a slow GBDT sweep must never cost the bandwidth rows
+out = bench.mesh_collective_scaling(devices, iters=3)
+print("MESHSCALE " + json.dumps(out), flush=True)
+out = bench.mesh_gbdt_scaling(devices, histogram="xla")
+print("MESHGBDT " + json.dumps(out), flush=True)
+"""
+
+
+def run_mesh_virtual() -> dict:
+    """Scale-out fallback on the virtual 8-device CPU host mesh — real
+    1->N bus-GB/s and trees/s rows every round, even on a one-chip rig.
+    Subprocess-isolated for the same reason as ``run_allreduce``: the
+    forced host platform must not leak into the parent's jax."""
+    note = ("virtual 8-device CPU host mesh (one real device); curves "
+            "show plan routing, not ICI bandwidth")
+    result: dict = {"gbdt_mesh": {}, "mesh_scaleout": {}}
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run([sys.executable, "-c", _MESH_CHILD],
+                              capture_output=True, text=True, timeout=600,
+                              env=env, cwd=str(REPO))
+        for line in proc.stdout.splitlines():
+            if line.startswith("MESHSCALE "):
+                result["mesh_scaleout"] = json.loads(line[len("MESHSCALE "):])
+            elif line.startswith("MESHGBDT "):
+                result["gbdt_mesh"] = json.loads(line[len("MESHGBDT "):])
+        if not result["mesh_scaleout"] and not result["gbdt_mesh"]:
+            result = {"gbdt_mesh": {"error": proc.stderr[-300:]},
+                      "mesh_scaleout": {"error": proc.stderr[-300:]}}
+    except subprocess.TimeoutExpired:
+        err = {"error": "virtual mesh scale-out timed out"}
+        result = {"gbdt_mesh": dict(err), "mesh_scaleout": dict(err)}
+    for sub in result.values():
+        sub["note"] = note
     return result
 
 
@@ -1650,43 +1819,32 @@ phase("allreduce", real_allreduce)
 phase("models", bench.run_models)
 
 def gbdt_mesh():
-    # sharded-kernel route (histogram_mesh): only meaningful with >=2 real
-    # TPU devices — each chip builds its row shard's histogram with the
-    # Pallas kernel under shard_map, explicit psum over ICI.  Skips on this
-    # one-chip rig; auto-runs (xla vs pallas row-trees/s) when a real
-    # multi-chip mesh appears.  Parity is pinned off-hardware by
-    # tests/test_gbdt.py::test_sharded_pallas_fit_matches_xla_fit.
-    import numpy as np
-    import time
+    # plan-routed scale-out: 1->N trees/s via MeshPlan (each chip builds
+    # its row shard's histogram with the Pallas kernel under the plan's
+    # shard_map, plan.allreduce over ICI) plus the chunked-overlap A/B at
+    # full count.  Only meaningful with >=2 real TPU devices; skips on
+    # this one-chip rig (the parent falls back to the virtual host mesh).
+    # Parity is pinned off-hardware by tests/test_meshplan.py.
     devices = jax.devices()
     if len(devices) < 2 or devices[0].platform != "tpu":
         return {"skipped": f"{len(devices)} {devices[0].platform} device(s)",
                 "platform": devices[0].platform}
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from dmlc_core_tpu.models import GBDT, QuantileBinner
-    rng = np.random.default_rng(5)
-    rows, F = 100_000 // len(devices) * len(devices), 28
-    x = rng.standard_normal((rows, F)).astype(np.float32)
-    y = (rng.random(rows) < 0.5).astype(np.float32)
-    bins_host = np.asarray(QuantileBinner(num_bins=256).fit_transform(x))
-    mesh = Mesh(np.asarray(devices), ("data",))
-    sh = NamedSharding(mesh, P("data"))
-    bins_d = jax.device_put(bins_host, sh)
-    y_d = jax.device_put(y, sh)
-    out = {"rows": rows, "devices": len(devices), "platform": "tpu"}
-    for impl, kw in (("xla", {"histogram": "xla"}),
-                     ("pallas", {"histogram": "pallas",
-                                 "histogram_mesh": (mesh, "data")})):
-        m = GBDT(num_features=F, num_trees=5, max_depth=6, num_bins=256,
-                 learning_rate=0.4, **kw)
-        jax.block_until_ready(m.fit(bins_d, y_d)["leaf"])  # warmup/compile
-        t0 = time.monotonic()
-        p = m.fit(bins_d, y_d)
-        jax.block_until_ready(p["leaf"])
-        out[f"row_trees_s_{impl}"] = round(
-            rows * m.num_trees / (time.monotonic() - t0))
-    return out
+    return bench.mesh_gbdt_scaling(devices, histogram="pallas",
+                                   rows=100_000 // len(devices) * len(devices),
+                                   num_features=28, num_bins=256,
+                                   trees=5, depth=6)
 phase("gbdt_mesh", gbdt_mesh)
+
+def mesh_scaleout():
+    # 1->N bus-GB/s curves, flat psum vs hierarchical RS->tree->AG, small
+    # and large payloads — the hier >= 1.5x gate is only meaningful here,
+    # on a real multi-chip fabric
+    devices = jax.devices()
+    if len(devices) < 2 or devices[0].platform != "tpu":
+        return {"skipped": f"{len(devices)} {devices[0].platform} device(s)",
+                "platform": devices[0].platform}
+    return bench.mesh_collective_scaling(devices)
+phase("mesh_scaleout", mesh_scaleout)
 phase("gbdt", bench.run_gbdt)
 """
 
@@ -1871,6 +2029,20 @@ def main() -> None:
     if "bus_gbps" not in allreduce:  # no real multi-device mesh: CPU fallback
         allreduce = run_allreduce()
     log(f"[bench] allreduce: {allreduce}")
+    # mesh scale-out: real rows every round — when the TPU child skipped
+    # (one chip) or never ran, fall back to the virtual 8-device host mesh
+    gbdt_mesh = phases.get("gbdt_mesh") or {}
+    mesh_scaleout = phases.get("mesh_scaleout") or {}
+    if "scaling" not in gbdt_mesh or "rows" not in mesh_scaleout:
+        virt = run_mesh_virtual()
+        if "scaling" not in gbdt_mesh:
+            gbdt_mesh = virt["gbdt_mesh"]
+        if "rows" not in mesh_scaleout:
+            mesh_scaleout = virt["mesh_scaleout"]
+    log(f"[bench] gbdt mesh scaling: {gbdt_mesh}")
+    log(f"[bench] collective scale-out: {mesh_scaleout}")
+    if mesh_scaleout.get("hier_gate_ok") is False:
+        log("[bench] WARN " + mesh_scaleout.get("hier_gate_note", ""))
     tpu_best = load_tpu_best() or None
 
     probe = probe_tpu()
@@ -1927,7 +2099,8 @@ def main() -> None:
             "sparse_row_trees_s"),
         "gbdt_sparse_hist_ab": phases.get("gbdt", {}).get("sparse_hist_ab"),
         "gbdt_platform": phases.get("gbdt", {}).get("platform"),
-        "gbdt_mesh": phases.get("gbdt_mesh"),
+        "gbdt_mesh": gbdt_mesh,
+        "mesh_scaleout": mesh_scaleout,
         "h2d_gbps_single_chip": phases.get("h2d", {}).get("gbps"),
         "h2d_platform": phases.get("h2d", {}).get("platform"),
         "pallas_segment": phases.get("pallas_segment"),
@@ -1967,6 +2140,11 @@ def main() -> None:
         "gbdt_sparse_hist_max_abs_err": (
             gbdt.get("sparse_hist_ab") or {}).get("max_abs_err"),
         "allreduce_bus_gbps": full["allreduce_bus_gbps"],
+        "mesh_hier_vs_flat": mesh_scaleout.get("hier_vs_flat_large"),
+        "gbdt_mesh_trees_s": [r.get("row_trees_s") for r in
+                              gbdt_mesh.get("scaling", [])] or None,
+        "gbdt_mesh_overlap_identical": gbdt_mesh.get(
+            "overlap_forest_identical"),
         "h2d_gbps": full["h2d_gbps_single_chip"],
         "staging_platform": full["staging_platform"],
         "stall": (full["stall_attribution"] or {}).get("table"),

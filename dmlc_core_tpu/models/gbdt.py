@@ -567,24 +567,31 @@ class GBDT:
         if histogram not in ("auto", "xla", "pallas"):
             raise ValueError("histogram must be 'auto', 'xla' or 'pallas'")
         self.histogram = histogram
-        # (jax.sharding.Mesh, axis_name): the explicit multi-device kernel
-        # route.  When set, levels whose backend resolves to "pallas" build
-        # the histogram via shard_map(local pallas kernel) + psum over the
-        # named axis instead of relying on GSPMD to partition segment_sum —
-        # pallas_call has no auto-partitioning rule, so this is the ONLY
-        # way the kernel can serve a row-sharded fit.  fit() inputs must be
-        # sharded over that axis, and shard_map's even-sharding rule
-        # applies: rows must divide by the axis size (the GSPMD/XLA route
-        # tolerates uneven rows; staged PaddedBatch pipelines sized to
-        # the mesh satisfy this by construction).  Tests pin
-        # interpret-mode parity on the 8-device CPU mesh;
-        # tests/test_pallas.py proves the route itself.
+        # The explicit multi-device kernel route.  Accepts a
+        # parallel.MeshPlan, a bare Mesh, or the legacy (mesh, axis_name)
+        # tuple (adapted via MeshPlan.from_spec).  When set, levels whose
+        # backend resolves to "pallas" build the histogram via
+        # shard_map(local pallas kernel) + the plan's allreduce (flat
+        # psum or hierarchical by payload) instead of relying on GSPMD
+        # to partition segment_sum — pallas_call has no auto-partitioning
+        # rule, so this is the ONLY way the kernel can serve a
+        # row-sharded fit.  A plan with overlap_chunks > 1 additionally
+        # routes XLA levels through the explicit chunked
+        # collective/compute-overlap path (see _level_histogram).
+        # fit() inputs must be sharded over the plan axes, and
+        # shard_map's even-sharding rule applies: rows must divide by
+        # the shard count (the GSPMD/XLA route tolerates uneven rows;
+        # staged PaddedBatch pipelines sized to the mesh satisfy this by
+        # construction).  Tests pin interpret-mode parity on the
+        # 8-device CPU mesh; tests/test_pallas.py proves the route
+        # itself, tests/test_meshplan.py the plan adapter and overlap.
         if histogram_mesh is not None:
-            mesh, axis = histogram_mesh  # unpack early: fail loudly
-            if axis not in mesh.axis_names:
-                raise ValueError(f"histogram_mesh axis {axis!r} not in "
-                                 f"mesh axes {mesh.axis_names}")
-        self.histogram_mesh = histogram_mesh
+            from ..parallel.meshplan import MeshPlan
+            self.mesh_plan = MeshPlan.from_spec(histogram_mesh)
+            self.histogram_mesh = self.mesh_plan.legacy_spec
+        else:
+            self.mesh_plan = None
+            self.histogram_mesh = None
         self._grad_hess = (_logistic_grad_hess if objective == "logistic"
                            else _squared_grad_hess)
 
@@ -640,38 +647,76 @@ class GBDT:
         """Per-level [nodes, F, bins, 2] histogram with backend routing.
 
         Plain ``histogram_gh`` call normally (GSPMD partitions the XLA
-        path and inserts the psum on sharded fits).  With
-        ``histogram_mesh=(mesh, axis)`` set and the level resolving to
-        the Pallas backend, the kernel runs per-device on local row
-        shards under ``jax.shard_map`` and the shards combine with an
-        explicit ``psum`` over the axis — the rabit histogram-allreduce
-        with the custom kernel on the device side (pattern proven by
+        path and inserts the psum on sharded fits).  With a mesh plan
+        set and the level resolving to the Pallas backend — or the plan
+        asking for overlap (``overlap_chunks > 1``) — the kernel runs
+        per-device on local row shards under ``jax.shard_map`` and the
+        shards combine with the plan's allreduce (flat psum or
+        hierarchical by payload; pattern proven by
         tests/test_pallas.py::test_histogram_gh_shardmap_psum_matches_global).
+
+        Overlap: with K = overlap_chunks > 1 the feature axis splits
+        into K chunks and the reduce of chunk k is issued before the
+        local histogram of chunk k+1 is built, so the collective for
+        chunk k overlaps the MXU contraction of chunk k+1 (XLA
+        schedules the independent reduce and compute concurrently;
+        double-buffered — at most one reduction in flight).  Forests
+        are bit-identical to the unchunked route: per-feature histogram
+        columns are computed independently with the row-reduction order
+        unchanged, and chunking an elementwise cross-device reduce
+        reorders nothing (tests/test_meshplan.py pins this).
+        ``mesh.overlap_occupancy`` publishes the structural overlap
+        fraction (K-1)/K in permille at trace time.
         """
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.collective import shard_map_compat
-
         impl = self._hist_impl(n_nodes)
         B = self.num_bins
-        if impl == "pallas" and self.histogram_mesh is not None:
-            mesh, axis = self.histogram_mesh
+        plan = self.mesh_plan
+        K = 1 if plan is None else min(plan.overlap_chunks,
+                                       self.num_features)
+        # explicit shard_map route: always for the pallas kernel (no
+        # GSPMD partitioning rule) and for any freshly-built plan;
+        # legacy tuple adapters (prefer_gspmd) keep their pre-plan
+        # GSPMD behavior on XLA levels unless overlap is requested
+        if plan is not None and (impl == "pallas" or K > 1
+                                 or not plan.prefer_gspmd):
 
             def local(b, r, g):
-                h = histogram_gh(b, r, g, n_nodes, B, force="pallas")
-                return jax.lax.psum(h, axis)
+                if K <= 1:
+                    return plan.allreduce(
+                        histogram_gh(b, r, g, n_nodes, B, force=impl))
+                F = b.shape[1]
+                bounds = [(F * k // K, F * (k + 1) // K)
+                          for k in range(K)]
+                outs, pending = [], None
+                for f0, f1 in bounds:
+                    if f0 == f1:
+                        continue
+                    hk = histogram_gh(b[:, f0:f1], r, g, n_nodes, B,
+                                      force=impl)
+                    if pending is not None:
+                        outs.append(plan.allreduce(pending))
+                    pending = hk
+                outs.append(plan.allreduce(pending))
+                return jnp.concatenate(outs, axis=1)
 
+            try:
+                telemetry.gauge_set("mesh.overlap_occupancy",
+                                    (K - 1) * 1000 // K)
+            except Exception:
+                pass
             # replication check off: pallas_call's out_shape carries no
             # varying-axes annotation, so the static check cannot see
-            # through it; the psum replicates the output regardless.
-            # NOTE shard_map's even-sharding rule: rows must divide by
-            # the mesh axis size (see the histogram_mesh ctor comment).
-            spec = P(axis)
-            return shard_map_compat(local, mesh,
-                                    in_specs=(spec, spec, spec),
-                                    out_specs=P(),
-                                    check_replication=False)(
-                                        bins_i, rel, gh)
+            # through it; the allreduce replicates the output
+            # regardless.  NOTE shard_map's even-sharding rule: rows
+            # must divide by the shard count (see the histogram_mesh
+            # ctor comment).
+            spec = plan.row_spec
+            return plan.shard_map(local, in_specs=(spec, spec, spec),
+                                  out_specs=P(),
+                                  check_replication=False)(
+                                      bins_i, rel, gh)
         return histogram_gh(bins_i, rel, gh, n_nodes, B, force=impl)
 
     # The sparse-kernel analogue of _PALLAS_NODE_LIMIT.  The sparse
@@ -731,10 +776,8 @@ class GBDT:
         never mesh-sharded)."""
         if not self._sparse_layout_enabled(streamed):
             return None
-        num_shards = 1
-        if self.histogram_mesh is not None:
-            mesh, axis = self.histogram_mesh
-            num_shards = mesh.shape[axis]
+        num_shards = (1 if self.mesh_plan is None
+                      else self.mesh_plan.num_shards)
         t0 = time.monotonic()
         layout = sparse_hist_layout(row_id, findex, ebin, emask,
                                     self.num_features, self.num_bins,
@@ -755,21 +798,20 @@ class GBDT:
         changes per level) feed one kernel call.  With ``histogram_mesh``
         the packed per-shard layout slices ride ``shard_map`` ``P(axis)``
         in_specs, each device runs the kernel on its local rows' entries,
-        and an explicit psum combines the shards — the same
-        rabit-histogram-allreduce shape as the dense `_level_histogram`
-        route (the per-tree gh gather moves inside the shard_map body
-        there, since gh is only device-local under the mesh)."""
+        and the plan's allreduce (flat psum or hierarchical by payload)
+        combines the shards — the same rabit-histogram-allreduce shape
+        as the dense `_level_histogram` route (the per-tree gh gather
+        moves inside the shard_map body there, since gh is only
+        device-local under the mesh)."""
         F, B = self.num_features, self.num_bins
         try:
             telemetry.counter_add("gbdt.hist_sparse_pallas", 1)
         except Exception:
             pass
-        if self.histogram_mesh is not None:
+        if self.mesh_plan is not None:
             from jax.sharding import PartitionSpec as P
 
-            from ..parallel.collective import shard_map_compat
-
-            mesh, axis = self.histogram_mesh
+            plan = self.mesh_plan
             mt = layout.max_tiles
 
             def local(gk, rid_l, w_l, ts, tc, rel_l, gh_l):
@@ -777,12 +819,12 @@ class GBDT:
                 ghe = gh_l[rid_l].astype(jnp.float32) * w_l[:, None]
                 h = histogram_gh_sparse_kernel(gk, rel_e, ghe, ts, tc,
                                                n_nodes, F, B, mt)
-                return jax.lax.psum(h, axis)
+                return plan.allreduce(h)
 
-            spec = P(axis)
-            return shard_map_compat(local, mesh,
-                                    in_specs=(spec,) * 7, out_specs=P(),
-                                    check_replication=False)(
+            spec = plan.row_spec
+            return plan.shard_map(local,
+                                  in_specs=(spec,) * 7, out_specs=P(),
+                                  check_replication=False)(
                 layout.gkey, layout.rid, layout.w,
                 layout.tstart, layout.tcount, rel, gh_row)
         rel_e = rel[layout.rid]

@@ -25,7 +25,7 @@ class SparseLinearModel(SGDModelMixin):
 
     def __init__(self, num_features: int, objective: str = "logistic",
                  l2: float = 0.0, learning_rate: float = 0.1,
-                 sdot_backend: str | None = None):
+                 sdot_backend: str | None = None, mesh_plan=None):
         if objective not in ("logistic", "squared"):
             raise ValueError(f"unknown objective '{objective}'")
         check_force(sdot_backend, "sdot_backend")
@@ -37,6 +37,12 @@ class SparseLinearModel(SGDModelMixin):
         # GSPMD-safe scatter-add; "pallas" = scatter-free kernel,
         # single-device TPU only (no pallas partitioning rule)
         self.sdot_backend = sdot_backend
+        # parallel.MeshPlan / Mesh / legacy (mesh, axis) tuple: owns
+        # device placement for the psum path — replicate params with
+        # place_params(), shard batches with batch_sharding(), and the
+        # jitted train_step's gradient reduction becomes the psum over
+        # the plan axes
+        self._set_mesh_plan(mesh_plan)
 
     def init(self, seed: int = 0) -> dict:
         del seed  # linear model: zero init is canonical

@@ -1,10 +1,13 @@
 """Distributed execution: device meshes, collectives, multi-host bootstrap."""
 from .mesh import make_mesh, data_sharding, replicated_sharding
-from .collective import allreduce, allreduce_bench, collective_bench
+from .collective import (allreduce, allreduce_bench, collective_bench,
+                         collective_sweep)
+from .meshplan import MeshPlan, plan_allreduce_bench
 from .bootstrap import init_from_env, dmlc_env_info
 
 __all__ = [
     "make_mesh", "data_sharding", "replicated_sharding",
-    "allreduce", "allreduce_bench", "collective_bench",
+    "allreduce", "allreduce_bench", "collective_bench", "collective_sweep",
+    "MeshPlan", "plan_allreduce_bench",
     "init_from_env", "dmlc_env_info",
 ]

@@ -9,7 +9,7 @@ bus bandwidth (BASELINE config 4).
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Sequence
 
 import numpy as np
 
@@ -58,13 +58,26 @@ def allreduce(x: jax.Array, op: str = "sum", axis_name: str = "data") -> jax.Arr
     return fn(x, axis_name)
 
 
-def allreduce_bench(mesh: Mesh, mib_per_device: float = 64.0, iters: int = 10) -> dict:
+def allreduce_bench(mesh: Mesh, mib_per_device: float = 64.0,
+                    iters: int = 10, warmup: int = 2) -> dict:
     """Measure all-reduce bus bandwidth over the mesh's ``data`` axis.
 
     Returns {bytes, seconds_per_iter, algo_gbps, bus_gbps}.  Bus bandwidth
     uses the standard 2(n-1)/n ring factor.
     """
-    return collective_bench(mesh, "allreduce", mib_per_device, iters)
+    return collective_bench(mesh, "allreduce", mib_per_device, iters,
+                            warmup=warmup)
+
+
+def collective_sweep(mesh: Mesh, op: str = "allreduce",
+                     payloads_mib: Sequence[float] = (0.25, 16.0),
+                     iters: int = 10, warmup: int = 2) -> list:
+    """``collective_bench`` at several payload sizes — the small/large
+    sweep that makes the latency-vs-bandwidth regimes (and the
+    hierarchical-vs-flat crossover, when compared against
+    ``meshplan.plan_allreduce_bench``) visible in one DETAIL row."""
+    return [collective_bench(mesh, op, mib, iters, warmup=warmup)
+            for mib in payloads_mib]
 
 
 # per-op (kernel builder, out spec, algbw size base as a function of the
@@ -104,12 +117,14 @@ def _kernels(n):
 
 
 def collective_bench(mesh: Mesh, op: str = "allreduce",
-                     mib_per_device: float = 64.0, iters: int = 10) -> dict:
+                     mib_per_device: float = 64.0, iters: int = 10,
+                     warmup: int = 2) -> dict:
     """Bandwidth of one XLA collective over the mesh's ``data`` axis — the
     ICI/DCN data plane the reference's TCP tree+ring bootstrap hands off
     to (SURVEY §5 'distributed communication backend').
 
     op: "allreduce" | "allgather" | "reducescatter" | "ppermute".
+    Compile lands in the explicit ``warmup`` calls, never the timed loop.
     Returns {devices, bytes, seconds_per_iter, algo_gbps, bus_gbps, op}.
     """
     kernels = _kernels(mesh.devices.size)
@@ -135,7 +150,8 @@ def collective_bench(mesh: Mesh, op: str = "allreduce",
         np.random.default_rng(0).standard_normal((n * nfloats,),
                                                  dtype=np.float32),
         NamedSharding(mesh, P("data")))
-    step(x).block_until_ready()  # warmup + compile
+    for _ in range(max(1, warmup)):  # compile + steady-state warmup
+        step(x).block_until_ready()
     watch = Stopwatch()
     for _ in range(iters):
         out = step(x)

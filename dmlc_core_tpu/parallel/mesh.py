@@ -23,16 +23,30 @@ def make_mesh(axis_sizes: Optional[Sequence[int]] = None,
 
     Default: 1-D ``data`` mesh over every addressable-or-global device —
     the dmlc data-parallel world.  Pass e.g. ``axis_sizes=(4, 2)``,
-    ``axis_names=('data', 'model')`` for richer layouts.
+    ``axis_names=('data', 'model')`` for richer layouts.  A 2-axis mesh
+    with no explicit sizes defaults to ``(hosts, devices_per_host)``
+    when the process topology (jax.distributed / the DMLC_* bootstrap)
+    reports more than one host.  Sizes that don't factor the available
+    devices raise — never a silently wrong mesh.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if axis_sizes is None:
-        axis_sizes = (n,) if len(axis_names) == 1 else None
-    assert axis_sizes is not None, "axis_sizes required for multi-axis meshes"
-    assert int(np.prod(axis_sizes)) == n, (
-        f"mesh {tuple(axis_sizes)} does not cover {n} devices")
+        if len(axis_names) == 1:
+            axis_sizes = (n,)
+        elif len(axis_names) == 2:
+            hosts = len({d.process_index for d in devices})
+            if hosts > 1 and n % hosts == 0:
+                axis_sizes = (hosts, n // hosts)
+    if axis_sizes is None:
+        raise ValueError(
+            f"axis_sizes required for mesh axes {tuple(axis_names)} over "
+            f"{n} single-host device(s)")
+    if int(np.prod(axis_sizes)) != n:
+        raise ValueError(
+            f"mesh axis_sizes {tuple(axis_sizes)} do not factor the {n} "
+            f"available device(s)")
     dev_array = np.asarray(devices).reshape(axis_sizes)
     return Mesh(dev_array, axis_names)
 

@@ -31,6 +31,7 @@ REGISTERED = [
     "cpp/src/data/binned_cache.h",
     "cpp/include/dmlctpu/threaded_iter.h",
     "cpp/src/data/text_parser.h",
+    "dmlc_core_tpu/parallel/meshplan.py",
 ]
 
 ATOMIC_OP_RE = re.compile(

@@ -252,8 +252,17 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
     -k "sparse or empty_shard" -m ""
   python -m pytest tests/test_gbdt.py -x -q \
     -k "sparse_fit_batch_pallas or streamed_pallas or sharded_fit_batch_pallas or histogram_env_knob" -m ""
+
+  # Mesh tier: the MeshPlan suite under the forced 8-device host platform
+  # (conftest.py pins it for every pytest run, made explicit here because
+  # this tier is meaningless without it) — hierarchical-vs-flat allreduce
+  # parity on the 1-D and 2-D virtual meshes, the topology/knob surface,
+  # the (mesh, axis) tuple adapter, and the chunked-overlap forest
+  # bit-identity contract (doc/mesh.md).
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    JAX_PLATFORMS=cpu python -m pytest tests/test_meshplan.py -x -q
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + sparse-pallas tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + sparse-pallas tier + mesh tier")
 echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + nocodec tier + $py)"

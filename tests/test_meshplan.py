@@ -1,0 +1,202 @@
+"""MeshPlan: topology discovery, collective routing, GBDT plan paths.
+
+Everything runs on the conftest-forced virtual 8-device CPU mesh, so the
+hierarchical ppermute route, the 2-D (host, chip) plan, and the chunked
+level-loop overlap are all exercised without TPU hardware.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dmlc_core_tpu.models import GBDT, QuantileBinner
+from dmlc_core_tpu.parallel import MeshPlan, make_mesh, plan_allreduce_bench
+
+
+# ---------------------------------------------------------------------------
+# collectives: hierarchical route vs flat psum
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("hosts", [None, 2])
+@pytest.mark.parametrize("op", ["sum", "max", "mean"])
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 0.05)])
+def test_hier_allreduce_matches_flat(hosts, op, dtype, tol):
+    plan = MeshPlan.build(hosts=hosts)
+    assert plan.num_shards == 8
+    rng = np.random.default_rng(0)
+    # 513 elements per shard: not divisible by the ring size, so the
+    # pad-to-c-blocks path is on the line too
+    x = jnp.asarray(rng.standard_normal((plan.num_shards * 513,)), dtype)
+
+    def body(v):
+        return (plan.allreduce(v, op, strategy="flat"),
+                plan.allreduce(v, op, strategy="hier"))
+
+    flat, hier = jax.jit(plan.shard_map(
+        body, in_specs=plan.row_spec, out_specs=(P(), P()),
+        check_replication=False))(jax.device_put(x, plan.data_sharding()))
+    np.testing.assert_allclose(
+        np.asarray(flat.astype(jnp.float32)),
+        np.asarray(hier.astype(jnp.float32)), rtol=tol, atol=tol)
+
+
+def test_hier_allreduce_deterministic():
+    # ring-ordered combines: the hierarchical route must be bit-stable
+    # run-to-run on a fixed plan (the property the GBDT forest identity
+    # leans on)
+    plan = MeshPlan.build(hosts=2)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((8 * 100,)),
+                    jnp.float32)
+    step = jax.jit(plan.shard_map(
+        lambda v: plan.allreduce(v, "sum", strategy="hier"),
+        in_specs=plan.row_spec, out_specs=P(), check_replication=False))
+    xd = jax.device_put(x, plan.data_sharding())
+    np.testing.assert_array_equal(np.asarray(step(xd)),
+                                  np.asarray(step(xd)))
+
+
+def test_plan_allreduce_bench_smoke():
+    out = plan_allreduce_bench(MeshPlan.build(), strategy="hier",
+                               mib_per_device=0.125, iters=2, warmup=1)
+    assert out["devices"] == 8
+    assert out["bus_gbps"] > 0
+    assert out["strategy"] == "hier"
+
+
+# ---------------------------------------------------------------------------
+# topology discovery + knobs
+# ---------------------------------------------------------------------------
+
+def test_build_topology():
+    plan = MeshPlan.build()
+    assert plan.axes == ("data",)
+    assert plan.chip_axis == "data" and plan.host_axis is None
+    plan2 = MeshPlan.build(hosts=2)
+    assert plan2.axes == ("host", "chip")
+    d = plan2.describe()
+    assert d["hosts"] == 2 and d["chips_per_host"] == 4
+    assert d["fabric"] == "host"  # CPU devices: no ICI
+
+
+def test_build_hosts_knob(monkeypatch):
+    monkeypatch.setenv("DMLCTPU_MESH_HOSTS", "4")
+    plan = MeshPlan.build()
+    assert plan.axes == ("host", "chip")
+    assert plan.mesh.shape["host"] == 4 and plan.mesh.shape["chip"] == 2
+    monkeypatch.setenv("DMLCTPU_MESH_HOSTS", "3")
+    with pytest.raises(ValueError, match="do not split over 3 host"):
+        MeshPlan.build()
+
+
+def test_collective_knobs(monkeypatch):
+    plan = MeshPlan.build()
+    assert plan.strategy_for(1 << 10) == "flat"  # under 256 KiB default
+    assert plan.strategy_for(1 << 20) == "hier"
+    monkeypatch.setenv("DMLCTPU_MESH_COLLECTIVE", "flat")
+    assert MeshPlan.build().strategy_for(1 << 20) == "flat"
+    monkeypatch.setenv("DMLCTPU_MESH_COLLECTIVE", "hier")
+    assert MeshPlan.build().strategy_for(16) == "hier"
+    monkeypatch.setenv("DMLCTPU_MESH_COLLECTIVE", "bogus")
+    with pytest.raises(ValueError, match="DMLCTPU_MESH_COLLECTIVE"):
+        MeshPlan.build()
+    monkeypatch.delenv("DMLCTPU_MESH_COLLECTIVE")
+    monkeypatch.setenv("DMLCTPU_MESH_HIER_THRESHOLD_KB", "1")
+    assert MeshPlan.build().strategy_for(2048) == "hier"
+    monkeypatch.setenv("DMLCTPU_MESH_OVERLAP_CHUNKS", "4")
+    assert MeshPlan.build().overlap_chunks == 4
+
+
+def test_single_shard_plan_stays_flat():
+    plan = MeshPlan.build(devices=jax.devices()[:1])
+    assert plan.strategy_for(1 << 30) == "flat"
+
+
+def test_make_mesh_raises_instead_of_asserting():
+    with pytest.raises(ValueError, match="do not factor the 8 available"):
+        make_mesh((3, 5), ("host", "chip"))
+    with pytest.raises(ValueError, match="axis_sizes required"):
+        make_mesh(None, ("host", "chip"))
+
+
+# ---------------------------------------------------------------------------
+# spec adaptation (back-compat with the (mesh, axis) tuple)
+# ---------------------------------------------------------------------------
+
+def test_from_spec_shapes():
+    assert MeshPlan.from_spec(None) is None
+    plan = MeshPlan.build()
+    assert MeshPlan.from_spec(plan) is plan  # passthrough, not a copy
+    bare = MeshPlan.from_spec(plan.mesh)
+    assert isinstance(bare, MeshPlan) and bare.axes == ("data",)
+    assert not bare.prefer_gspmd
+
+
+def test_tuple_adapter_back_compat():
+    mesh = make_mesh((8,), ("data",))
+    m = GBDT(num_features=4, num_trees=1, max_depth=2, num_bins=8,
+             learning_rate=0.3, histogram="xla",
+             histogram_mesh=(mesh, "data"))
+    assert isinstance(m.mesh_plan, MeshPlan)
+    assert m.mesh_plan.prefer_gspmd  # tuples keep the legacy GSPMD route
+    assert m.histogram_mesh == (mesh, "data")  # legacy_spec round-trips
+    with pytest.raises(ValueError, match="histogram_mesh axis"):
+        GBDT(num_features=4, num_trees=1, max_depth=2, num_bins=8,
+             learning_rate=0.3, histogram_mesh=(mesh, "model"))
+
+
+# ---------------------------------------------------------------------------
+# GBDT plan routing: forest identity
+# ---------------------------------------------------------------------------
+
+_BINS = 16
+
+
+def _binned_data(rows=2048, feats=8, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((rows, feats)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    return np.asarray(QuantileBinner(num_bins=_BINS).fit_transform(x)), y
+
+
+def _fit(plan, bins, y):
+    m = GBDT(num_features=bins.shape[1], num_trees=2, max_depth=4,
+             num_bins=_BINS, learning_rate=0.3, histogram="xla",
+             histogram_mesh=plan)
+    if plan is not None:
+        bins = jax.device_put(bins, plan.data_sharding())
+        y = jax.device_put(y, plan.data_sharding())
+    return m.fit(bins, y)
+
+
+def test_plan_routed_fit_matches_single_device():
+    bins, y = _binned_data()
+    ref = _fit(None, bins, y)
+    for plan in (MeshPlan.build(), MeshPlan.build(hosts=2)):
+        forest = _fit(plan, bins, y)
+        # identical tree structure; leaves may differ by reduction
+        # rounding between the single-device and collective routes
+        np.testing.assert_array_equal(np.asarray(ref["feature"]),
+                                      np.asarray(forest["feature"]))
+        np.testing.assert_array_equal(np.asarray(ref["threshold"]),
+                                      np.asarray(forest["threshold"]))
+        np.testing.assert_allclose(np.asarray(ref["leaf"]),
+                                   np.asarray(forest["leaf"]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_overlap_chunks_forest_bit_identical():
+    # the collective/compute overlap contract: chunking the level-loop
+    # histogram reduction must not move a single bit of the forest
+    bins, y = _binned_data()
+    base = _fit(MeshPlan.build(overlap_chunks=1), bins, y)
+    variants = [MeshPlan.build(overlap_chunks=2),
+                MeshPlan.build(overlap_chunks=4),
+                MeshPlan.build(hosts=2, overlap_chunks=4)]
+    for plan in variants:
+        forest = _fit(plan, bins, y)
+        for key in ("feature", "threshold", "leaf"):
+            np.testing.assert_array_equal(np.asarray(base[key]),
+                                          np.asarray(forest[key]))
