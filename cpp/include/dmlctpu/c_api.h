@@ -157,6 +157,9 @@ typedef struct {
   uint64_t value_off;    /* float [nnz_pad] */
   uint64_t field_off;    /* int32 [nnz_pad]; UINT64_MAX when absent */
   uint64_t qid_off;      /* int32 [batch_size]; UINT64_MAX when absent */
+  int64_t lineage;       /* (source virtual part << 32) | chunk index of the
+                            batch's first row; -1 when the parser does not
+                            track provenance (single-stream paths) */
 } DmlcTpuStagedBatchOwnedC;
 
 /*! \brief nnz_max: 0 = unbounded (nnz padded to nnz_bucket multiples); else
@@ -231,7 +234,7 @@ int DmlcTpuStagedBatchWireHeader(const DmlcTpuStagedBatchOwnedC* batch,
 int DmlcTpuStagedBatchFromWire(const void* header, uint64_t header_len,
                                void* arena, uint64_t arena_bytes,
                                DmlcTpuStagedBatchOwnedC* out);
-#define DMLCTPU_STAGED_WIRE_HEADER_BYTES 104
+#define DMLCTPU_STAGED_WIRE_HEADER_BYTES 112
 
 /* ---- RecordBatcher: RecordIO → packed fixed-shape device batches --------- */
 typedef void* DmlcTpuRecordBatcherHandle;
@@ -455,6 +458,24 @@ int DmlcTpuTelemetryRecordSpan(const char* name, int64_t ts_us,
 int DmlcTpuTelemetryGaugeSet(const char* name, int64_t value);
 int DmlcTpuTelemetryGaugeAdd(const char* name, int64_t delta);
 int DmlcTpuTelemetryGaugeGet(const char* name, int64_t* out);
+/* Install the process-ambient distributed trace context: spans recorded
+ * while trace_id != 0 carry (trace_id, parent_span, lineage) into the trace
+ * dump as Chrome-trace args, so tracker.job_trace() can link them causally
+ * under the originating client span (doc/observability.md "Distributed
+ * tracing").  trace_id = 0 clears the context.  A no-op when telemetry is
+ * compiled out. */
+int DmlcTpuTelemetrySetTraceContext(uint64_t trace_id, uint64_t parent_span,
+                                    int64_t lineage);
+/* read the ambient context back (outputs may be NULL; zeros / -1 when no
+ * context is installed or telemetry is compiled out). */
+int DmlcTpuTelemetryGetTraceContext(uint64_t* trace_id, uint64_t* parent_span,
+                                    int64_t* lineage);
+/* Validate that `json` is one complete well-formed JSON value (arbitrary
+ * nesting) with nothing but whitespace after it, using the same pull reader
+ * (dmlctpu/json.h) the native loaders trust.  *out_ok = 1 valid / 0 invalid;
+ * the return value only reports API-level failure.  Used by the check.sh
+ * jobtrace tier to vet merged /jobtrace documents. */
+int DmlcTpuJsonValidate(const char* json, int* out_ok);
 
 /* ---- stall watchdog + flight recorder (dmlctpu/watchdog.h) ---------------- */
 /* (Re)arm the watchdog: fire when NO pipeline progress counter moves for

@@ -116,6 +116,12 @@ class Parser : public DataIter<RowBlock<IndexType, DType>> {
                                                           const char* type);
   /*! \brief bytes consumed so far (throughput accounting) */
   virtual size_t BytesRead() const = 0;
+  /*! \brief lineage id of the chunk behind the block last returned by
+   *  Value(): (global virtual part << 32) | chunk index for the sharded
+   *  parse pool; -1 when the parser does not track provenance (the
+   *  single-stream paths).  Purely observational — never affects the row
+   *  stream. */
+  virtual int64_t LineageId() const { return -1; }
 };
 
 /*! \brief iterator over row blocks with schema info, optionally disk-cached */

@@ -156,6 +156,18 @@ class Registry {
 
 // ---- trace spans ------------------------------------------------------------
 
+/*! \brief install the process-ambient distributed trace context.  Every span
+ *  recorded while trace_id != 0 is stamped with (trace_id, parent_span,
+ *  lineage) and the trace dump emits them as Chrome-trace args, so a
+ *  tracker-side merge can link this process's spans causally under the
+ *  originating client span.  trace_id = 0 clears the context (spans revert
+ *  to unstamped).  All loads/stores are relaxed: the context is advisory
+ *  labeling, not a synchronization edge. */
+void SetTraceContext(uint64_t trace_id, uint64_t parent_span, int64_t lineage);
+/*! \brief read the ambient context back (out pointers may be null). */
+void GetTraceContext(uint64_t* trace_id, uint64_t* parent_span,
+                     int64_t* lineage);
+
 /*! \brief start recording spans (clears previously buffered events). */
 void TraceStart();
 /*! \brief stop recording (buffered events are kept for TraceDumpJson). */
@@ -293,6 +305,14 @@ class Registry {
   std::string SnapshotJson() const { return "{\"enabled\":false}"; }
   void ResetAll() {}
 };
+
+inline void SetTraceContext(uint64_t, uint64_t, int64_t) {}
+inline void GetTraceContext(uint64_t* trace_id, uint64_t* parent_span,
+                            int64_t* lineage) {
+  if (trace_id != nullptr) *trace_id = 0;
+  if (parent_span != nullptr) *parent_span = 0;
+  if (lineage != nullptr) *lineage = -1;
+}
 
 inline void TraceStart() {}
 inline void TraceStop() {}

@@ -4,6 +4,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <sstream>
 #include <string>
 #include <vector>
 
@@ -15,6 +16,7 @@
 #include "dmlctpu/fault.h"
 #include "dmlctpu/input_split.h"
 #include "dmlctpu/io/filesystem.h"
+#include "dmlctpu/json.h"
 #include "dmlctpu/logging.h"
 #include "dmlctpu/recordio.h"
 #include "dmlctpu/stream.h"
@@ -228,6 +230,44 @@ int DmlcTpuTelemetryGaugeAdd(const char* name, int64_t delta) {
 int DmlcTpuTelemetryGaugeGet(const char* name, int64_t* out) {
   return Guard([&] {
     *out = dmlctpu::telemetry::Registry::Get()->gauge(name).Value();
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetrySetTraceContext(uint64_t trace_id, uint64_t parent_span,
+                                    int64_t lineage) {
+  return Guard([&] {
+    dmlctpu::telemetry::SetTraceContext(trace_id, parent_span, lineage);
+    return 0;
+  });
+}
+
+int DmlcTpuTelemetryGetTraceContext(uint64_t* trace_id, uint64_t* parent_span,
+                                    int64_t* lineage) {
+  return Guard([&] {
+    dmlctpu::telemetry::GetTraceContext(trace_id, parent_span, lineage);
+    return 0;
+  });
+}
+
+int DmlcTpuJsonValidate(const char* json, int* out_ok) {
+  return Guard([&] {
+    *out_ok = 0;
+    try {
+      std::istringstream is(json == nullptr ? "" : json);
+      dmlctpu::JSONReader reader(&is);
+      reader.SkipValue();
+      // one value, then nothing but whitespace
+      char c;
+      while (is.get(c)) {
+        if (c != ' ' && c != '\t' && c != '\n' && c != '\r') {
+          return 0;
+        }
+      }
+      *out_ok = 1;
+    } catch (const std::exception&) {
+      *out_ok = 0;  // malformed input is a *result*, not an API failure
+    }
     return 0;
   });
 }
@@ -1043,6 +1083,7 @@ void FillOwnedC(const dmlctpu::data::StagedArena* a, void* batch,
   out->value_off = a->value_off;
   out->field_off = a->with_field ? a->field_off : ~static_cast<uint64_t>(0);
   out->qid_off = a->with_qid ? a->qid_off : ~static_cast<uint64_t>(0);
+  out->lineage = a->lineage;
 }
 }  // namespace
 
@@ -1094,7 +1135,9 @@ namespace {
 // Fixed native-endian wire header for one owned staged batch.  Native order
 // matches the rest of the side-channel framing (struct "@i" in metrics.py);
 // the magic word doubles as the cross-arch tripwire, exactly like the 0xff98
-// handshake.  13 * 8 = 104 bytes == DMLCTPU_STAGED_WIRE_HEADER_BYTES.
+// handshake.  14 * 8 = 112 bytes == DMLCTPU_STAGED_WIRE_HEADER_BYTES.
+// v2 appends the batch lineage id (the magic's low word is the version, so
+// a v1 peer rejects a v2 stream loudly instead of misreading it).
 struct StagedWireHeader {
   uint64_t magic;      // kStagedWireMagic
   uint64_t num_rows;   // widened from uint32 to keep the layout padding-free
@@ -1109,8 +1152,9 @@ struct StagedWireHeader {
   uint64_t value_off;
   uint64_t field_off;
   uint64_t qid_off;
+  int64_t lineage;     // (source virtual part << 32) | chunk index; -1 unknown
 };
-constexpr uint64_t kStagedWireMagic = 0xDB57A6ED00000001ULL;  // ..01 = v1
+constexpr uint64_t kStagedWireMagic = 0xDB57A6ED00000002ULL;  // ..02 = v2
 constexpr uint64_t kNoColumn = ~static_cast<uint64_t>(0);
 static_assert(sizeof(StagedWireHeader) == DMLCTPU_STAGED_WIRE_HEADER_BYTES,
               "wire header layout drifted from the public constant");
@@ -1146,6 +1190,7 @@ int DmlcTpuStagedBatchWireHeader(const DmlcTpuStagedBatchOwnedC* batch,
     h.value_off = batch->value_off;
     h.field_off = batch->field_off;
     h.qid_off = batch->qid_off;
+    h.lineage = batch->lineage;
     std::memcpy(buf, &h, sizeof(h));
     *out_len = sizeof(h);
     return 0;
@@ -1198,6 +1243,7 @@ int DmlcTpuStagedBatchFromWire(const void* header, uint64_t header_len,
     out->value_off = h.value_off;
     out->field_off = h.field_off;
     out->qid_off = h.qid_off;
+    out->lineage = h.lineage;
     return 0;
   });
 }

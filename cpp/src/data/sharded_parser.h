@@ -127,6 +127,7 @@ class ShardedParser : public Parser<IndexType, DType> {
       RecycleCurBlocks();
     }
     blk_ptr_ = 0;
+    cur_lineage_ = -1;
     Start();
   }
 
@@ -154,6 +155,10 @@ class ShardedParser : public Parser<IndexType, DType> {
   size_t BytesRead() const override {
     return bytes_read_.load(std::memory_order_relaxed);
   }
+  /*! \brief lineage of the chunk behind the current Value(): consumer-thread
+   *  state like blk_ptr_/cur_blocks_ (set in TakeFront on the Next() thread,
+   *  read on the same thread) */
+  int64_t LineageId() const override { return cur_lineage_; }
 
   unsigned virtual_parts() const { return virtual_parts_; }
 
@@ -212,8 +217,14 @@ class ShardedParser : public Parser<IndexType, DType> {
   }
 
  private:
+  struct QueuedChunk {
+    Blocks blocks;
+    size_t cost = 0;      // byte cost against the buffer cap
+    int64_t lineage = -1;  // (global virtual part << 32) | chunk index
+  };
+
   struct PartQueue {
-    std::deque<std::pair<Blocks, size_t>> q;  // (blocks, byte cost)
+    std::deque<QueuedChunk> q;
     bool done = false;
     size_t popped = 0;  // chunks the consumer took (a re-parse skips these)
   };
@@ -371,11 +382,11 @@ class ShardedParser : public Parser<IndexType, DType> {
    *  buffered-byte accounting is unwound, bytes_read_ is NOT — those bytes
    *  really were read and the re-parse reads them again */
   void RollbackPartLocked(PartQueue* pq) {
-    for (auto& [blocks, cost] : pq->q) {
-      buffered_bytes_ -= cost;
+    for (auto& ch : pq->q) {
+      buffered_bytes_ -= ch.cost;
       if (free_pool_.size() < static_cast<size_t>(2 * worker_target_)) {
-        for (auto& b : blocks) b.Clear();
-        free_pool_.push_back(std::move(blocks));
+        for (auto& b : ch.blocks) b.Clear();
+        free_pool_.push_back(std::move(ch.blocks));
       }
     }
     pq->q.clear();
@@ -427,7 +438,8 @@ class ShardedParser : public Parser<IndexType, DType> {
       size_t nb = parser->BytesRead();
       size_t delta = nb - last_bytes;
       last_bytes = nb;
-      if (chunk_idx++ < skip_chunks) {
+      const size_t this_chunk = chunk_idx++;
+      if (this_chunk < skip_chunks) {
         // re-parse replaying chunks the consumer already took from a prior
         // attempt: identical bytes re-parsed to identical blocks, so drop
         // them (the bytes were really read again and stay counted)
@@ -464,7 +476,13 @@ class ShardedParser : public Parser<IndexType, DType> {
           });
         }
         if (stop_ || error_) return;
-        parts_[j].q.emplace_back(std::move(blocks), cost);
+        // lineage id: which source bytes produced this chunk — a pure
+        // function of the partition, so identical across re-parses and
+        // completely independent of whether tracing is armed
+        const int64_t lineage = static_cast<int64_t>(
+            (static_cast<uint64_t>(part_ * virtual_parts_ + j) << 32) |
+            (static_cast<uint64_t>(this_chunk) & 0xffffffffu));
+        parts_[j].q.push_back(QueuedChunk{std::move(blocks), cost, lineage});
         buffered_bytes_ += cost;
         telemetry::stage::ShardBufferedBytes().Set(
             static_cast<int64_t>(buffered_bytes_));
@@ -561,8 +579,9 @@ class ShardedParser : public Parser<IndexType, DType> {
   void TakeFront(PartQueue* pq) {
     RecycleCurBlocks();
     ++pq->popped;  // a re-parse must replay (not republish) this chunk
-    cur_blocks_ = std::move(pq->q.front().first);
-    buffered_bytes_ -= pq->q.front().second;
+    cur_blocks_ = std::move(pq->q.front().blocks);
+    cur_lineage_ = pq->q.front().lineage;
+    buffered_bytes_ -= pq->q.front().cost;
     telemetry::stage::ShardBufferedBytes().Set(
         static_cast<int64_t>(buffered_bytes_));
     pq->q.pop_front();
@@ -612,6 +631,7 @@ class ShardedParser : public Parser<IndexType, DType> {
 
   Blocks cur_blocks_;
   size_t blk_ptr_ = 0;
+  int64_t cur_lineage_ = -1;  // consumer-thread state (see LineageId)
   RowBlock<IndexType, DType> block_;
 };
 

@@ -61,6 +61,7 @@ struct StagedArena {
   uint32_t num_rows = 0;
   size_t nnz_pad = 0;
   int64_t max_index = -1;
+  int64_t lineage = -1;  // lineage id of the chunk behind the batch's first row
 
   ~StagedArena() { std::free(base); }
 
@@ -242,6 +243,10 @@ class StagedBatcherT {
 
     size_t rows = 0;
     size_t nnz = 0;
+    // batch lineage = lineage of the chunk behind the batch's first row
+    // (carried-over blocks keep their chunk's id; the parser's LineageId is
+    // consumer-thread state and Produce runs on the Next() thread)
+    int64_t lineage = -1;
     while (rows < B) {
       if (!have_block_) {
         const int64_t wait_t0 = telemetry::NowUs();
@@ -284,6 +289,7 @@ class StagedBatcherT {
         Grow(slot, nnz, nnz + take_nnz);
         a = slot->arena.get();
       }
+      if (rows == 0) lineage = parser_->LineageId();
       AppendRows(a, rows, nnz, take);
       rows += take;
       nnz += take_nnz;
@@ -297,6 +303,7 @@ class StagedBatcherT {
     }
     last_nnz_ = nnz;
     Finalize(slot, rows, nnz);
+    slot->arena->lineage = lineage;
     if constexpr (telemetry::Enabled()) {
       namespace ts = telemetry::stage;
       const int64_t total = telemetry::NowUs() - pack_t0;

@@ -46,7 +46,19 @@ struct TraceEvent {
   uint32_t tid;
   int64_t ts_us;
   int64_t dur_us;
+  // ambient distributed-trace context, stamped at record time (0 = none)
+  uint64_t trace_id = 0;
+  uint64_t parent = 0;
+  int64_t lineage = -1;
 };
+
+// Process-ambient distributed trace context (SetTraceContext).  Three
+// independent relaxed atomics: the context is advisory labeling adopted at
+// hop boundaries, not a synchronization edge, and a torn read across the
+// triple can only mislabel a span recorded during the (rare) swap.
+std::atomic<uint64_t> g_ctx_trace_id{0};
+std::atomic<uint64_t> g_ctx_parent{0};
+std::atomic<int64_t> g_ctx_lineage{-1};
 
 // Per-thread buffer.  The shared_ptr in the global list keeps it alive past
 // thread exit so TraceDumpJson can still read events from finished workers.
@@ -87,9 +99,16 @@ ThreadTraceBuf& LocalBuf() {
 void PushEvent(TraceEvent&& ev) {
   ThreadTraceBuf& b = LocalBuf();
   ev.tid = b.tid;
+  ev.trace_id = g_ctx_trace_id.load(std::memory_order_relaxed);
+  if (ev.trace_id != 0) {
+    ev.parent = g_ctx_parent.load(std::memory_order_relaxed);
+    ev.lineage = g_ctx_lineage.load(std::memory_order_relaxed);
+  }
   std::lock_guard<std::mutex> lk(b.mu);
   if (b.events.size() >= kMaxEventsPerThread) {
     ++b.dropped;
+    static Counter& drops = Registry::Get()->counter("trace.spans_dropped");
+    drops.Add(1);
     return;
   }
   b.events.push_back(std::move(ev));
@@ -246,6 +265,37 @@ std::string Snapshot::ToJson() const {
 
 // ---- trace API --------------------------------------------------------------
 
+namespace {
+// 016x hex rendering for 64-bit trace/span ids: JSON numbers lose precision
+// past 2^53 in JS consumers (Perfetto), so ids travel as strings.
+std::string HexId(uint64_t v) {
+  char buf[20];
+  std::snprintf(buf, sizeof(buf), "%016llx",
+                static_cast<unsigned long long>(v));
+  return std::string(buf);
+}
+}  // namespace
+
+void SetTraceContext(uint64_t trace_id, uint64_t parent_span,
+                     int64_t lineage) {
+  g_ctx_trace_id.store(trace_id, std::memory_order_relaxed);
+  g_ctx_parent.store(parent_span, std::memory_order_relaxed);
+  g_ctx_lineage.store(lineage, std::memory_order_relaxed);
+}
+
+void GetTraceContext(uint64_t* trace_id, uint64_t* parent_span,
+                     int64_t* lineage) {
+  if (trace_id != nullptr) {
+    *trace_id = g_ctx_trace_id.load(std::memory_order_relaxed);
+  }
+  if (parent_span != nullptr) {
+    *parent_span = g_ctx_parent.load(std::memory_order_relaxed);
+  }
+  if (lineage != nullptr) {
+    *lineage = g_ctx_lineage.load(std::memory_order_relaxed);
+  }
+}
+
 bool TraceActive() { return g_trace_active.load(std::memory_order_relaxed); }
 
 void TraceStart() {
@@ -289,7 +339,13 @@ std::string TraceDumpJson() {
       }
       out += "\",\"cat\":\"dmlctpu\",\"ph\":\"X\",\"pid\":1,\"tid\":" +
              std::to_string(ev.tid) + ",\"ts\":" + std::to_string(ev.ts_us) +
-             ",\"dur\":" + std::to_string(ev.dur_us) + "}";
+             ",\"dur\":" + std::to_string(ev.dur_us);
+      if (ev.trace_id != 0) {
+        out += ",\"args\":{\"trace_id\":\"" + HexId(ev.trace_id) +
+               "\",\"parent\":\"" + HexId(ev.parent) +
+               "\",\"lineage\":" + std::to_string(ev.lineage) + "}";
+      }
+      out += "}";
     }
   }
   out += "],\"otherData\":{\"dropped_events\":" + std::to_string(dropped) + "}}";

@@ -211,6 +211,13 @@ _LIB.DmlcTpuTelemetryGaugeSet.argtypes = [ctypes.c_char_p, ctypes.c_int64]
 _LIB.DmlcTpuTelemetryGaugeAdd.argtypes = [ctypes.c_char_p, ctypes.c_int64]
 _LIB.DmlcTpuTelemetryGaugeGet.argtypes = [
     ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+_LIB.DmlcTpuTelemetrySetTraceContext.argtypes = [
+    ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64]
+_LIB.DmlcTpuTelemetryGetTraceContext.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_int64)]
+_LIB.DmlcTpuJsonValidate.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
 _LIB.DmlcTpuWatchdogStart.argtypes = [
     ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_char_p]
 _LIB.DmlcTpuWatchdogStop.argtypes = []

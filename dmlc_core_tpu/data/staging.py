@@ -490,6 +490,7 @@ class _StagedBatchOwnedC(ctypes.Structure):
         ("value_off", ctypes.c_uint64),
         ("field_off", ctypes.c_uint64),
         ("qid_off", ctypes.c_uint64),
+        ("lineage", ctypes.c_int64),
     ]
 
 
@@ -1157,6 +1158,10 @@ class DeviceStagingIter:
             index=staged[3], value=staged[4], num_rows=staged[5],
             field=staged[6] if with_field else None,
             qid=staged[6 + int(with_field)] if with_qid else None)
+        # lineage rides as a plain (non-pytree) attribute: it is provenance
+        # metadata for telemetry.lineage(), not a traced value — a pytree
+        # meta field would retrace jitted consumers per lineage id
+        batch._lineage = int(w.get("lineage", -1))
         self._max_index = max(self._max_index, w["max_index"])
         self._note_staged()
         return batch
@@ -1216,6 +1221,7 @@ class DeviceStagingIter:
             "qid": arr(c.qid_off, B, np.int32) if with_qid else None,
             "num_rows": int(c.num_rows),
             "max_index": int(c.max_index),
+            "lineage": int(c.lineage),
         }
 
     def _iter_multihost(self) -> Iterator[PaddedBatch]:
@@ -1303,6 +1309,8 @@ class DeviceStagingIter:
             num_rows=put_r(total_rows),
             field=put_s(field) if with_field else None,
             qid=put_s(qid) if with_qid else None)
+        if local is not None:
+            batch._lineage = int(local.get("lineage", -1))
         self._note_staged()
         return batch
 

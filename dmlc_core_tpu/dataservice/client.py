@@ -147,6 +147,9 @@ class DataServiceIter:
 
     def _req_reply(self, worker: dict, req: dict) -> dict:
         _fire("dataservice.connect")
+        ctx = telemetry.trace_context_wire()
+        if ctx is not None:
+            req = dict(req, trace=ctx)
         sock = socket.create_connection((worker["host"], worker["port"]),
                                         timeout=self._timeout_s)
         try:
@@ -209,6 +212,13 @@ class DataServiceIter:
         from dmlc_core_tpu.data.binned_cache import (decode_block_payload,
                                                      unpack_block)
         _fire("dataservice.connect")
+        req = {"op": "fetch", "spec": self._spec, "part": int(part)}
+        # the epoch's trace context rides the fetch request so the worker's
+        # parse/pack spans link under this client's epoch span in the
+        # job-trace merge
+        ctx = telemetry.trace_context_wire()
+        if ctx is not None:
+            req["trace"] = ctx
         sock = socket.create_connection((worker["host"], worker["port"]),
                                         timeout=self._timeout_s)
         blocks: List = []
@@ -216,8 +226,7 @@ class DataServiceIter:
         try:
             sock.settimeout(self._timeout_s)
             protocol.client_handshake(sock)
-            protocol.send_req(sock, {"op": "fetch", "spec": self._spec,
-                                     "part": int(part)})
+            protocol.send_req(sock, req)
             while True:
                 kind, payload = protocol.read_frame(sock)
                 if kind == protocol.FRAME_END:
@@ -271,7 +280,8 @@ class DataServiceIter:
                 continue
             worker = r["worker"]
             try:
-                blocks = self._fetch_from(worker, part)
+                with telemetry.span("dataservice.fetch"):
+                    blocks = self._fetch_from(worker, part)
             except (ConnectionError, OSError, ValueError) as e:
                 telemetry.counter_add("dataservice.errors", 1)
                 LOGGER.warning("fetch of part %d from %s failed (%s); "
@@ -372,6 +382,13 @@ class DataServiceIter:
 
     def __iter__(self) -> Iterator:
         from dmlc_core_tpu.data.staging import _staged_iter
+        # mint this epoch's trace context: every fetch request carries it,
+        # so the fleet's parse/pack spans land under one trace id in the
+        # tracker's job-trace merge.  The epoch span itself is recorded
+        # below so the merged trace has the client-side root to hang the
+        # remote spans off.
+        trace_id = telemetry.new_trace_id()
+        telemetry.set_trace_context(trace_id, trace_id)
         self.ensure_meta()
         self._data().data_req({
             "op": "lease_register", "client": self.client_id,
@@ -393,7 +410,9 @@ class DataServiceIter:
                 host_iter.close()
 
         try:
-            yield from _staged_iter(produce_device, 2,
-                                    depth_gauge="h2d.queue_depth")
+            with telemetry.span("dataservice.epoch"):
+                yield from _staged_iter(produce_device, 2,
+                                        depth_gauge="h2d.queue_depth")
         finally:
+            telemetry.clear_trace_context()
             self._epoch += 1

@@ -11,7 +11,7 @@ raw payload verbatim:
   ``FRAME_BLOCK``   one binned-cache block exactly as stored on disk
                     (32-byte header + columns; ``unpack_block`` decodes it),
                     served zero-copy from the worker's mmap view
-  ``FRAME_STAGED``  one packed text-parse batch: the 104-byte native wire
+  ``FRAME_STAGED``  one packed text-parse batch: the 112-byte native wire
                     header (``DmlcTpuStagedBatchWireHeader``) + the owned
                     arena verbatim — the text-path fallback
   ``FRAME_END``     JSON trailer ``{"blocks": n}`` closing a fetch; a count
@@ -49,7 +49,7 @@ FRAME_STAGED = 2
 FRAME_SNAPSHOT = 3
 FRAME_ERROR = -1
 
-WIRE_HEADER_BYTES = 104  # == DMLCTPU_STAGED_WIRE_HEADER_BYTES
+WIRE_HEADER_BYTES = 112  # == DMLCTPU_STAGED_WIRE_HEADER_BYTES (wire v2)
 
 _I64 = struct.Struct("@q")
 
@@ -174,4 +174,5 @@ def unwrap_staged_wire(buf: bytearray) -> dict:
                 if c.qid_off != _NO_FIELD else None),
         "num_rows": int(c.num_rows),
         "max_index": int(c.max_index),
+        "lineage": int(c.lineage),
     }

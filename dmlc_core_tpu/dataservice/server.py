@@ -341,29 +341,45 @@ class StagingWorker:
 
     def _handle_req(self, fd: socket.socket, req: dict) -> None:
         op = req.get("op")
-        if op == "ping":
-            protocol.send_req(fd, {"ok": True, "worker": self.worker_id})
-            return
-        served = self._dataset(req["spec"])
-        if op == "meta":
-            try:
-                protocol.send_req(fd, served.ensure())
-            except Exception as e:  # build failed: tell the client, not TCP
-                telemetry.counter_add("dataservice.errors", 1)
-                protocol.send_req(fd, {"ok": False, "error": str(e)[-500:]})
-            return
-        if op == "fetch":
-            served.ensure()
-            try:
-                served.serve_fetch(fd, int(req["part"]))
-            except (ConnectionError, OSError):
-                raise  # client went away mid-stream; nothing to send
-            except Exception as e:
-                telemetry.counter_add("dataservice.errors", 1)
-                protocol.write_json_frame(fd, protocol.FRAME_ERROR,
-                                          {"error": str(e)[-500:]})
-            return
-        protocol.send_req(fd, {"ok": False, "error": f"unknown op {op!r}"})
+        # adopt the client's trace context (when it sent one) so every
+        # native parse/pack span this request triggers carries the client's
+        # trace id and links causally under its epoch span in the tracker's
+        # job-trace merge.  Advisory labeling: concurrent requests race on
+        # the ambient context, last writer wins (doc/observability.md).
+        # Restore (not clear) on the way out: an in-process worker must not
+        # wipe the client's own epoch context.
+        prev = telemetry.get_trace_context()
+        adopted = telemetry.adopt_trace_context(req.get("trace"))
+        try:
+            if op == "ping":
+                protocol.send_req(fd, {"ok": True, "worker": self.worker_id})
+                return
+            served = self._dataset(req["spec"])
+            if op == "meta":
+                try:
+                    protocol.send_req(fd, served.ensure())
+                except Exception as e:  # build failed: tell client, not TCP
+                    telemetry.counter_add("dataservice.errors", 1)
+                    protocol.send_req(fd, {"ok": False,
+                                           "error": str(e)[-500:]})
+                return
+            if op == "fetch":
+                served.ensure()
+                try:
+                    with telemetry.span("dataservice.serve"):
+                        served.serve_fetch(fd, int(req["part"]))
+                except (ConnectionError, OSError):
+                    raise  # client went away mid-stream; nothing to send
+                except Exception as e:
+                    telemetry.counter_add("dataservice.errors", 1)
+                    protocol.write_json_frame(fd, protocol.FRAME_ERROR,
+                                              {"error": str(e)[-500:]})
+                return
+            protocol.send_req(fd, {"ok": False,
+                                   "error": f"unknown op {op!r}"})
+        finally:
+            if adopted:
+                telemetry.set_trace_context(*prev)
 
     def _dataset(self, spec: dict) -> _ServedDataset:
         key = spec_key(spec)

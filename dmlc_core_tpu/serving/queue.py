@@ -141,7 +141,7 @@ class MicroBatchQueue:
                 not in ("0", "", "false")
         self._iter = ScoringIterator(max_batch=4096, with_field=with_field)
         self._lock = threading.Condition()
-        self._pending: deque = deque()  # (rows, future, t_enqueue_ns)
+        self._pending: deque = deque()  # (rows, future, t_enqueue_ns, ctx)
         self._pending_rows = 0
         self._closed = False
         self._lat_us: deque = deque(maxlen=_PCTL_WINDOW)
@@ -174,12 +174,19 @@ class MicroBatchQueue:
     # ---- request side ----------------------------------------------------
     def submit(self, rows: List) -> Future:
         """Enqueue one request (a list of sparse rows); resolves to
-        ``(np.ndarray scores, model_digest, model_seq)``."""
+        ``(np.ndarray scores, model_digest, model_seq)``.
+
+        The submitting thread's ambient trace context (the /score handler
+        adopts the request's, when it sent one) is captured WITH the
+        request, so the dispatcher can label the micro-batch's spans with
+        the first request's trace even though it runs on its own thread."""
         fut: Future = Future()
+        ctx = telemetry.get_trace_context()
         with self._lock:
             if self._closed:
                 raise RuntimeError("queue is closed")
-            self._pending.append((rows, fut, time.monotonic_ns()))
+            self._pending.append((rows, fut, time.monotonic_ns(),
+                                  ctx if ctx[0] else None))
             self._pending_rows += len(rows)
             telemetry.gauge_set("serve.queue_depth", len(self._pending))
             self._lock.notify_all()
@@ -229,31 +236,50 @@ class MicroBatchQueue:
                     return
                 continue
             t_deq = time.monotonic_ns()
-            for _, _, t_enq in items:
-                telemetry.counter_add("serve.queue_wait_us",
-                                      (t_deq - t_enq) // 1000)
+            # the micro-batch adopts the FIRST context-carrying request's
+            # trace (first-row rule, like staged-batch lineage) and mints
+            # its lineage from the batch sequence number, so every span
+            # below lands in that request's trace in the job-trace merge
+            ctx = next((c for _, _, _, c in items if c is not None), None)
+            if ctx is not None:
+                telemetry.set_trace_context(ctx[0], ctx[1], self.batches)
+            now = telemetry.now_us()
+            for _, _, t_enq, _ in items:
+                wait_us = (t_deq - t_enq) // 1000
+                telemetry.counter_add("serve.queue_wait_us", wait_us)
+                # per-request timeline: the span covers the request's park
+                # time in the queue, ending at dequeue
+                telemetry.record_span("serve.queue_wait", now - wait_us,
+                                      wait_us)
             engine = self._engine_provider()  # hot-swap seam: one read
             flat: List = []
-            for rows, _, _ in items:
+            for rows, _, _, _ in items:
                 flat.extend(rows)
             try:
                 if engine is None:
                     raise RuntimeError("no model loaded")
-                batch, _ = self._iter.pack(flat)
-                scores = engine.score(batch)
+                with telemetry.span("serve.pack"):
+                    batch, _ = self._iter.pack(flat)
+                with telemetry.span("serve.device"):
+                    scores = engine.score(batch)
             except Exception as exc:
-                for _, fut, _ in items:
+                for _, fut, _, _ in items:
                     if not fut.cancelled():
                         fut.set_exception(exc)
+                if ctx is not None:
+                    telemetry.clear_trace_context()
                 continue
             t_done = time.monotonic_ns()
-            off = 0
-            for rows, fut, t_enq in items:
-                part = scores[off:off + len(rows)]
-                off += len(rows)
-                self._lat_us.append((t_done - t_enq) // 1000)
-                if not fut.cancelled():
-                    fut.set_result((part, engine.digest, engine.seq))
+            with telemetry.span("serve.respond"):
+                off = 0
+                for rows, fut, t_enq, _ in items:
+                    part = scores[off:off + len(rows)]
+                    off += len(rows)
+                    self._lat_us.append((t_done - t_enq) // 1000)
+                    if not fut.cancelled():
+                        fut.set_result((part, engine.digest, engine.seq))
+            if ctx is not None:
+                telemetry.clear_trace_context()
             self.batches += 1
             telemetry.counter_add("serve.batches", 1)
             telemetry.counter_add("serve.rows", len(flat))

@@ -137,15 +137,29 @@ class ScoringServer:
             telemetry.counter_add("serve.malformed", 1)
             return (400, json.dumps({"error": f"malformed request: {exc}"}),
                     "application/json")
-        fut = self.queue.submit(rows)
+        # a request may carry its caller's trace context ({"trace": {...}}
+        # beside "rows"): adopt it so this request's queue-wait/pack/device
+        # spans land in the caller's trace in the job-trace merge.  Restore
+        # (not clear) the previous context on the way out so an in-process
+        # caller keeps its own ambient context.
+        prev = telemetry.get_trace_context()
+        adopted = telemetry.adopt_trace_context(doc.get("trace"))
         try:
-            scores, digest, seq = fut.result(timeout=30)
-        except Exception as exc:
-            return (500, json.dumps({"error": str(exc)}), "application/json")
-        return (200, json.dumps({
-            "scores": [float(s) for s in scores.reshape(-1)]
-            if scores.ndim == 1 else [list(map(float, r)) for r in scores],
-            "model": digest, "seq": seq}), "application/json")
+            with telemetry.span("serve.request"):
+                fut = self.queue.submit(rows)
+                try:
+                    scores, digest, seq = fut.result(timeout=30)
+                except Exception as exc:
+                    return (500, json.dumps({"error": str(exc)}),
+                            "application/json")
+            return (200, json.dumps({
+                "scores": [float(s) for s in scores.reshape(-1)]
+                if scores.ndim == 1
+                else [list(map(float, r)) for r in scores],
+                "model": digest, "seq": seq}), "application/json")
+        finally:
+            if adopted:
+                telemetry.set_trace_context(*prev)
 
     # ---- snapshot channel ------------------------------------------------
     def _accept_loop(self) -> None:
@@ -172,9 +186,19 @@ class ScoringServer:
                     protocol.send_req(conn, {"ok": False,
                                              "error": f"bad frame {kind}"})
                     return
-                protocol.send_req(conn, self._apply_snapshot(
-                    bytes(payload), req.get("digest", ""),
-                    int(req.get("seq", 0))))
+                # the pusher's trace context rides the push request, so
+                # the swap span links under the training job's trace
+                prev = telemetry.get_trace_context()
+                adopted = telemetry.adopt_trace_context(req.get("trace"))
+                try:
+                    with telemetry.span("serve.snapshot_apply"):
+                        verdict = self._apply_snapshot(
+                            bytes(payload), req.get("digest", ""),
+                            int(req.get("seq", 0)))
+                finally:
+                    if adopted:
+                        telemetry.set_trace_context(*prev)
+                protocol.send_req(conn, verdict)
         except Exception:
             pass  # a dying pusher must not take the server down
 
@@ -231,10 +255,15 @@ def push_snapshot(host: str, port: int, payload: bytes,
     from .snapshot import snapshot_digest
     if digest is None:
         digest = snapshot_digest(payload)
+    req = {"op": "push_snapshot", "digest": digest, "seq": int(seq)}
+    # the training job's ambient trace context (if any) rides the push so
+    # the server's swap span joins this job's trace
+    ctx = telemetry.trace_context_wire()
+    if ctx is not None:
+        req["trace"] = ctx
     with socket.create_connection((host, port), timeout=timeout) as sock:
         protocol.client_handshake(sock)
-        protocol.send_req(sock, {"op": "push_snapshot", "digest": digest,
-                                 "seq": int(seq)})
+        protocol.send_req(sock, req)
         protocol.write_frame(sock, protocol.FRAME_SNAPSHOT, payload)
         return protocol.read_req(sock)
 

@@ -32,7 +32,11 @@ __all__ = [
     "gauge_set", "gauge_add", "gauge_get",
     "counters_delta", "snapshot_restarted", "merge_snapshots",
     "histogram_quantile", "trace_start", "trace_stop", "trace_dump_json",
-    "trace_dump", "record_span", "span", "stall_attribution",
+    "trace_dump", "record_span", "span", "now_us", "trace_armed",
+    "new_trace_id",
+    "set_trace_context", "get_trace_context", "clear_trace_context",
+    "trace_context_wire", "adopt_trace_context", "lineage", "json_validate",
+    "stall_attribution",
     "format_stall_table", "window", "Window", "capture_logs",
     "watchdog", "watchdog_from_env", "watchdog_running",
     "watchdog_stall_count", "flight_record", "last_flight_record",
@@ -163,9 +167,40 @@ def histogram_quantile(hist: dict, q: float) -> Optional[float]:
 
 # ---- traces -----------------------------------------------------------------
 
+# Test hook: DMLCTPU_CLOCK_SKEW_US shifts every Python-side steady-clock
+# read (spans recorded via span()/now_us() AND the clock probes the
+# MetricsPusher answers offset estimation with) by a fixed amount, faking a
+# host whose clock runs ahead/behind.  Native spans are NOT shifted — the
+# two-process tests run the whole traced pipeline in the skewed child, so
+# its entire dump (native + Python spans) is offset-corrected as one unit
+# by the tracker merge.  See doc/analysis.md for the knob registry entry.
+_CLOCK_SKEW_US = int(os.environ.get("DMLCTPU_CLOCK_SKEW_US", "0") or "0")
+
+
+def now_us() -> int:
+    """Steady-clock microseconds on the span timeline (same epoch as the
+    native ``NowUs()``), plus the ``DMLCTPU_CLOCK_SKEW_US`` test skew."""
+    return time.monotonic_ns() // 1000 + _CLOCK_SKEW_US
+
+
+# True once trace_start() ran in this process: the MetricsPusher uses it
+# to decide whether a push should carry the trace buffers to the tracker
+# (it stays True after trace_stop() so the final push ships the completed
+# trace; a fresh trace_start() simply re-arms it).
+_trace_armed = False
+
+
+def trace_armed() -> bool:
+    """True when this process recorded (or is recording) a trace worth
+    shipping to the tracker's job-trace merge."""
+    return _trace_armed
+
+
 def trace_start() -> None:
     """Start buffering spans (clears spans from any previous trace)."""
+    global _trace_armed
     _native.check(_native.lib().DmlcTpuTelemetryTraceStart())
+    _trace_armed = True
 
 
 def trace_stop() -> None:
@@ -197,11 +232,119 @@ def record_span(name: str, ts_us: int, dur_us: int) -> None:
 @contextlib.contextmanager
 def span(name: str) -> Iterator[None]:
     """Context manager recording its body as a span when tracing is on."""
-    t0 = time.monotonic_ns() // 1000
+    t0 = now_us()
     try:
         yield
     finally:
-        record_span(name, t0, time.monotonic_ns() // 1000 - t0)
+        record_span(name, t0, now_us() - t0)
+
+
+# ---- trace context (job-wide causality) -------------------------------------
+#
+# A trace context is (trace_id, parent_span_id, lineage) — three integers a
+# client mints once per epoch/request and every downstream process adopts
+# before doing traced work on its behalf.  The native span recorder stamps
+# the ambient context onto every span it buffers, so after the tracker
+# merges per-host dumps (``MetricsAggregator.job_trace``) a remote worker's
+# parse/pack spans carry the same ``trace_id`` as the client's epoch span
+# and Perfetto queries can walk the causal chain.  The context is advisory
+# labeling, not a synchronization edge; trace_id 0 means "no context".
+# Wire format: ``{"id": "<16-hex>", "span": "<16-hex>", "lineage": int}``
+# — ids travel as hex strings because the JSON consumers include
+# JavaScript, which corrupts integers past 2**53.
+
+_trace_id_lock = threading.Lock()
+_trace_id_counter = 0
+
+
+def new_trace_id() -> int:
+    """Mint a fresh nonzero 64-bit trace id: 32 bits of pid-seeded entropy,
+    32 bits of process-local counter — collision-free within a process and
+    unlikely to collide across the job's hosts."""
+    global _trace_id_counter
+    with _trace_id_lock:
+        _trace_id_counter += 1
+        low = _trace_id_counter & 0xFFFFFFFF
+    high = (os.getpid() ^ int.from_bytes(os.urandom(4), "little")) & 0xFFFFFFFF
+    tid = (high << 32) | low
+    return tid or 1
+
+
+def set_trace_context(trace_id: int, parent_span: int = 0,
+                      lineage_id: int = -1) -> None:
+    """Install the ambient trace context stamped onto subsequently recorded
+    native spans.  ``trace_id`` 0 clears it (spans stop carrying args)."""
+    _native.check(_native.lib().DmlcTpuTelemetrySetTraceContext(
+        int(trace_id) & 0xFFFFFFFFFFFFFFFF,
+        int(parent_span) & 0xFFFFFFFFFFFFFFFF, int(lineage_id)))
+
+
+def get_trace_context() -> Tuple[int, int, int]:
+    """Current ambient ``(trace_id, parent_span, lineage)`` (0, 0, -1 when
+    unset or when telemetry is compiled out)."""
+    tid = ctypes.c_uint64()
+    parent = ctypes.c_uint64()
+    lin = ctypes.c_int64()
+    _native.check(_native.lib().DmlcTpuTelemetryGetTraceContext(
+        ctypes.byref(tid), ctypes.byref(parent), ctypes.byref(lin)))
+    return int(tid.value), int(parent.value), int(lin.value)
+
+
+def clear_trace_context() -> None:
+    set_trace_context(0, 0, -1)
+
+
+def trace_context_wire() -> Optional[dict]:
+    """The ambient context as its wire dict (attach under a ``"trace"`` key
+    in a request frame), or ``None`` when no context is installed."""
+    tid, parent, lin = get_trace_context()
+    if not tid:
+        return None
+    return {"id": format(tid, "016x"), "span": format(parent, "016x"),
+            "lineage": lin}
+
+
+def adopt_trace_context(wire: Optional[dict]) -> bool:
+    """Install a context received off the wire (the dict form produced by
+    :func:`trace_context_wire`; malformed/absent input is ignored).  Bumps
+    ``trace.ctx_propagated`` on every successful adoption so the job-trace
+    health row can count cross-process hops."""
+    if not isinstance(wire, dict):
+        return False
+    try:
+        tid = int(str(wire.get("id", "0")), 16)
+        parent = int(str(wire.get("span", "0")), 16)
+        lin = int(wire.get("lineage", -1))
+    except (TypeError, ValueError):
+        return False
+    if not tid:
+        return False
+    set_trace_context(tid, parent, lin)
+    counter_add("trace.ctx_propagated", 1)
+    return True
+
+
+def lineage(batch) -> int:
+    """Lineage id of a staged batch: ``(global virtual part << 32) | chunk
+    index``, minted by the sharded parser at the split chunk and threaded
+    through the staged batcher, the 0xff9a wire, and H2D staging.  ``-1``
+    when the batch predates lineage tracking or came off a non-sharded
+    source.  Accepts a ``PaddedBatch`` (plain ``_lineage`` attribute) or
+    the raw staged dict (``"lineage"`` key)."""
+    if isinstance(batch, dict):
+        return int(batch.get("lineage", -1))
+    return int(getattr(batch, "_lineage", -1))
+
+
+def json_validate(text: str) -> bool:
+    """True when ``text`` is one complete JSON value per the native
+    ``JSONReader`` (the same parser the C++ side loads snapshots with) —
+    the check.sh jobtrace tier validates merged traces through this so the
+    contract is the native reader's, not Python's."""
+    ok = ctypes.c_int()
+    _native.check(_native.lib().DmlcTpuJsonValidate(
+        text.encode(), ctypes.byref(ok)))
+    return bool(ok.value)
 
 
 # ---- stall attribution ------------------------------------------------------

@@ -14,6 +14,12 @@ One small ThreadingHTTPServer per process serving:
 * ``/autotune`` — the autotuner's structured state: armed flag, per-tuner
   knob/progress summaries, and the bounded decision log (JSON; see
   doc/autotune.md).
+* ``/healthz`` — cheap liveness: ``ok`` with no locks taken, no native
+  calls, and no health gate consulted, so probes stay truthful while a
+  snapshot swap (or anything else) has the gated endpoints answering 503.
+* ``/jobtrace`` — the tracker's merged, clock-aligned job trace
+  (``MetricsAggregator.job_trace``), tracker endpoints only: a
+  ``trace_provider`` must be attached.  Load in Perfetto like ``/trace``.
 * ``/shards`` — the tracker's shard-board dispatch state (per-epoch
   pending/started/done and steal records), tracker endpoints only: a
   ``board_provider`` must be attached (the aggregator's).
@@ -54,6 +60,9 @@ ScoreProvider = Callable[[bytes], Tuple[int, str, str]]
 # /metrics answer 503 + Retry-After with the reason instead of hanging
 # (snapshot swap mid-flight, no model loaded yet)
 HealthGate = Callable[[], Optional[str]]
+# trace provider: () -> merged Chrome-trace dict; tracker endpoints attach
+# MetricsAggregator.job_trace to light up /jobtrace
+TraceProvider = Callable[[], dict]
 
 
 def _sanitize(name: str) -> str:
@@ -69,7 +78,8 @@ def _labels(labels: Dict[str, str]) -> str:
         return ""
     inner = ",".join(
         '%s="%s"' % (_sanitize(k),
-                     str(v).replace("\\", "\\\\").replace('"', '\\"'))
+                     str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
         for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
@@ -111,7 +121,10 @@ def prometheus_text(sources: List[Tuple[Dict[str, str], dict]]) -> str:
                 lab = _labels(_merge_label(labels, {"le": le}))
                 lines.append(f"{fam}_bucket{lab} {cum}")
             lines.append(f"{fam}_sum{_labels(labels)} {h.get('sum', 0)}")
-            lines.append(f"{fam}_count{_labels(labels)} {h.get('count', 0)}")
+            # _count must equal the +Inf bucket exactly; the snapshot's own
+            # count field is a separate atomic that can race the bucket
+            # reads, so derive the count from the buckets we just rendered
+            lines.append(f"{fam}_count{_labels(labels)} {cum}")
             for line in lines:
                 add(fam, "histogram", line)
 
@@ -144,6 +157,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    # bounded per-write chunk for large bodies (a long trace runs to
+    # hundreds of MB; one giant sendall both doubles peak memory in the
+    # socket layer and starves the other handler threads)
+    _CHUNK = 1 << 20
+
+    def _send_large(self, code: int, body: str, ctype: str) -> None:
+        """Like ``_send`` but streams the body out in bounded chunks."""
+        data = memoryview(body.encode())
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        for off in range(0, len(data), self._CHUNK):
+            self.wfile.write(data[off:off + self._CHUNK])
 
     def _gated(self) -> bool:
         """503 the request when the server's health gate objects (swap in
@@ -193,9 +221,24 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 text = prometheus_text(self.server.provider())
                 self._send(200, text, "text/plain; version=0.0.4")
+            elif url.path == "/healthz":
+                # liveness must stay cheap and ungated: no registry lock,
+                # no native call, no health gate — it answers "is the
+                # process serving" even while /metrics answers 503
+                self._send(200, "ok\n", "text/plain")
             elif url.path == "/trace":
-                self._send(200, telemetry.trace_dump_json(),
-                           "application/json")
+                self._send_large(200, telemetry.trace_dump_json(),
+                                 "application/json")
+            elif url.path == "/jobtrace":
+                tp = getattr(self.server, "trace_provider", None)
+                if tp is None:
+                    self._send(404, "no job-trace merge on this endpoint "
+                               "(worker process? the tracker serves "
+                               "/jobtrace; per-process spans are at "
+                               "/trace)\n", "text/plain")
+                else:
+                    self._send_large(200, json.dumps(tp()),
+                                     "application/json")
             elif url.path == "/flight":
                 rec = None
                 if "fresh=1" not in (url.query or ""):
@@ -221,9 +264,9 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(200, json.dumps(boards.get(url.path[1:], {})),
                                "application/json")
             else:
-                self._send(404, "not found: try /metrics /trace /flight "
-                           "/snapshot /autotune /shards /dataservice\n",
-                           "text/plain")
+                self._send(404, "not found: try /metrics /trace /jobtrace "
+                           "/flight /snapshot /autotune /shards "
+                           "/dataservice /healthz\n", "text/plain")
         except Exception as exc:  # a scrape must never kill the server
             try:
                 self._send(500, f"error: {exc}\n", "text/plain")
@@ -238,13 +281,15 @@ class TelemetryServer:
                  provider: Optional[Provider] = None,
                  board_provider: Optional[BoardProvider] = None,
                  score_provider: Optional[ScoreProvider] = None,
-                 health_gate: Optional[HealthGate] = None):
+                 health_gate: Optional[HealthGate] = None,
+                 trace_provider: Optional[TraceProvider] = None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.provider = provider or _local_provider
         self._httpd.board_provider = board_provider
         self._httpd.score_provider = score_provider
         self._httpd.health_gate = health_gate
+        self._httpd.trace_provider = trace_provider
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -272,13 +317,16 @@ def serve(port: int = 0, host: str = "127.0.0.1",
           provider: Optional[Provider] = None,
           board_provider: Optional[BoardProvider] = None,
           score_provider: Optional[ScoreProvider] = None,
-          health_gate: Optional[HealthGate] = None) -> TelemetryServer:
+          health_gate: Optional[HealthGate] = None,
+          trace_provider: Optional[TraceProvider] = None) -> TelemetryServer:
     """Start the endpoint on a daemon thread and return its handle.
     ``port=0`` binds an ephemeral port (read it back via ``.port``).
     ``board_provider`` (tracker endpoints) lights up ``/shards`` and
     ``/dataservice`` — pass ``MetricsAggregator.board_provider``.
     ``score_provider``/``health_gate`` (serving endpoints) light up
     ``POST /score`` and the 503-on-swap contract — a ScoringServer
-    passes its own (doc/serving.md)."""
+    passes its own (doc/serving.md).  ``trace_provider`` (tracker
+    endpoints) lights up ``/jobtrace`` — pass
+    ``MetricsAggregator.job_trace``."""
     return TelemetryServer(host, port, provider, board_provider,
-                           score_provider, health_gate)
+                           score_provider, health_gate, trace_provider)

@@ -444,6 +444,10 @@ class MetricsAggregator:
                 "snapshot": payload["snapshot"],
                 "restarted": restarted,
                 "last_update": time.time(),
+                # newest trace dump wins; a push without one keeps the
+                # last dump the host shipped (cumulative, like counters)
+                "trace": payload.get("trace") or (
+                    prev.get("trace") if prev else None),
             }
         _write_int(fd, 0)
         # optional shard-board RPC: one JSON reply after the ack (absent
@@ -457,6 +461,16 @@ class MetricsAggregator:
         dreq = payload.get("dataservice_req")
         if dreq is not None:
             _write_str(fd, json.dumps(self._handle_dataservice_req(dreq)))
+        # optional clock probe: the worker sends one ping AFTER reading the
+        # ack and we answer with this process's steady clock in
+        # microseconds.  The worker brackets the ping with its own clock
+        # reads (t0, t1) and estimates offset = t_tracker - (t0+t1)/2 —
+        # classic NTP, min-RTT filtered on the worker side.  Kept as the
+        # LAST exchange so the reply rides right behind the ack and the
+        # RTT stays a socket round trip, not a JSON-merge round trip.
+        if payload.get("clock"):
+            _read_str(fd)  # the ping; content irrelevant, timing is all
+            _write_str(fd, str(telemetry.now_us()))
 
     def _handle_shard_req(self, rank: int, req: dict) -> dict:
         op = req.get("op")
@@ -644,6 +658,73 @@ class MetricsAggregator:
         return {"shards": self.board.state(),
                 "dataservice": self.leases.state()}
 
+    def job_trace(self) -> dict:
+        """Merge every host's shipped trace dump into one clock-aligned
+        Chrome trace (the tracker's ``/jobtrace`` endpoint).
+
+        Each host's spans keep their names/tids but get (a) ``pid`` set to
+        the host's rank plus a ``process_name`` metadata event labeling it
+        ``rank R host:pid``, and (b) timestamps shifted onto the tracker's
+        steady clock by the host's NTP-style offset estimate (the
+        ``telemetry.clock_offset_us`` gauge riding its snapshot:
+        ``t_tracker = t_host + offset``).  Steady clocks of different
+        processes have arbitrary epochs, so without the shift same-machine
+        hosts land milliseconds-to-hours apart; with it a send span on one
+        host orders before its receive span on another.  The tracker's own
+        spans (when it traced anything) join as pid -1 with offset 0 —
+        they already live on the reference clock.
+
+        ``otherData`` carries the merge health row: per-rank span counts
+        and offsets, and the largest absolute offset applied.
+        """
+        with self._lock:
+            hosts = {r: dict(h) for r, h in self._hosts.items()}
+        events: List[dict] = []
+        offsets: Dict[str, int] = {}
+        spans: Dict[str, int] = {}
+
+        def add_process(pid: int, label: str, dump: dict, off: int) -> int:
+            evs = dump.get("traceEvents", [])
+            if not evs:
+                return 0
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": label}})
+            for ev in evs:
+                e = dict(ev)
+                e["pid"] = pid
+                if "ts" in e:
+                    e["ts"] = int(e["ts"]) + off
+                events.append(e)
+            return len(evs)
+
+        for rank, h in sorted(hosts.items()):
+            trace = h.get("trace")
+            if not trace:
+                continue
+            off = int(h["snapshot"].get("gauges", {})
+                      .get("telemetry.clock_offset_us", 0))
+            n = add_process(rank, f"rank {rank} {h['host']}:{h['pid']}",
+                            trace, off)
+            if n:
+                offsets[str(rank)] = off
+                spans[str(rank)] = n
+        n = add_process(-1, "tracker", telemetry.trace_dump(), 0)
+        if n:
+            offsets["tracker"] = 0
+            spans["tracker"] = n
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "hosts": len(spans),
+                "spans": sum(spans.values()),
+                "spans_per_host": spans,
+                "offsets_us": offsets,
+                "max_abs_offset_us": max(
+                    (abs(o) for o in offsets.values()), default=0),
+            },
+        }
+
     def close(self) -> None:
         if self._closed:
             return
@@ -658,16 +739,31 @@ class MetricsAggregator:
 # ---- worker side ------------------------------------------------------------
 
 def push_once(tracker_uri: str, metrics_port: int, rank: int,
-              restarted: bool = False, timeout: float = 10.0) -> None:
+              restarted: bool = False, timeout: float = 10.0,
+              clock: bool = False,
+              trace: Optional[dict] = None) -> Optional[Tuple[int, int]]:
     """Push one snapshot to the tracker (raises on connection failure —
-    the periodic pusher catches, a deterministic test caller should see)."""
-    payload = json.dumps({
+    the periodic pusher catches, a deterministic test caller should see).
+
+    With ``trace`` the payload ships that trace dump for the tracker's
+    ``job_trace`` merge.  With ``clock=True`` the push piggybacks one
+    NTP-style probe after the ack — send a ping at local steady time t0,
+    read the tracker's steady time, note local t1 — and returns
+    ``(rtt_us, offset_us)`` where ``offset = t_tracker - (t0+t1)/2``, i.e.
+    local time + offset = tracker time.  The estimate's error is bounded
+    by rtt/2, which is why the pusher keeps the minimum-RTT probe."""
+    body = {
         "rank": int(rank),
         "host": socket.gethostname(),
         "pid": os.getpid(),
         "restarted": bool(restarted),
         "snapshot": telemetry.snapshot(),
-    })
+    }
+    if trace is not None:
+        body["trace"] = trace
+    if clock:
+        body["clock"] = True
+    payload = json.dumps(body)
     with socket.create_connection((tracker_uri, metrics_port),
                                   timeout=timeout) as sock:
         sock.settimeout(timeout)
@@ -677,6 +773,13 @@ def push_once(tracker_uri: str, metrics_port: int, rank: int,
         _write_str(sock, payload)
         if _read_int(sock) != 0:
             raise ConnectionError("tracker rejected metrics push")
+        if clock:
+            t0 = telemetry.now_us()
+            _write_str(sock, "clock")
+            t_tracker = int(_read_str(sock))
+            t1 = telemetry.now_us()
+            return (t1 - t0, t_tracker - (t0 + t1) // 2)
+    return None
 
 
 class ShardClient:
@@ -804,7 +907,22 @@ class MetricsPusher:
     contract: a tracker restarted by the launcher (restart-flags path)
     binds a NEW ephemeral metrics port and republishes it, and an address
     resolved once at construction would spin on the dead one forever.
+
+    Every successful push also runs one NTP-style clock probe (see
+    ``push_once``).  The pusher keeps a sliding window of recent probes and
+    publishes the offset of the minimum-RTT one — the estimate whose error
+    bound (rtt/2) is tightest — as :attr:`clock_offset_us` and the
+    ``telemetry.clock_offset_us`` gauge.  The gauge rides the next
+    snapshot push, which is how the tracker's ``job_trace`` merge learns
+    each host's offset without a second channel.  When this process
+    recorded a trace (``telemetry.trace_armed``), pushes also ship the
+    trace dump for the merge.
     """
+
+    # sliding probe window: long enough to ride out transient queueing
+    # spikes, short enough that a real drift re-estimates within ~30s at
+    # the default cadence
+    CLOCK_WINDOW = 16
 
     def __init__(self, tracker_uri: str, metrics_port: int, rank: int,
                  interval_s: float = 2.0):
@@ -813,6 +931,8 @@ class MetricsPusher:
         self.rank = int(rank)
         self.interval_s = max(float(interval_s), 0.05)
         self.pushes_dropped = 0
+        self.clock_offset_us: Optional[int] = None
+        self._clock_probes: List[Tuple[int, int]] = []  # (rtt_us, offset_us)
         self._failure_streak = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -832,9 +952,15 @@ class MetricsPusher:
             self.push()
 
     def push(self) -> bool:
-        """One immediate push; True on success."""
+        """One immediate push (with clock probe + trace dump); True on
+        success."""
         try:
-            push_once(self.tracker_uri, self.metrics_port, self.rank)
+            probe = push_once(
+                self.tracker_uri, self.metrics_port, self.rank, clock=True,
+                trace=telemetry.trace_dump()
+                if telemetry.trace_armed() else None)
+            if probe is not None:
+                self._clock_update(probe)
             self._failure_streak = 0
             return True
         except (OSError, ConnectionError, ValueError):
@@ -847,6 +973,19 @@ class MetricsPusher:
             if self._failure_streak >= 2:
                 self._re_resolve()
             return False
+
+    def _clock_update(self, probe: Tuple[int, int]) -> None:
+        """Fold one (rtt, offset) probe into the min-RTT estimate and
+        publish it as the ``telemetry.clock_offset_us`` gauge."""
+        self._clock_probes.append((int(probe[0]), int(probe[1])))
+        if len(self._clock_probes) > self.CLOCK_WINDOW:
+            self._clock_probes.pop(0)
+        self.clock_offset_us = min(self._clock_probes)[1]
+        try:
+            telemetry.gauge_set("telemetry.clock_offset_us",
+                                self.clock_offset_us)
+        except Exception:  # telemetry compiled out or lib torn down
+            pass
 
     def _re_resolve(self) -> None:
         """Pick up a restarted tracker's republished address from the env

@@ -309,6 +309,17 @@ class RabitTracker:
             return "(tracker metrics disabled)"
         return self.metrics.format_job_table()
 
+    def job_trace(self) -> dict:
+        """Merged, clock-aligned job-wide Chrome trace (see
+        MetricsAggregator.job_trace).  Empty trace when metrics were
+        disabled."""
+        if self.metrics is None:
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "otherData": {"hosts": 0, "spans": 0,
+                                  "spans_per_host": {}, "offsets_us": {},
+                                  "max_abs_offset_us": 0}}
+        return self.metrics.job_trace()
+
     def _serve(self) -> None:
         num_workers = self.num_workers
         shutdown: Dict[int, _Worker] = {}

@@ -3,7 +3,7 @@
 Usage:
     python scripts/analyze.py [--root DIR] [checker ...]
 
-With no checker names, all five run.  Findings print one per line as
+With no checker names, all six run.  Findings print one per line as
 `path:line: [checker] message`, sorted, followed by a summary line.
 """
 from __future__ import annotations
@@ -12,11 +12,13 @@ import argparse
 import sys
 from pathlib import Path
 
-from . import capi, concurrency, knobs, stubparity, telemetry_names
+from . import (capi, concurrency, knobs, stubparity, telemetry_names,
+               tracespans)
 
 CHECKERS = {
     "capi": capi.check,
     "telemetry": telemetry_names.check,
+    "tracespans": tracespans.check,
     "knobs": knobs.check,
     "stubparity": stubparity.check,
     "concurrency": concurrency.check,

@@ -1,0 +1,103 @@
+"""Checker 6: the trace-span name contract.
+
+Span names are string literals minted at C++ ``ScopedSpan``/``RecordSpan``
+sites and at Python ``telemetry.span(...)``/``telemetry.record_span(...)``
+sites.  They are the vocabulary the job-trace merge and the Perfetto
+recipes in doc/observability.md are written against, so — like metric
+names — they are a cross-layer contract.  Checked both directions, the
+same discipline as the telemetry checker:
+
+  * every span name used in code appears in the "Trace span contract"
+    table in doc/observability.md
+  * every documented span name has a code usage site (no stale rows)
+  * span names share the metric-name shape (dotted lowercase) so trace
+    tooling can group them by stage prefix
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .common import (Finding, line_of, read_text, rel, strip_cxx_comments,
+                     table_backticks)
+
+DOC = "doc/observability.md"
+DOC_SECTION = "Trace span contract"
+SPAN_SHAPE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+CPP_SCOPED_RE = re.compile(r'ScopedSpan\s+\w+\s*\(\s*"([^"]+)"\s*\)')
+CPP_RECORD_RE = re.compile(r'\bRecordSpan\s*\(\s*"([^"]+)"')
+PY_SPAN_RE = re.compile(r'\b(?:telemetry\.)?(?:span|record_span)\(\s*'
+                        r'"([^"]+)"')
+
+
+def harvest(root: Path) -> dict[str, list[tuple[str, int]]]:
+    """span name -> [(relpath, line)] over every code-side usage site."""
+    uses: dict[str, list[tuple[str, int]]] = {}
+
+    def add(name: str, path: str, line: int) -> None:
+        uses.setdefault(name, []).append((path, line))
+
+    cpp_files = sorted((root / "cpp").rglob("*.h")) + \
+        sorted((root / "cpp").rglob("*.cc")) if (root / "cpp").is_dir() else []
+    for p in cpp_files:
+        if "tests" in p.parts:
+            continue  # test-local span names are not the public contract
+        text = strip_cxx_comments(read_text(p))
+        for regex in (CPP_SCOPED_RE, CPP_RECORD_RE):
+            for m in regex.finditer(text):
+                add(m.group(1), rel(root, p), line_of(text, m.start()))
+
+    pkg = root / "dmlc_core_tpu"
+    py_files = sorted(pkg.rglob("*.py")) if pkg.is_dir() else []
+    for p in py_files:
+        if "__pycache__" in p.parts:
+            continue
+        text = read_text(p)
+        for m in PY_SPAN_RE.finditer(text):
+            add(m.group(1), rel(root, p), line_of(text, m.start()))
+    return uses
+
+
+def documented(root: Path) -> dict[str, int]:
+    doc = root / DOC
+    if not doc.is_file():
+        return {}
+    names: dict[str, int] = {}
+    for line, tok in table_backticks(read_text(doc), DOC_SECTION):
+        if SPAN_SHAPE.match(tok) and not tok.endswith((".h", ".py", ".cc",
+                                                       ".md")):
+            names.setdefault(tok, line)
+    return names
+
+
+def check(root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    uses = harvest(root)
+    docs = documented(root)
+    if not docs and not (root / DOC).is_file():
+        return [Finding(DOC, 1, "tracespans", f"{DOC} not found")]
+    if not docs:
+        return [Finding(DOC, 1, "tracespans",
+                        f'no "{DOC_SECTION}" table found in {DOC}')]
+
+    for name in sorted(uses):
+        path, line = uses[name][0]
+        if not SPAN_SHAPE.match(name):
+            findings.append(Finding(
+                path, line, "tracespans",
+                f'span "{name}" does not match the dotted-lowercase '
+                f'name shape'))
+            continue
+        if name not in docs:
+            findings.append(Finding(
+                path, line, "tracespans",
+                f'span "{name}" is recorded here but missing from the '
+                f'"{DOC_SECTION}" table in {DOC}'))
+    for name, line in sorted(docs.items()):
+        if name not in uses:
+            findings.append(Finding(
+                DOC, line, "tracespans",
+                f'documented span "{name}" has no code usage site '
+                f'(stale contract row)'))
+    return findings

@@ -253,6 +253,14 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   python -m pytest tests/test_gbdt.py -x -q \
     -k "sparse_fit_batch_pallas or streamed_pallas or sharded_fit_batch_pallas or histogram_env_knob" -m ""
 
+  # Jobtrace tier: a two-process dataservice epoch with tracing armed —
+  # worker subprocess and in-process client both record traces and push
+  # them (with NTP-style clock probes) over the 0xff98 heartbeat, then
+  # the merged /jobtrace body is validated through the NATIVE JSONReader
+  # and the worker's serve spans must carry the client's trace id
+  # (doc/observability.md "Distributed tracing").
+  python scripts/jobtrace_check.py
+
   # Mesh tier: the MeshPlan suite under the forced 8-device host platform
   # (conftest.py pins it for every pytest run, made explicit here because
   # this tier is meaningless without it) — hierarchical-vs-flat allreduce
@@ -264,5 +272,5 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + sparse-pallas tier + mesh tier")
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + jobtrace tier + sparse-pallas tier + mesh tier")
 echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + nocodec tier + $py)"

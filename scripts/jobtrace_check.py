@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""check.sh jobtrace tier: a two-process dataservice epoch with tracing
+armed, validated end-to-end through the tracker's /jobtrace endpoint.
+
+A staging-worker subprocess serves a real epoch to an in-process
+DataServiceIter client; both sides record traces and push them (with
+clock probes) over the 0xff98 heartbeat; the parent then fetches the
+merged job trace over HTTP and asserts the full contract:
+
+  * the body is one valid JSON value per the NATIVE JSONReader
+    (``telemetry.json_validate``) — the consumer contract is the C++
+    parser's, not Python's
+  * both hosts landed in the merge, each as its own Perfetto process,
+    each with a clock offset
+  * the client's ``dataservice.epoch``/``dataservice.fetch`` spans and
+    the worker's ``dataservice.serve`` spans share one trace id — the
+    cross-process propagation the trace-context wire exists for
+
+Run from the repo root (check.sh does):  python scripts/jobtrace_check.py
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from dmlc_core_tpu import telemetry, telemetry_http  # noqa: E402
+from dmlc_core_tpu.tracker import metrics as tm  # noqa: E402
+
+_WORKER_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, sys.argv[1])
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.dataservice.server import StagingWorker
+from dmlc_core_tpu.tracker import metrics as tm
+
+telemetry.trace_start()
+worker = StagingWorker(cache_dir=sys.argv[3])
+pusher = tm.MetricsPusher("127.0.0.1", int(sys.argv[2]), rank=0,
+                          interval_s=3600.0)
+print(f"WORKER_READY {worker.port}", flush=True)
+for line in sys.stdin:
+    if line.strip() == "push":
+        ok = all(pusher.push() for _ in range(3))
+        print("PUSHED" if ok else "PUSH_FAILED", flush=True)
+    else:
+        break
+worker.close()
+"""
+
+
+def _write_libsvm(path: Path, rows: int = 400, features: int = 32) -> str:
+    rng = np.random.default_rng(7)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            feats = sorted(rng.choice(features, size=rng.integers(3, 9),
+                                      replace=False))
+            f.write(" ".join([str(rng.integers(0, 2))] +
+                             [f"{j}:{rng.normal():.4f}" for j in feats])
+                    + "\n")
+    return str(path)
+
+
+def main() -> int:
+    telemetry.trace_start()
+    agg = tm.MetricsAggregator()
+    srv = telemetry_http.serve(trace_provider=agg.job_trace)
+    tmp = tempfile.TemporaryDirectory(prefix="dmlctpu-jobtrace-")
+    tmp_path = Path(tmp.name)
+    env = dict(os.environ)
+    env["DMLC_TRACKER_URI"] = "127.0.0.1"
+    env[tm.METRICS_PORT_ENV] = str(agg.port)
+    child = subprocess.Popen(
+        [sys.executable, "-c", _WORKER_CHILD, str(REPO), str(agg.port),
+         str(tmp_path / "cache")],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env=env, cwd=str(REPO))
+    try:
+        deadline = time.time() + 120
+        while True:
+            line = child.stdout.readline()
+            if line.startswith("WORKER_READY"):
+                break
+            assert time.time() < deadline and child.poll() is None, \
+                "staging worker never came up"
+
+        os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+        os.environ[tm.METRICS_PORT_ENV] = str(agg.port)
+        from dmlc_core_tpu.dataservice.client import DataServiceIter
+        from dmlc_core_tpu.models import QuantileBinner
+        uri = _write_libsvm(tmp_path / "train.libsvm")
+        binner = QuantileBinner(num_bins=16, missing_aware=True,
+                                sketch_size=64, sketch_seed=3)
+        it = DataServiceIter(uri, binner, batch_size=64, nnz_bucket=128,
+                             client_id="jobtrace-check")
+        batches = sum(1 for _ in it)
+        assert batches > 0, "epoch served no batches"
+
+        # ship both sides' traces: 3 pushes each so the min-RTT clock
+        # offset gauge (set during push N) rides a later snapshot
+        pusher = tm.MetricsPusher("127.0.0.1", agg.port, rank=1,
+                                  interval_s=3600.0)
+        assert all(pusher.push() for _ in range(3)), "client push failed"
+        child.stdin.write("push\n")
+        child.stdin.flush()
+        assert child.stdout.readline().strip() == "PUSHED", \
+            "worker push failed"
+
+        body = urllib.request.urlopen(f"{srv.url}/jobtrace",
+                                      timeout=30).read().decode()
+        # the consumer contract: one JSON value per the native JSONReader
+        assert telemetry.json_validate(body), \
+            "/jobtrace body rejected by the native JSONReader"
+        doc = json.loads(body)
+        other = doc["otherData"]
+        assert other["hosts"] >= 2, f"expected 2 hosts, got {other}"
+        assert int(other["spans"]) > 0, f"no spans merged: {other}"
+        assert len(other["offsets_us"]) >= 2, f"missing offsets: {other}"
+
+        events = doc["traceEvents"]
+        spans = [e for e in events if e.get("ph") == "X"]
+        names = {e["name"] for e in spans}
+        for want in ("dataservice.epoch", "dataservice.fetch",
+                     "dataservice.serve"):
+            assert want in names, f"span {want!r} missing from merge"
+        # cross-process propagation: the worker's serve spans carry the
+        # client's trace id
+        epoch_tid = next(e["args"]["trace_id"] for e in spans
+                         if e["name"] == "dataservice.epoch"
+                         and e.get("args", {}).get("trace_id"))
+        serve_tids = {e.get("args", {}).get("trace_id") for e in spans
+                      if e["name"] == "dataservice.serve"}
+        assert epoch_tid in serve_tids, (
+            f"worker serve spans never adopted the client's trace id "
+            f"{epoch_tid}: {serve_tids}")
+        pids = {e["pid"] for e in spans}
+        assert len(pids) >= 2, f"expected spans from 2 processes: {pids}"
+        print(f"JOBTRACE_CHECK_OK batches={batches} "
+              f"spans={other['spans']} hosts={other['hosts']} "
+              f"max_abs_offset_us={other['max_abs_offset_us']}")
+        return 0
+    finally:
+        try:
+            child.stdin.write("exit\n")
+            child.stdin.flush()
+            child.wait(timeout=10)
+        except Exception:
+            child.kill()
+        srv.close()
+        agg.close()
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,8 @@ import sys
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "scripts"))
 
-from analyze import capi, concurrency, knobs, stubparity, telemetry_names  # noqa: E402
+from analyze import (capi, concurrency, knobs, stubparity,  # noqa: E402
+                     telemetry_names, tracespans)
 from analyze.main import run  # noqa: E402
 
 
@@ -146,6 +147,53 @@ def test_concurrency_seqcst_and_bare_wait(tmp_path):
     _find(findings, "cpp/include/dmlctpu/lockfree_queue.h",
           _line(header, "void Wait()"), "without a predicate")
     assert len(findings) == 2, [f.render() for f in findings]
+
+
+def test_tracespans_both_directions(tmp_path):
+    src = ('#include "dmlctpu/telemetry.h"\n'
+           "void F() {\n"
+           '  ScopedSpan sp("ghost.span");\n'
+           "}\n")
+    pysrc = ("from . import telemetry\n"
+             "def g():\n"
+             "    with telemetry.span(\"BadShape\"):\n"
+             "        pass\n"
+             "    with telemetry.span(\"good.span\"):\n"
+             "        pass\n")
+    doc = ("## Trace spans\n\n"
+           "### Trace span contract\n\n"
+           "| span | where | meaning |\n|---|---|---|\n"
+           "| `good.span` | `x.py` | test |\n"
+           "| `stale.span` | `x.py` | never recorded |\n")
+    root = _tree(tmp_path, {
+        "cpp/src/spans.cc": src,
+        "dmlc_core_tpu/work.py": pysrc,
+        "doc/observability.md": doc,
+    })
+    findings = tracespans.check(root)
+    _find(findings, "cpp/src/spans.cc", _line(src, "ghost.span"),
+          '"ghost.span" is recorded here but missing')
+    _find(findings, "dmlc_core_tpu/work.py", _line(pysrc, "BadShape"),
+          "dotted-lowercase")
+    _find(findings, "doc/observability.md", _line(doc, "stale.span"),
+          "stale contract row")
+    assert not any("good.span" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_tracespans_green_tree(tmp_path):
+    pysrc = ("from . import telemetry\n"
+             "def g():\n"
+             "    with telemetry.span(\"good.span\"):\n"
+             "        pass\n")
+    doc = ("### Trace span contract\n\n"
+           "| span | where | meaning |\n|---|---|---|\n"
+           "| `good.span` | `work.py` | test |\n")
+    root = _tree(tmp_path, {
+        "dmlc_core_tpu/work.py": pysrc,
+        "doc/observability.md": doc,
+    })
+    assert tracespans.check(root) == []
 
 
 def test_repo_is_green():

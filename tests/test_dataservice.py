@@ -663,3 +663,39 @@ def test_three_process_single_parse(tmp_path):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+# ---- distributed tracing across the 0xff9a wire ----------------------------
+
+def test_trace_context_propagates_over_service(board_env, tmp_path):
+    """The epoch's trace context rides every 0xff9a request: the worker
+    adopts it per request, so its dataservice.serve spans (and the native
+    work under them) carry the client's epoch trace id."""
+    from dmlc_core_tpu import telemetry
+    if not telemetry.enabled():
+        pytest.skip("tracing is compiled out")
+    agg, worker = board_env
+    uri = _write_libsvm(tmp_path / "train.libsvm")
+    before = telemetry.snapshot()
+    telemetry.trace_start()
+    try:
+        it = DataServiceIter(uri, _binner(), batch_size=64, nnz_bucket=256,
+                             shard_client=tm.ShardClient("127.0.0.1",
+                                                         agg.port, rank=0))
+        batches = list(it)
+    finally:
+        telemetry.trace_stop()
+        telemetry.clear_trace_context()
+    assert batches
+    delta = telemetry.counters_delta(before, telemetry.snapshot())
+    # at least the meta request + one fetch adopted a context
+    assert delta.get("trace.ctx_propagated", 0) >= 2
+    events = [e for e in telemetry.trace_dump()["traceEvents"]
+              if e.get("ph") == "X"]
+    serve = [e for e in events if e["name"] == "dataservice.serve"]
+    assert serve, "worker never recorded a serve span"
+    tids = {e.get("args", {}).get("trace_id") for e in serve}
+    # every served request was labeled, all with the same (epoch) trace id
+    assert len(tids) == 1 and None not in tids and "0" * 16 not in tids
+    assert any(e["name"] == "dataservice.fetch" for e in events)
+    assert any(e["name"] == "dataservice.epoch" for e in events)

@@ -567,3 +567,65 @@ def test_two_process_hot_swap_bit_identity():
         if proc is not None:
             proc.kill()
             proc.wait(timeout=30)
+
+
+# ---- distributed tracing through the serving hops --------------------------
+
+def test_trace_context_rides_score_and_snapshot_push():
+    """FRAME_SNAPSHOT and /score both carry a caller's trace context: the
+    server adopts it, so the swap span and the whole per-request
+    micro-batch timeline land in the caller's trace."""
+    if not telemetry.enabled():
+        pytest.skip("tracing is compiled out")
+    before = telemetry.snapshot()
+    telemetry.trace_start()
+    try:
+        with ScoringServer(max_delay_us=200) as srv:
+            _, snap = _linear_engine(seed=3)
+            # hop 1: the pusher's ambient context rides the snapshot push
+            tid_push = telemetry.new_trace_id()
+            telemetry.set_trace_context(tid_push, tid_push)
+            try:
+                assert push_snapshot("127.0.0.1", srv.port, snap)["ok"]
+            finally:
+                telemetry.clear_trace_context()
+            # hop 2: an explicit context in the /score body
+            tid_req = telemetry.new_trace_id()
+            rows = _requests(2, seed=11)
+            body = json.dumps({
+                "rows": [{"index": list(map(int, i)),
+                          "value": list(map(float, v))} for i, v in rows],
+                "trace": {"id": format(tid_req, "016x"),
+                          "span": format(tid_req, "016x"), "lineage": -1},
+            }).encode()
+            url = f"http://127.0.0.1:{srv.http_port}"
+            doc = json.loads(_post(url + "/score", body).read())
+            assert len(doc["scores"]) == 2
+    finally:
+        telemetry.trace_stop()
+        telemetry.clear_trace_context()
+    delta = telemetry.counters_delta(before, telemetry.snapshot())
+    assert delta.get("trace.ctx_propagated", 0) >= 2
+    events = [e for e in telemetry.trace_dump()["traceEvents"]
+              if e.get("ph") == "X"]
+    by = {}
+    for e in events:
+        by.setdefault(e["name"], []).append(e)
+    swap = by["serve.snapshot_apply"][0]
+    assert swap["args"]["trace_id"] == format(tid_push, "016x")
+    # serve.request exists but its stamp is best-effort: the span closes
+    # as the dispatcher's context clear races the handler wake-up (the
+    # single context slot is advisory labeling, not a sync edge)
+    assert by.get("serve.request")
+    # the dispatcher thread adopted the request's context for the whole
+    # micro-batch timeline, minting lineage from the batch sequence
+    # (serve.respond closes after set_result wakes the handler thread,
+    # whose clear can race the process-global context slot — labeling is
+    # advisory, so only the pre-resolution spans are asserted strictly)
+    for name in ("serve.queue_wait", "serve.pack", "serve.device"):
+        spans = [e for e in by.get(name, [])
+                 if e.get("args", {}).get("trace_id")
+                 == format(tid_req, "016x")]
+        assert spans, f"no {name} span labeled with the request's trace"
+        assert all(e["args"]["lineage"] >= 0 for e in spans)
+    assert by.get("serve.respond"), "serve.respond span missing"

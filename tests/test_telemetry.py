@@ -375,36 +375,116 @@ def test_telemetry_http_endpoints(libsvm_file):
         with urlopen(srv.url + "/snapshot", timeout=10) as resp:
             snap = json.loads(resp.read().decode())
             assert snap["enabled"] == telemetry.enabled()
+        with urlopen(srv.url + "/healthz", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.read().decode() == "ok\n"
+        # a worker endpoint has no trace_provider: /jobtrace must 404
+        # with a pointer at /trace, not crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urlopen(srv.url + "/jobtrace", timeout=10)
+        assert ei.value.code == 404
         with pytest.raises(urllib.error.HTTPError) as ei:
             urlopen(srv.url + "/nope", timeout=10)
         assert ei.value.code == 404
 
+    # with a trace_provider attached (the tracker's case), /jobtrace
+    # serves the merged dump
+    merged = {"traceEvents": [], "displayTimeUnit": "ms",
+              "otherData": {"hosts": 0}}
+    with telemetry_http.serve(port=0, trace_provider=lambda: merged) as srv:
+        with urlopen(srv.url + "/jobtrace", timeout=10) as resp:
+            assert json.loads(resp.read().decode()) == merged
+
 
 def _assert_prometheus_wellformed(text):
-    """Minimal validity check for the classic text exposition format."""
+    """Strict validity check for the classic text exposition format.
+
+    Beyond line-shape this enforces what a real Prometheus scraper
+    enforces: every sample belongs to its declared contiguous family and
+    carries the right suffix for the family's type, no duplicate
+    (name, labelset) samples, label syntax is valid, values parse as
+    floats, and histogram series satisfy the format's invariants —
+    `le` values strictly increasing with `+Inf` last, cumulative bucket
+    counts non-decreasing, and `_count` exactly equal to the `+Inf`
+    bucket."""
     import re
 
-    sample_re = re.compile(
-        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9]+(\.[0-9]+)?$")
-    typed = set()
-    seen_families = []
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    label_re = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+    typed = {}
+    fam_order = []  # families in TYPE-line order, for contiguity
+    cur_fam = None
+    seen_samples = set()
+    hist = {}  # (fam, labels-sans-le) -> [(le_float, cum_value)]
+    hist_count = {}  # (fam, labels-sans-le) -> _count value
     for line in text.rstrip("\n").split("\n"):
         if not line:
             continue
         if line.startswith("# TYPE "):
-            name, mtype = line.split()[2:4]
+            parts = line.split()
+            assert len(parts) == 4, f"bad TYPE line: {line!r}"
+            name, mtype = parts[2:4]
+            assert name_re.match(name), f"bad family name {name!r}"
             assert mtype in ("counter", "gauge", "histogram")
             assert name not in typed, f"duplicate TYPE for {name}"
-            typed.add(name)
-            seen_families.append(name)
+            typed[name] = mtype
+            fam_order.append(name)
+            cur_fam = name
         elif line.startswith("#"):
             continue
         else:
-            assert sample_re.match(line), f"bad sample line: {line!r}"
-            metric = line.split("{", 1)[0].split(" ", 1)[0]
-            fam = seen_families[-1] if seen_families else ""
-            assert metric == fam or metric.startswith(fam + "_"), \
-                f"sample {metric} outside its family block {fam}"
+            m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                         r"(\{[^{}]*\})? (\S+)$", line)
+            assert m, f"bad sample line: {line!r}"
+            metric, labelblob, value = m.groups()
+            float(value)  # must parse (raises on garbage)
+            labels = ()
+            if labelblob:
+                parts = re.findall(r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]'
+                                   r'|\\.)*"', labelblob[1:-1])
+                rebuilt = ",".join(parts)
+                assert rebuilt == labelblob[1:-1], \
+                    f"bad label syntax: {labelblob!r}"
+                for p in parts:
+                    assert label_re.match(p), f"bad label pair {p!r}"
+                labels = tuple(sorted(parts))
+            key = (metric, labels)
+            assert key not in seen_samples, f"duplicate sample {key}"
+            seen_samples.add(key)
+            fam = cur_fam or ""
+            assert fam, f"sample {metric} before any TYPE line"
+            mtype = typed[fam]
+            if mtype == "histogram":
+                assert metric in (fam + "_bucket", fam + "_sum",
+                                  fam + "_count"), \
+                    f"sample {metric} not a histogram series of {fam}"
+                base = tuple(p for p in labels if not p.startswith('le='))
+                if metric == fam + "_bucket":
+                    le = [p for p in labels if p.startswith('le=')]
+                    assert len(le) == 1, f"bucket without le: {line!r}"
+                    raw = le[0][4:-1]
+                    lef = float("inf") if raw == "+Inf" else float(raw)
+                    hist.setdefault((fam, base), []).append(
+                        (lef, float(value)))
+                elif metric == fam + "_count":
+                    hist_count[(fam, base)] = float(value)
+            else:
+                assert metric == fam, \
+                    f"sample {metric} outside its family block {fam}"
+                if mtype == "counter":
+                    assert fam.endswith("_total"), \
+                        f"counter family {fam} missing _total"
+                    assert float(value) >= 0, f"negative counter: {line!r}"
+    assert fam_order == sorted(set(fam_order)), "families not contiguous"
+    for key, buckets in hist.items():
+        les = [le for le, _ in buckets]
+        assert les == sorted(les), f"le not increasing for {key}"
+        assert les[-1] == float("inf"), f"missing +Inf bucket for {key}"
+        cums = [c for _, c in buckets]
+        assert cums == sorted(cums), f"buckets not cumulative for {key}"
+        assert key in hist_count, f"histogram {key} missing _count"
+        assert hist_count[key] == cums[-1], \
+            f"_count != +Inf bucket for {key}"
     if telemetry.enabled():
         assert typed, "no TYPE lines in exposition"
 
@@ -444,3 +524,117 @@ def test_capture_logs_interleaved_thread_ordering():
     for tag, seq in by_tag.items():
         assert seq == list(range(n_per_thread)), \
             f"thread {tag} order scrambled"
+
+
+# ---- distributed tracing: context, lineage, exposition hardening ------------
+
+
+def test_prometheus_text_strict_validity_multisource():
+    """The exposition generator against a strict format parser: multiple
+    labeled sources, hostile label values, and a histogram whose separate
+    count atomic raced the bucket reads — the output must still satisfy
+    every invariant a real scraper checks (in particular _count == +Inf
+    bucket, derived from the buckets, not the racing count field)."""
+    from dmlc_core_tpu.telemetry_http import prometheus_text
+
+    hist = {"count": 999, "sum": 123,  # count deliberately != sum(buckets)
+            "buckets": [2, 3] + [0] * 30}
+    sources = [
+        ({"rank": "0", "host": 'evil"host\\name\nline'},
+         {"enabled": True, "counters": {"parse.rows": 7},
+          "gauges": {"h2d.queue_depth": -2},
+          "histograms": {"parse.chunk_us": hist}}),
+        ({"rank": "1", "host": "h1"},
+         {"enabled": True, "counters": {"parse.rows": 9},
+          "gauges": {}, "histograms": {"parse.chunk_us": hist}}),
+    ]
+    text = prometheus_text(sources)
+    _assert_prometheus_wellformed(text)
+    # the hardened count: derived from the buckets (5), not the field (999)
+    count_lines = [ln for ln in text.splitlines()
+                   if ln.startswith("dmlctpu_parse_chunk_us_count")]
+    assert len(count_lines) == 2
+    assert all(ln.endswith(" 5") for ln in count_lines)
+    # newline in a label value must be escaped, never raw
+    assert "\nline" not in text.replace("\\n", "")
+
+
+def test_trace_context_helpers_roundtrip():
+    ids = {telemetry.new_trace_id() for _ in range(64)}
+    assert 0 not in ids and len(ids) == 64  # never 0, never repeating
+    tid = telemetry.new_trace_id()
+    try:
+        telemetry.set_trace_context(tid, tid, 42)
+        assert telemetry.get_trace_context() == (tid, tid, 42)
+        wire = telemetry.trace_context_wire()
+        assert wire == {"id": format(tid, "016x"),
+                        "span": format(tid, "016x"), "lineage": 42}
+        telemetry.clear_trace_context()
+        assert telemetry.get_trace_context()[0] == 0
+        assert telemetry.trace_context_wire() is None
+        # adopting the wire dict restores the full context
+        before = telemetry.snapshot()
+        assert telemetry.adopt_trace_context(wire)
+        assert telemetry.get_trace_context() == (tid, tid, 42)
+        if telemetry.enabled():
+            delta = telemetry.counters_delta(before, telemetry.snapshot())
+            assert delta.get("trace.ctx_propagated", 0) == 1
+    finally:
+        telemetry.clear_trace_context()
+
+
+def test_adopt_trace_context_malformed_ignored():
+    telemetry.clear_trace_context()
+    for bad in (None, 17, "nope", {}, {"id": "xyz", "span": "0"},
+                {"id": "10", "span": []}, {"id": "0", "span": "0"}):
+        assert not telemetry.adopt_trace_context(bad)
+        assert telemetry.get_trace_context()[0] == 0
+
+
+def test_trace_context_stamps_span_args():
+    if not telemetry.enabled():
+        pytest.skip("tracing is compiled out")
+    tid = telemetry.new_trace_id()
+    telemetry.trace_start()
+    try:
+        with telemetry.span("test.unlabeled"):
+            pass
+        telemetry.set_trace_context(tid, tid, 7)
+        with telemetry.span("test.labeled"):
+            pass
+    finally:
+        telemetry.clear_trace_context()
+        telemetry.trace_stop()
+    events = {e["name"]: e for e in telemetry.trace_dump()["traceEvents"]
+              if e.get("ph") == "X"}
+    lab = events["test.labeled"]
+    assert lab["args"]["trace_id"] == format(tid, "016x")
+    assert lab["args"]["parent"] == format(tid, "016x")
+    assert lab["args"]["lineage"] == 7
+    assert "trace_id" not in events["test.unlabeled"].get("args", {})
+
+
+def test_now_us_tracks_monotonic():
+    lo = time.monotonic_ns() // 1000
+    t = telemetry.now_us()
+    hi = time.monotonic_ns() // 1000
+    assert lo <= t <= hi  # no skew injected in this process
+
+
+def test_json_validate():
+    assert telemetry.json_validate('{"a": [1, 2.5, "x"], "b": null}')
+    assert telemetry.json_validate("[]")
+    # native parser rejects; the FATAL log line it prints is expected noise
+    assert not telemetry.json_validate('{"a": ')
+    assert not telemetry.json_validate("not json")
+    assert not telemetry.json_validate('{"a": 1} trailing')
+
+
+def test_lineage_helper():
+    assert telemetry.lineage({"lineage": 99}) == 99
+    assert telemetry.lineage({}) == -1
+
+    class B:
+        _lineage = (3 << 32) | 5
+    assert telemetry.lineage(B()) == (3 << 32) | 5
+    assert telemetry.lineage(object()) == -1

@@ -363,3 +363,118 @@ def test_shard_client_wire_roundtrip():
         assert snap["shards"]["0"]["stolen"][0]["to"] == 1
     finally:
         agg.close()
+
+
+# ---- distributed tracing: clock probes and the job-trace merge --------------
+
+
+def test_metrics_clock_probe_and_job_trace_merge():
+    """One push with clock=True returns a sane (rtt, offset) probe, and a
+    shipped trace dump comes back from job_trace() rank-labeled; a later
+    traceless push keeps the newest shipped trace (cumulative view)."""
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator, push_once
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        fake = {"traceEvents": [
+            {"name": "fake.span", "cat": "x", "ph": "X", "pid": 1, "tid": 2,
+             "ts": 1000, "dur": 10}]}
+        probe = push_once("127.0.0.1", agg.port, rank=3, clock=True,
+                          trace=fake)
+        assert probe is not None
+        rtt, off = probe
+        # same machine, same monotonic epoch: the offset can never exceed
+        # the probe's own error bound
+        assert rtt >= 0
+        assert abs(off) <= max(rtt, 1)
+        merged = agg.job_trace()
+        ev = next(e for e in merged["traceEvents"]
+                  if e["name"] == "fake.span")
+        assert ev["pid"] == 3  # host lane = rank
+        meta = next(e for e in merged["traceEvents"]
+                    if e["name"] == "process_name" and e["pid"] == 3)
+        assert meta["args"]["name"].startswith("rank 3 ")
+        od = merged["otherData"]
+        assert od["spans_per_host"]["3"] == 1
+        assert od["hosts"] == len(od["spans_per_host"])
+        assert "3" in od["offsets_us"]
+        # an ordinary push without a trace must not erase the merged view
+        assert push_once("127.0.0.1", agg.port, rank=3) is None
+        merged2 = agg.job_trace()
+        assert any(e["name"] == "fake.span" for e in merged2["traceEvents"])
+    finally:
+        agg.close()
+
+
+def test_job_trace_empty_without_pushes():
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    try:
+        merged = agg.job_trace()
+        od = merged["otherData"]
+        assert od["spans"] == sum(od["spans_per_host"].values())
+        assert od["max_abs_offset_us"] == 0 or "tracker" in od["offsets_us"]
+    finally:
+        agg.close()
+
+
+_SKEW_CHILD = r"""
+import sys, time
+sys.path.insert(0, sys.argv[1])
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.tracker import metrics as tm
+
+port = int(sys.argv[2])
+telemetry.trace_start()
+telemetry.record_span("clockskew.send", telemetry.now_us(), 50)
+time.sleep(0.3)   # real-time gap >> probe error: ordering can't flake
+p = tm.MetricsPusher("127.0.0.1", port, rank=0, interval_s=3600.0)
+# 3 manual pushes: the offset gauge set during push N ships in push N+1
+ok = all(p.push() for _ in range(3))
+print("CHILD", ok, p.clock_offset_us, flush=True)
+sys.exit(0 if ok else 1)
+"""
+
+
+def test_job_trace_two_process_clock_skew(tmp_path):
+    """A child with a deliberately skewed clock (DMLCTPU_CLOCK_SKEW_US
+    shifts its now_us by +5s) records a send span, then pushes probes +
+    trace.  The merge must (a) estimate the skew to within the probe
+    error, and (b) order the child's send before the tracker's receive
+    on the aligned axis — raw timestamps would invert that order by ~5s.
+    """
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.tracker.metrics import MetricsAggregator
+    if not telemetry.enabled():
+        pytest.skip("tracing/gauges are compiled out")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    skew = 5_000_000
+    agg = MetricsAggregator(host_ip="127.0.0.1", port=0)
+    telemetry.trace_start()
+    try:
+        env = dict(os.environ, DMLCTPU_CLOCK_SKEW_US=str(skew))
+        proc = subprocess.run(
+            [sys.executable, "-c", _SKEW_CHILD, repo, str(agg.port)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # tracker-side "receive": strictly after the child's send in real
+        # time, recorded on the unskewed reference clock
+        t_recv = telemetry.now_us()
+        telemetry.record_span("clockskew.recv", t_recv, 50)
+        merged = agg.job_trace()
+    finally:
+        telemetry.trace_stop()
+        agg.close()
+    off = merged["otherData"]["offsets_us"]["0"]
+    # the estimate must recover the injected skew (error bound ~ rtt/2;
+    # 1s of slack tolerates arbitrary CI scheduling noise)
+    assert abs(off + skew) < 1_000_000, f"offset {off} vs skew {-skew}"
+    send = next(e for e in merged["traceEvents"]
+                if e["name"] == "clockskew.send")
+    recv = next(e for e in merged["traceEvents"]
+                if e["name"] == "clockskew.recv")
+    assert send["pid"] == 0 and recv["pid"] == -1
+    assert send["ts"] < recv["ts"], "clock alignment failed to order send " \
+        f"before receive: send={send['ts']} recv={recv['ts']}"
+    # and the alignment mattered: the raw (unshifted) send timestamp sits
+    # ~5s in the future, after the receive
+    assert send["ts"] - off > recv["ts"]
