@@ -496,6 +496,29 @@ int DmlcTpuFlightRecordJson(const char* reason, const char** out);
 /* the record dumped by the most recent watchdog stall ("" when none). */
 int DmlcTpuWatchdogLastRecordJson(const char** out);
 
+/* ---- time-series sampler (dmlctpu/timeseries.h) --------------------------- */
+/* (Re)arm the background sampler: every registered counter/gauge is sampled
+ * into fixed-size two-resolution rings (fine ticks + coarse rollups) so
+ * windowed rates stay derivable in bounded memory however long the process
+ * lives.  Any arg <=0 falls back to its DMLCTPU_TS_* env knob / built-in
+ * default (tick 1000 ms, 600 fine slots, 30 ticks per rollup, 960 coarse
+ * slots).  Arming also installs the crash-forensics black box (fatal-log
+ * hook + SIGABRT/SIGTERM flight dump).  No-op when telemetry is compiled
+ * out. */
+int DmlcTpuTimeseriesStart(int64_t tick_ms, int64_t fine_slots,
+                           int64_t coarse_every, int64_t coarse_slots);
+int DmlcTpuTimeseriesStop(void);
+int DmlcTpuTimeseriesActive(int* out);
+/* take one synchronous sample tick (deterministic ring driving for tests). */
+int DmlcTpuTimeseriesSample(void);
+/* Full dump: {"enabled","active","tick_ms",...,"series":{name:{"kind",
+ * "rate_per_s","fine":[[t_us,v]...],"coarse":[[t_us,v]...]}}}; pointer
+ * valid until the next telemetry call on the same thread. */
+int DmlcTpuTimeseriesJson(const char** out);
+/* same, with each ring truncated to its newest `points` entries (<=0: 60) —
+ * the bounded form that rides flight records and the metrics push. */
+int DmlcTpuTimeseriesTailJson(int points, const char** out);
+
 /* ---- deterministic fault injection (dmlctpu/fault.h) ---------------------- */
 /* *out = 1 when the fault registry was compiled in (DMLCTPU_FAULTS=1, the
  * default); 0 in a -DDMLCTPU_FAULTS=0 build, where Arm with a nonempty spec

@@ -64,6 +64,18 @@ inline const char* SeverityName(LogSeverity s) {
 
 void Emit(LogSeverity severity, const char* file, int line, const std::string& msg);
 
+/*! \brief hook invoked (unlocked, after the sink) whenever a kFatal message
+ *  is emitted — the crash-forensics black box (watchdog.cc) installs one to
+ *  dump a flight record before the CHECK/LOG(FATAL) throw unwinds.  Must
+ *  not throw.  Empty hook removes.  Thread-safe like SetSink. */
+using FatalHook = std::function<void(const std::string&)>;
+void SetFatalHook(FatalHook hook);
+
+/*! \brief the last ~128 emitted log lines (every severity that reached
+ *  Emit, newest last) as a JSON array of strings — the bounded always-on
+ *  log tail that rides flight records.  Lines are truncated to 400 chars. */
+std::string TailJson();
+
 /*! \brief demangled stack trace of the calling thread, one frame per line
  *  (reference include/dmlc/logging.h:76-96 capability).  Controlled by env:
  *  DMLCTPU_LOG_STACK_TRACE=0 disables (default on),

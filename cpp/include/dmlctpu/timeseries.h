@@ -1,0 +1,82 @@
+// dmlctpu/timeseries.h — always-on time-series sampler over the telemetry
+// registry.
+//
+// A single background thread samples every registered counter and gauge into
+// fixed-size per-series ring buffers at two resolutions: a fine ring of
+// ~1 s ticks covering the last ~10 minutes, and a coarse ring of rollups
+// (one point per `coarse_every` ticks — counters keep the window-end
+// cumulative value, gauges keep the window max) covering hours beyond.
+// Memory is bounded no matter how long the process lives: rings are
+// preallocated, the tracked-series set is capped, and overflow is counted
+// (`timeseries.series_dropped`) instead of allocated.  Each tick also
+// publishes host resource gauges from procfs (`resource.rss_bytes`,
+// `resource.fd_count`) and a cumulative CPU-time counter
+// (`resource.cpu_ms`) — zero-stubbed off Linux — so resource headroom rides
+// the same rings, the 0xff98 metrics push, and Prometheus unchanged.
+// Windowed per-second rates are derived on demand from the fine ring with
+// counter-restart clamping (negative deltas clamp to zero, mirroring
+// telemetry.counters_delta).  See doc/observability.md ("Always-on
+// operation").
+//
+// With -DDMLCTPU_TELEMETRY=0 everything here is an inline no-op.
+#ifndef DMLCTPU_TIMESERIES_H_
+#define DMLCTPU_TIMESERIES_H_
+
+#include <cstdint>
+#include <string>
+
+#include "dmlctpu/telemetry.h"
+
+namespace dmlctpu {
+namespace telemetry {
+
+struct TimeseriesOptions {
+  /*! \brief sampling period; <=0 reads DMLCTPU_TS_TICK_MS (default 1000) */
+  int64_t tick_ms = 0;
+  /*! \brief fine-ring capacity in ticks; <=0 reads DMLCTPU_TS_FINE_SLOTS
+   *  (default 600 — ten minutes at the default tick) */
+  int64_t fine_slots = 0;
+  /*! \brief fine ticks per coarse rollup point (default 30) */
+  int64_t coarse_every = 0;
+  /*! \brief coarse-ring capacity in points; <=0 reads
+   *  DMLCTPU_TS_COARSE_SLOTS (default 960 — eight hours at the defaults) */
+  int64_t coarse_slots = 0;
+};
+
+#if DMLCTPU_TELEMETRY
+
+/*! \brief (re)arm the sampler thread with these options.  A second Start
+ *  replaces the configuration and clears the rings (latest options win);
+ *  pair every Start with a Stop (the Python binding refcounts for you). */
+void TimeseriesStart(const TimeseriesOptions& opts);
+/*! \brief stop and join the sampler thread (rings keep their contents so a
+ *  post-mortem Json() still serves the tail; no-op when not running). */
+void TimeseriesStop();
+/*! \brief true while the sampler thread is armed. */
+bool TimeseriesActive();
+/*! \brief take one synchronous sample tick right now (works armed or not —
+ *  deterministic ring driving for tests; armed, it interleaves safely). */
+void TimeseriesSample();
+/*! \brief full dump: {"enabled","active","tick_ms","fine_slots",
+ *  "coarse_every","coarse_slots","now_us","ticks","series":{name:
+ *  {"kind","rate_per_s","fine":[[t_us,v]...],"coarse":[[t_us,v]...]}}}. */
+std::string TimeseriesJson();
+/*! \brief bounded tail: same shape as TimeseriesJson() but each ring is
+ *  truncated to its most recent `points` entries (<=0 means 60) — the form
+ *  that rides flight records and the 0xff98 metrics push. */
+std::string TimeseriesTailJson(int points);
+
+#else  // DMLCTPU_TELEMETRY == 0
+
+inline void TimeseriesStart(const TimeseriesOptions&) {}
+inline void TimeseriesStop() {}
+inline bool TimeseriesActive() { return false; }
+inline void TimeseriesSample() {}
+inline std::string TimeseriesJson() { return "{\"enabled\":false}"; }
+inline std::string TimeseriesTailJson(int) { return "{\"enabled\":false}"; }
+
+#endif  // DMLCTPU_TELEMETRY
+
+}  // namespace telemetry
+}  // namespace dmlctpu
+#endif  // DMLCTPU_TIMESERIES_H_

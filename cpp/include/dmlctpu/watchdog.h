@@ -53,12 +53,23 @@ uint64_t WatchdogStallCount();
 /*! \brief build a flight record right now (same JSON the watchdog dumps):
  *  {"enabled","reason","now_us","stall_count","deadline_ms","stalled_stage",
  *   "stages":[{stage,counter,value,progressed,age_us}...],
- *   "registry":<SnapshotJson>,"trace":<TraceDumpJson>}.
+ *   "registry":<SnapshotJson>,"trace":<TraceDumpJson>,
+ *   "timeseries":<TimeseriesTailJson>,"log_tail":<log::TailJson>}.
  *  Progress ages come from the armed watchdog's samples; unarmed, ages are
  *  -1 and stalled_stage is "". */
 std::string FlightRecordJson(const std::string& reason);
 /*! \brief the record from the most recent stall ("" when none fired). */
 std::string LastFlightRecordJson();
+
+/*! \brief install the crash-forensics black box (idempotent): a kFatal log
+ *  hook plus SIGABRT/SIGTERM handlers that dump one flight record — trace
+ *  ring tail, time-series tail, log tail — to the DMLCTPU_WATCHDOG_DUMP
+ *  path (or the armed watchdog's dump_path) before the process dies.  The
+ *  signal path is best-effort by design: it allocates and may take locks,
+ *  which is undefined in a handler, but a lost dump on a torn process is
+ *  strictly better than no dump (doc/observability.md "Always-on
+ *  operation").  Armed automatically by WatchdogStart and TimeseriesStart. */
+void InstallBlackBox();
 
 #else  // DMLCTPU_TELEMETRY == 0
 
@@ -70,6 +81,7 @@ inline std::string FlightRecordJson(const std::string&) {
   return "{\"enabled\":false}";
 }
 inline std::string LastFlightRecordJson() { return std::string(); }
+inline void InstallBlackBox() {}
 
 #endif  // DMLCTPU_TELEMETRY
 

@@ -21,6 +21,7 @@
 #include "dmlctpu/recordio.h"
 #include "dmlctpu/stream.h"
 #include "dmlctpu/telemetry.h"
+#include "dmlctpu/timeseries.h"
 #include "dmlctpu/watchdog.h"
 
 namespace {
@@ -320,6 +321,58 @@ int DmlcTpuFlightRecordJson(const char* reason, const char** out) {
 int DmlcTpuWatchdogLastRecordJson(const char** out) {
   return Guard([&] {
     telemetry_json = dmlctpu::telemetry::LastFlightRecordJson();
+    *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+/* ---- time-series sampler -------------------------------------------------- */
+
+int DmlcTpuTimeseriesStart(int64_t tick_ms, int64_t fine_slots,
+                           int64_t coarse_every, int64_t coarse_slots) {
+  return Guard([&] {
+    dmlctpu::telemetry::TimeseriesOptions opts;
+    opts.tick_ms = tick_ms;
+    opts.fine_slots = fine_slots;
+    opts.coarse_every = coarse_every;
+    opts.coarse_slots = coarse_slots;
+    dmlctpu::telemetry::TimeseriesStart(opts);
+    return 0;
+  });
+}
+
+int DmlcTpuTimeseriesStop(void) {
+  return Guard([&] {
+    dmlctpu::telemetry::TimeseriesStop();
+    return 0;
+  });
+}
+
+int DmlcTpuTimeseriesActive(int* out) {
+  return Guard([&] {
+    *out = dmlctpu::telemetry::TimeseriesActive() ? 1 : 0;
+    return 0;
+  });
+}
+
+int DmlcTpuTimeseriesSample(void) {
+  return Guard([&] {
+    dmlctpu::telemetry::TimeseriesSample();
+    return 0;
+  });
+}
+
+int DmlcTpuTimeseriesJson(const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::telemetry::TimeseriesJson();
+    *out = telemetry_json.c_str();
+    return 0;
+  });
+}
+
+int DmlcTpuTimeseriesTailJson(int points, const char** out) {
+  return Guard([&] {
+    telemetry_json = dmlctpu::telemetry::TimeseriesTailJson(points);
     *out = telemetry_json.c_str();
     return 0;
   });

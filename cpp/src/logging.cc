@@ -14,6 +14,7 @@
 #include <ctime>
 #include <memory>
 #include <mutex>
+#include <vector>
 
 namespace dmlctpu {
 namespace log {
@@ -31,11 +32,81 @@ Sink& InstalledSink() {
   static Sink* sink = new Sink();  // empty => default stderr sink
   return *sink;
 }
+FatalHook& InstalledFatalHook() {
+  static FatalHook* hook = new FatalHook();
+  return *hook;
+}
+
+// Always-on bounded log tail: the last kTailLines formatted lines, kept in
+// a ring so a crash dump can show what the process was saying right before
+// it died.  Its own mutex — appending must never contend with a slow sink.
+constexpr size_t kTailLines = 128;
+constexpr size_t kTailLineChars = 400;
+struct LogTail {
+  std::mutex mu;
+  std::vector<std::string> lines;  // ring once full
+  size_t start = 0;
+};
+LogTail& Tail() {
+  static LogTail* t = new LogTail();  // leaked: usable during exit
+  return *t;
+}
+
+void TailAppend(LogSeverity severity, const char* file, int line,
+                const std::string& msg) {
+  std::string entry = std::string(SeverityName(severity)) + " " + file + ":" +
+                      std::to_string(line) + ": " + msg;
+  if (entry.size() > kTailLineChars) entry.resize(kTailLineChars);
+  LogTail& t = Tail();
+  std::lock_guard<std::mutex> lk(t.mu);
+  if (t.lines.size() < kTailLines) {
+    t.lines.push_back(std::move(entry));
+  } else {
+    t.lines[t.start] = std::move(entry);
+    t.start = (t.start + 1) % t.lines.size();
+  }
+}
 }  // namespace
 
 void SetSink(Sink sink) {
   std::lock_guard<std::mutex> lk(SinkMutex());
   InstalledSink() = std::move(sink);
+}
+
+void SetFatalHook(FatalHook hook) {
+  std::lock_guard<std::mutex> lk(SinkMutex());
+  InstalledFatalHook() = std::move(hook);
+}
+
+std::string TailJson() {
+  LogTail& t = Tail();
+  std::lock_guard<std::mutex> lk(t.mu);
+  std::string out = "[";
+  for (size_t i = 0; i < t.lines.size(); ++i) {
+    const std::string& s = t.lines[(t.start + i) % t.lines.size()];
+    if (i) out += ',';
+    out += '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += c;
+          }
+      }
+    }
+    out += '"';
+  }
+  out += ']';
+  return out;
 }
 
 #ifndef DMLCTPU_HAS_BACKTRACE
@@ -77,23 +148,29 @@ std::string StackTrace(int skip) {
 #endif  // DMLCTPU_HAS_BACKTRACE
 
 void Emit(LogSeverity severity, const char* file, int line, const std::string& msg) {
+  TailAppend(severity, file, line, msg);
   Sink sink;
+  FatalHook hook;
   {
     std::lock_guard<std::mutex> lk(SinkMutex());
     sink = InstalledSink();
+    if (severity == LogSeverity::kFatal) hook = InstalledFatalHook();
   }
   if (sink) {
     std::string where = std::string(file) + ":" + std::to_string(line);
     sink(severity, where.c_str(), msg);
-    return;
+  } else {
+    std::time_t now = std::time(nullptr);
+    std::tm tm_buf;
+    localtime_r(&now, &tm_buf);
+    char ts[16];
+    std::strftime(ts, sizeof(ts), "%H:%M:%S", &tm_buf);
+    std::fprintf(stderr, "[%s] %s %s:%d: %s\n", ts, SeverityName(severity), file, line,
+                 msg.c_str());
   }
-  std::time_t now = std::time(nullptr);
-  std::tm tm_buf;
-  localtime_r(&now, &tm_buf);
-  char ts[16];
-  std::strftime(ts, sizeof(ts), "%H:%M:%S", &tm_buf);
-  std::fprintf(stderr, "[%s] %s %s:%d: %s\n", ts, SeverityName(severity), file, line,
-               msg.c_str());
+  // black-box dump AFTER the line is visible; invoked unlocked (the hook
+  // builds a flight record and must be free to take other locks)
+  if (hook) hook(msg);
 }
 
 }  // namespace log

@@ -6,6 +6,7 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <cstdlib>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -62,14 +63,31 @@ std::atomic<int64_t> g_ctx_lineage{-1};
 
 // Per-thread buffer.  The shared_ptr in the global list keeps it alive past
 // thread exit so TraceDumpJson can still read events from finished workers.
+// `events` is a fixed-capacity ring once full: new spans overwrite the
+// OLDEST (start walks forward), so an always-on service keeps the newest
+// tail for crash forensics instead of freezing the first N spans forever.
 struct ThreadTraceBuf {
   std::mutex mu;
   std::vector<TraceEvent> events;
+  size_t start = 0;  // index of the oldest event once the ring is full
   uint32_t tid = 0;
   uint64_t dropped = 0;
 };
 
-constexpr size_t kMaxEventsPerThread = 1 << 18;  // ~16MB/thread worst case
+// Ring capacity per thread: DMLCTPU_TRACE_RING_EVENTS, default 2^18
+// (~16MB/thread worst case).  Read once — the cap must not move while
+// rings are partially rewrapped.
+size_t TraceRingCap() {
+  static const size_t cap = [] {
+    const char* v = std::getenv("DMLCTPU_TRACE_RING_EVENTS");
+    if (v != nullptr && v[0] != '\0') {
+      const long long n = std::atoll(v);
+      if (n > 0) return static_cast<size_t>(std::min<long long>(n, 1 << 24));
+    }
+    return static_cast<size_t>(1) << 18;
+  }();
+  return cap;
+}
 
 std::atomic<bool> g_trace_active{false};
 
@@ -105,9 +123,13 @@ void PushEvent(TraceEvent&& ev) {
     ev.lineage = g_ctx_lineage.load(std::memory_order_relaxed);
   }
   std::lock_guard<std::mutex> lk(b.mu);
-  if (b.events.size() >= kMaxEventsPerThread) {
+  const size_t cap = TraceRingCap();
+  if (b.events.size() >= cap) {
+    // drop-oldest: overwrite the ring head so the newest spans survive
+    b.events[b.start] = std::move(ev);
+    b.start = (b.start + 1) % b.events.size();
     ++b.dropped;
-    static Counter& drops = Registry::Get()->counter("trace.spans_dropped");
+    static Counter& drops = Registry::Get()->counter("trace.events_dropped");
     drops.Add(1);
     return;
   }
@@ -304,6 +326,7 @@ void TraceStart() {
   for (auto& b : g.bufs) {
     std::lock_guard<std::mutex> blk(b->mu);
     b->events.clear();
+    b->start = 0;
     b->dropped = 0;
   }
   g_trace_active.store(true, std::memory_order_release);
@@ -328,7 +351,9 @@ std::string TraceDumpJson() {
   for (auto& b : g.bufs) {
     std::lock_guard<std::mutex> blk(b->mu);
     dropped += b->dropped;
-    for (const TraceEvent& ev : b->events) {
+    // walk the ring oldest-first so the dump stays chronological per thread
+    for (size_t i = 0; i < b->events.size(); ++i) {
+      const TraceEvent& ev = b->events[(b->start + i) % b->events.size()];
       if (!first) out += ',';
       first = false;
       out += "{\"name\":\"";

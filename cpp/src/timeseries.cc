@@ -1,0 +1,379 @@
+// timeseries.cc — the background sampler thread and two-resolution ring
+// buffers behind dmlctpu/timeseries.h.
+#include <dmlctpu/timeseries.h>
+
+#if DMLCTPU_TELEMETRY
+
+#include <dmlctpu/watchdog.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#ifdef __linux__
+#include <dirent.h>
+#include <unistd.h>
+#endif
+
+namespace dmlctpu {
+namespace telemetry {
+namespace {
+
+int64_t env_i64(const char* name, int64_t fallback) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || v[0] == '\0') return fallback;
+  long long n = std::atoll(v);
+  return n > 0 ? static_cast<int64_t>(n) : fallback;
+}
+
+// ---- host resource accounting (procfs; zero-stub off Linux) -----------------
+
+struct ResourceSample {
+  int64_t rss_bytes = 0;
+  int64_t fd_count = 0;
+  int64_t cpu_ms = 0;  // cumulative process CPU (utime+stime)
+  bool ok = false;
+};
+
+#ifdef __linux__
+ResourceSample ReadProcfs() {
+  ResourceSample r;
+  // RSS: /proc/self/statm field 2, in pages
+  if (FILE* f = std::fopen("/proc/self/statm", "r")) {
+    long long size = 0, resident = 0;
+    if (std::fscanf(f, "%lld %lld", &size, &resident) == 2) {
+      r.rss_bytes = resident * static_cast<int64_t>(sysconf(_SC_PAGESIZE));
+      r.ok = true;
+    }
+    std::fclose(f);
+  }
+  // open fds: directory entries under /proc/self/fd (minus . and ..)
+  if (DIR* d = ::opendir("/proc/self/fd")) {
+    int64_t n = 0;
+    while (::readdir(d) != nullptr) ++n;
+    ::closedir(d);
+    r.fd_count = n > 2 ? n - 2 : 0;
+  }
+  // CPU: /proc/self/stat fields 14/15 (utime/stime, clock ticks).  comm
+  // (field 2) may contain spaces, so scan from after the closing paren.
+  if (FILE* f = std::fopen("/proc/self/stat", "r")) {
+    char buf[1024];
+    size_t got = std::fread(buf, 1, sizeof(buf) - 1, f);
+    std::fclose(f);
+    buf[got] = '\0';
+    if (const char* p = std::strrchr(buf, ')')) {
+      long long utime = 0, stime = 0;
+      // after ") S" come fields 4..13 (10 numbers), then utime stime
+      if (std::sscanf(p + 1, " %*c %*s %*s %*s %*s %*s %*s %*s %*s %*s %*s "
+                             "%lld %lld", &utime, &stime) == 2) {
+        const int64_t hz = sysconf(_SC_CLK_TCK);
+        if (hz > 0) r.cpu_ms = (utime + stime) * 1000 / hz;
+      }
+    }
+  }
+  return r;
+}
+#else
+ResourceSample ReadProcfs() { return ResourceSample(); }
+#endif
+
+// ---- two-resolution rings ---------------------------------------------------
+
+struct Point {
+  int64_t t_us;
+  int64_t v;
+};
+
+// Fixed-capacity ring: `buf` grows to capacity once, then `start` walks.
+struct Ring {
+  std::vector<Point> buf;
+  size_t start = 0;  // index of the oldest point once the ring is full
+
+  void Push(const Point& p, size_t cap) {
+    if (buf.size() < cap) {
+      buf.push_back(p);
+    } else {
+      buf[start] = p;
+      start = (start + 1) % buf.size();
+    }
+  }
+  size_t Count() const { return buf.size(); }
+  const Point& At(size_t i) const {  // i = 0 is oldest
+    return buf[(start + i) % buf.size()];
+  }
+};
+
+struct Series {
+  bool is_counter = false;
+  Ring fine;
+  Ring coarse;
+  // scratch for the coarse rollup: running max (gauge) over the open window;
+  // counters just keep the window-end cumulative value
+  int64_t window_max = 0;
+  bool window_open = false;
+};
+
+/*! \brief windowed per-second rate over the newest `window` fine points with
+ *  counter-restart clamping: each negative inter-tick delta clamps to zero
+ *  (the process restarted; mirrors telemetry.counters_delta).  Returns 0
+ *  with fewer than two points or a zero time span. */
+double WindowedRate(const Ring& fine, size_t window) {
+  const size_t n = fine.Count();
+  if (n < 2) return 0.0;
+  const size_t take = std::min(n, window < 2 ? 2 : window);
+  const size_t first = n - take;
+  int64_t sum = 0;
+  for (size_t i = first + 1; i < n; ++i) {
+    const int64_t d = fine.At(i).v - fine.At(i - 1).v;
+    if (d > 0) sum += d;
+  }
+  const int64_t span_us = fine.At(n - 1).t_us - fine.At(first).t_us;
+  if (span_us <= 0) return 0.0;
+  return static_cast<double>(sum) * 1e6 / static_cast<double>(span_us);
+}
+
+constexpr size_t kMaxSeries = 512;     // bound vs runaway name minting
+constexpr size_t kRateWindowTicks = 60;  // ~1 min at the default tick
+
+class Sampler {
+ public:
+  static Sampler& Get() {
+    static Sampler* s = new Sampler();  // leaked: process-lifetime
+    return *s;
+  }
+
+  void Start(const TimeseriesOptions& opts) {
+    Stop();  // replace-restart: latest options win
+    std::lock_guard<std::mutex> lk(mu_);
+    opts_ = Resolve(opts);
+    series_.clear();
+    ticks_ = 0;
+    last_cpu_ms_ = -1;
+    stop_.store(false, std::memory_order_release);
+    running_ = true;
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  void Stop() {
+    std::thread joinme;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!running_) return;
+      stop_.store(true, std::memory_order_release);
+      running_ = false;
+      joinme = std::move(thread_);
+    }
+    if (joinme.joinable()) joinme.join();
+  }
+
+  bool Active() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return running_;
+  }
+
+  void SampleNow() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (opts_.tick_ms <= 0) opts_ = Resolve(TimeseriesOptions());
+    TickLocked(NowUs());
+  }
+
+  std::string Json(int tail_points) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return JsonLocked(tail_points);
+  }
+
+ private:
+  TimeseriesOptions Resolve(TimeseriesOptions o) {
+    if (o.tick_ms <= 0) o.tick_ms = env_i64("DMLCTPU_TS_TICK_MS", 1000);
+    if (o.fine_slots <= 0) {
+      o.fine_slots = env_i64("DMLCTPU_TS_FINE_SLOTS", 600);
+    }
+    if (o.coarse_every <= 0) o.coarse_every = 30;
+    if (o.coarse_slots <= 0) {
+      o.coarse_slots = env_i64("DMLCTPU_TS_COARSE_SLOTS", 960);
+    }
+    o.fine_slots = std::min<int64_t>(o.fine_slots, 1 << 20);
+    o.coarse_slots = std::min<int64_t>(o.coarse_slots, 1 << 20);
+    return o;
+  }
+
+  void PublishResourcesLocked() {
+    const ResourceSample r = ReadProcfs();
+    // off-Linux the gauges stay at their zero default — graceful stub
+    Registry* reg = Registry::Get();
+    static Gauge& rss = reg->gauge("resource.rss_bytes");
+    static Gauge& fds = reg->gauge("resource.fd_count");
+    rss.Set(r.rss_bytes);
+    fds.Set(r.fd_count);
+    if (r.cpu_ms > 0) {
+      static Counter& cpu = reg->counter("resource.cpu_ms");
+      if (last_cpu_ms_ >= 0 && r.cpu_ms > last_cpu_ms_) {
+        cpu.Add(static_cast<uint64_t>(r.cpu_ms - last_cpu_ms_));
+      }
+      last_cpu_ms_ = r.cpu_ms;
+    }
+  }
+
+  void TickLocked(int64_t now) {
+    PublishResourcesLocked();
+    static Counter& tick_counter = Registry::Get()->counter("timeseries.ticks");
+    tick_counter.Add(1);
+    const Snapshot snap = Snapshot::Capture();
+    uint64_t dropped = 0;
+    auto feed = [&](const std::string& name, int64_t v, bool is_counter) {
+      auto it = series_.find(name);
+      if (it == series_.end()) {
+        if (series_.size() >= kMaxSeries) {
+          ++dropped;
+          return;
+        }
+        it = series_.emplace(name, Series()).first;
+        it->second.is_counter = is_counter;
+      }
+      Series& s = it->second;
+      s.fine.Push(Point{now, v}, static_cast<size_t>(opts_.fine_slots));
+      if (!s.window_open || v > s.window_max) s.window_max = v;
+      s.window_open = true;
+    };
+    for (const auto& [name, v] : snap.counters) {
+      feed(name, static_cast<int64_t>(v), true);
+    }
+    for (const auto& [name, v] : snap.gauges) feed(name, v, false);
+    if (dropped > 0) {
+      static Counter& drops =
+          Registry::Get()->counter("timeseries.series_dropped");
+      drops.Add(dropped);
+    }
+    static Gauge& nseries = Registry::Get()->gauge("timeseries.series");
+    nseries.Set(static_cast<int64_t>(series_.size()));
+    ++ticks_;
+    if (ticks_ % opts_.coarse_every == 0) {
+      for (auto& [name, s] : series_) {
+        if (!s.window_open) continue;
+        // counters roll up as the window-end cumulative value (deltas and
+        // rates re-derive exactly); gauges keep the window max (a spike —
+        // an RSS peak, a full queue — must survive downsampling)
+        const int64_t v = s.is_counter && s.fine.Count() > 0
+                              ? s.fine.At(s.fine.Count() - 1).v
+                              : s.window_max;
+        s.coarse.Push(Point{now, v}, static_cast<size_t>(opts_.coarse_slots));
+        s.window_open = false;
+        s.window_max = 0;
+      }
+    }
+  }
+
+  static void AppendRing(std::string* out, const Ring& r, size_t tail) {
+    *out += '[';
+    const size_t n = r.Count();
+    const size_t first = tail > 0 && n > tail ? n - tail : 0;
+    for (size_t i = first; i < n; ++i) {
+      if (i != first) *out += ',';
+      const Point& p = r.At(i);
+      *out += '[' + std::to_string(p.t_us) + ',' + std::to_string(p.v) + ']';
+    }
+    *out += ']';
+  }
+
+  std::string JsonLocked(int tail_points) const {
+    const size_t tail =
+        tail_points < 0 ? 0 : static_cast<size_t>(tail_points);
+    std::string out = "{\"enabled\":true,\"active\":";
+    out += running_ ? "true" : "false";
+    out += ",\"tick_ms\":" + std::to_string(opts_.tick_ms);
+    out += ",\"fine_slots\":" + std::to_string(opts_.fine_slots);
+    out += ",\"coarse_every\":" + std::to_string(opts_.coarse_every);
+    out += ",\"coarse_slots\":" + std::to_string(opts_.coarse_slots);
+    out += ",\"now_us\":" + std::to_string(NowUs());
+    out += ",\"ticks\":" + std::to_string(ticks_);
+    out += ",\"series\":{";
+    bool first = true;
+    for (const auto& [name, s] : series_) {
+      if (!first) out += ',';
+      first = false;
+      out += '"';
+      // metric names are minted from identifier literals; escape-free append
+      // would be fine, but stay safe against arbitrary C-API names
+      for (char c : name) {
+        if (c == '"' || c == '\\') out += '\\';
+        out += c;
+      }
+      out += "\":{\"kind\":\"";
+      out += s.is_counter ? "counter" : "gauge";
+      out += '"';
+      if (s.is_counter) {
+        char buf[32];
+        std::snprintf(buf, sizeof(buf), "%.6f",
+                      WindowedRate(s.fine, kRateWindowTicks));
+        out += ",\"rate_per_s\":";
+        out += buf;
+      }
+      out += ",\"fine\":";
+      AppendRing(&out, s.fine, tail);
+      out += ",\"coarse\":";
+      AppendRing(&out, s.coarse, tail);
+      out += '}';
+    }
+    out += "}}";
+    return out;
+  }
+
+  void Loop() {
+    for (;;) {
+      int64_t tick_ms;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        tick_ms = opts_.tick_ms;
+      }
+      // Sliced plain-sleep polling (same rationale as watchdog.cc): this
+      // toolchain's timed cv waits bottom out in pthread_cond_clockwait,
+      // which its libtsan does not intercept.  20 ms slices keep Stop()
+      // prompt even at multi-second ticks.
+      for (int64_t slept = 0; slept < tick_ms;) {
+        if (stop_.load(std::memory_order_acquire)) return;
+        const int64_t slice = std::min<int64_t>(20, tick_ms - slept);
+        std::this_thread::sleep_for(std::chrono::milliseconds(slice));
+        slept += slice;
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stop_.load(std::memory_order_acquire)) return;
+      TickLocked(NowUs());
+    }
+  }
+
+  std::mutex mu_;
+  std::thread thread_;
+  bool running_ = false;           // guarded by mu_
+  std::atomic<bool> stop_{false};  // checked by the unlocked sleep slices
+  TimeseriesOptions opts_;
+  std::map<std::string, Series> series_;  // guarded by mu_
+  uint64_t ticks_ = 0;
+  int64_t last_cpu_ms_ = -1;
+};
+
+}  // namespace
+
+void TimeseriesStart(const TimeseriesOptions& opts) {
+  InstallBlackBox();  // an always-on sampler implies crash forensics
+  Sampler::Get().Start(opts);
+}
+void TimeseriesStop() { Sampler::Get().Stop(); }
+bool TimeseriesActive() { return Sampler::Get().Active(); }
+void TimeseriesSample() { Sampler::Get().SampleNow(); }
+std::string TimeseriesJson() { return Sampler::Get().Json(0); }
+std::string TimeseriesTailJson(int points) {
+  return Sampler::Get().Json(points <= 0 ? 60 : points);
+}
+
+}  // namespace telemetry
+}  // namespace dmlctpu
+
+#endif  // DMLCTPU_TELEMETRY

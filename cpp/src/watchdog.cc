@@ -7,6 +7,7 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <csignal>
 #include <cstdio>
 #include <cstdlib>
 #include <fstream>
@@ -15,6 +16,7 @@
 
 #include <dmlctpu/fault.h>
 #include <dmlctpu/logging.h>
+#include <dmlctpu/timeseries.h>
 
 namespace dmlctpu {
 namespace telemetry {
@@ -53,6 +55,26 @@ constexpr StageCounter kStages[] = {
     {"record", "record.batches"}, {"h2d", "h2d.batches"},
 };
 constexpr int kNumStages = sizeof(kStages) / sizeof(kStages[0]);
+
+// ---- crash-forensics black box ---------------------------------------------
+// Dump path for the signal path, resolved ONCE at install time from
+// DMLCTPU_WATCHDOG_DUMP: a signal handler must not take the watchdog mutex
+// to read the armed options, so it reads this never-mutated string instead.
+std::string* g_env_dump_path = nullptr;
+std::atomic<bool> g_blackbox_installed{false};
+// once-guard across the fatal/signal paths; also set by the stall-abort
+// path so the SIGABRT handler does not overwrite the (more precise) stall
+// record the watchdog just wrote
+std::atomic<bool> g_crash_dumping{false};
+
+void WriteRecordFile(const std::string& path, const std::string& rec) {
+  if (path.empty()) return;
+  std::FILE* f = std::fopen(path.c_str(), "w");
+  if (f != nullptr) {
+    std::fwrite(rec.data(), 1, rec.size(), f);
+    std::fclose(f);
+  }
+}
 
 class Watchdog {
  public:
@@ -102,6 +124,25 @@ class Watchdog {
     std::lock_guard<std::mutex> lk(mu_);
     if (running_) SampleLocked(NowUs());
     return BuildRecordLocked(reason);
+  }
+
+  /*! \brief flight record for a dying process (fatal hook / signal handler):
+   *  never blocks.  try_lock keeps the record as fresh as the normal path;
+   *  when the interrupted thread holds mu_, fall through to racy reads —
+   *  a slightly torn record beats deadlocking the crash. */
+  std::string BuildRecordCrash(const std::string& reason) {
+    if (mu_.try_lock()) {
+      std::lock_guard<std::mutex> lk(mu_, std::adopt_lock);
+      if (running_) SampleLocked(NowUs());
+      last_record_ = BuildRecordLocked(reason);
+      return last_record_;
+    }
+    return BuildRecordLocked(reason);
+  }
+
+  std::string DumpPath() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return opts_.dump_path;
   }
 
   std::string LastRecord() {
@@ -199,6 +240,11 @@ class Watchdog {
     out += ",\"faults\":" + fault::SnapshotJson();
     out += ",\"registry\":" + Registry::Get()->SnapshotJson();
     out += ",\"trace\":" + TraceDumpJson();
+    // the always-on tails: the last minute of every sampled series and the
+    // last ~128 log lines — what the process was doing and saying when it
+    // wedged or died
+    out += ",\"timeseries\":" + TimeseriesTailJson(60);
+    out += ",\"log_tail\":" + log::TailJson();
     out += "}";
     return out;
   }
@@ -267,6 +313,9 @@ class Watchdog {
                       " ms; stalled stage: " + stalled_for_log_ +
                       "; flight record: " + where);
         if (do_abort) {
+          // the stall record above IS the black box for this death; keep
+          // the SIGABRT handler from overwriting it with a generic one
+          g_crash_dumping.store(true, std::memory_order_release);
           std::fflush(nullptr);
           std::abort();
         }
@@ -285,9 +334,48 @@ class Watchdog {
   std::string stalled_for_log_;  // written under mu_, read by the one Loop
 };
 
+void CrashSignalHandler(int sig) {
+  if (!g_crash_dumping.exchange(true)) {
+    const char* what = sig == SIGABRT   ? "SIGABRT"
+                       : sig == SIGTERM ? "SIGTERM"
+                                        : "signal";
+    // the signal path only knows the env-configured path (never-mutated
+    // string — the armed options are behind a mutex a handler cannot take)
+    const std::string path =
+        g_env_dump_path != nullptr ? *g_env_dump_path : std::string();
+    if (!path.empty()) {
+      WriteRecordFile(path, Watchdog::Get().BuildRecordCrash(
+                                std::string("crash: ") + what));
+    }
+  }
+  std::signal(sig, SIG_DFL);
+  std::raise(sig);
+}
+
 }  // namespace
 
-void WatchdogStart(const WatchdogOptions& opts) { Watchdog::Get().Start(opts); }
+void InstallBlackBox() {
+  if (g_blackbox_installed.exchange(true)) return;
+  const char* env = std::getenv("DMLCTPU_WATCHDOG_DUMP");
+  g_env_dump_path = new std::string(env != nullptr ? env : "");
+  // CHECK/LOG(FATAL): the Error is often caught and handled upstream, so
+  // dump (last fatal wins) rather than die — and only when a dump path is
+  // configured, so routine caught CHECKs stay free
+  log::SetFatalHook([](const std::string& msg) {
+    std::string path = Watchdog::Get().DumpPath();
+    if (path.empty() && g_env_dump_path != nullptr) path = *g_env_dump_path;
+    if (path.empty()) return;
+    WriteRecordFile(path,
+                    Watchdog::Get().BuildRecordCrash("fatal: " + msg));
+  });
+  std::signal(SIGABRT, CrashSignalHandler);
+  std::signal(SIGTERM, CrashSignalHandler);
+}
+
+void WatchdogStart(const WatchdogOptions& opts) {
+  InstallBlackBox();
+  Watchdog::Get().Start(opts);
+}
 void WatchdogStop() { Watchdog::Get().Stop(); }
 bool WatchdogRunning() { return Watchdog::Get().Running(); }
 uint64_t WatchdogStallCount() { return Watchdog::Get().StallCount(); }

@@ -1,0 +1,347 @@
+// Tests for the always-on time-series sampler (dmlctpu/timeseries.h):
+// ring wraparound bit-exactness, the two-resolution downsample against a
+// naive reference, windowed-rate derivation under counter-restart clamping,
+// bounded rings over long runs, the flight-record black-box keys, and the
+// bounded per-thread trace ring with its exact drop counter.
+//
+// Built in the notelemetry tier too (-DDMLCTPU_TELEMETRY=0): the stub
+// branch must answer enabled:false and no-op everywhere.
+#include <algorithm>
+#include <cmath>
+#include <cstdlib>
+#include <chrono>
+#include <map>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dmlctpu/json.h"
+#include "dmlctpu/logging.h"
+#include "dmlctpu/telemetry.h"
+#include "dmlctpu/timeseries.h"
+#include "dmlctpu/watchdog.h"
+#include "testing.h"
+
+using namespace dmlctpu;           // NOLINT
+using namespace dmlctpu::telemetry;  // NOLINT
+
+namespace {
+
+/*! \brief walk an arbitrary JSON document; throws (via TCHECK) when
+ *  malformed. */
+void WalkJson(const std::string& text) {
+  std::istringstream is(text);
+  JSONReader reader(&is);
+  reader.SkipValue();
+}
+
+#if DMLCTPU_TELEMETRY
+
+struct SeriesLite {
+  std::string kind;
+  double rate_per_s = -1.0;
+  std::vector<std::pair<int64_t, int64_t>> fine;
+  std::vector<std::pair<int64_t, int64_t>> coarse;
+};
+
+struct TimeseriesDoc {
+  bool enabled = false;
+  bool active = false;
+  int64_t ticks = 0;
+  std::map<std::string, SeriesLite> series;
+};
+
+void ReadPoints(JSONReader* reader,
+                std::vector<std::pair<int64_t, int64_t>>* out) {
+  reader->BeginArray();
+  while (reader->NextArrayItem()) {
+    reader->BeginArray();
+    int64_t t = 0, v = 0;
+    TCHECK(reader->NextArrayItem());
+    reader->ReadNumber(&t);
+    TCHECK(reader->NextArrayItem());
+    reader->ReadNumber(&v);
+    TCHECK(!reader->NextArrayItem());
+    out->emplace_back(t, v);
+  }
+}
+
+TimeseriesDoc ParseTimeseries(const std::string& text) {
+  TimeseriesDoc doc;
+  std::istringstream is(text);
+  JSONReader reader(&is);
+  reader.BeginObject();
+  std::string key;
+  while (reader.NextObjectItem(&key)) {
+    if (key == "enabled") {
+      reader.ReadNumber(&doc.enabled);
+    } else if (key == "active") {
+      reader.ReadNumber(&doc.active);
+    } else if (key == "ticks") {
+      reader.ReadNumber(&doc.ticks);
+    } else if (key == "series") {
+      reader.BeginObject();
+      std::string name;
+      while (reader.NextObjectItem(&name)) {
+        SeriesLite s;
+        reader.BeginObject();
+        std::string k;
+        while (reader.NextObjectItem(&k)) {
+          if (k == "kind") {
+            reader.ReadString(&s.kind);
+          } else if (k == "rate_per_s") {
+            reader.ReadNumber(&s.rate_per_s);
+          } else if (k == "fine") {
+            ReadPoints(&reader, &s.fine);
+          } else if (k == "coarse") {
+            ReadPoints(&reader, &s.coarse);
+          } else {
+            reader.SkipValue();
+          }
+        }
+        doc.series[name] = std::move(s);
+      }
+    } else {
+      reader.SkipValue();
+    }
+  }
+  return doc;
+}
+
+/*! \brief (re)arm the sampler with deterministic options and a tick so long
+ *  the background thread never fires on its own, then stop the thread —
+ *  options survive Stop, so TimeseriesSample() drives exact manual ticks. */
+void ArmManual(int64_t fine_slots, int64_t coarse_every,
+               int64_t coarse_slots) {
+  TimeseriesOptions o;
+  o.tick_ms = 3600 * 1000;
+  o.fine_slots = fine_slots;
+  o.coarse_every = coarse_every;
+  o.coarse_slots = coarse_slots;
+  TimeseriesStart(o);
+  TimeseriesStop();
+}
+
+/*! \brief one manual tick, with enough wall time between ticks that every
+ *  fine point gets a distinct steady-clock microsecond (rate spans > 0). */
+void Tick() {
+  std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  TimeseriesSample();
+}
+
+TESTCASE(ring_wraparound_bit_exact) {
+  ArmManual(/*fine_slots=*/4, /*coarse_every=*/1000, /*coarse_slots=*/8);
+  Counter& c = Registry::Get()->counter("tst.ring");
+  c.Reset();
+  for (int i = 0; i < 7; ++i) {
+    c.Add(1);
+    Tick();
+  }
+  TimeseriesDoc doc = ParseTimeseries(TimeseriesJson());
+  WalkJson(TimeseriesJson());
+  EXPECT_TRUE(doc.enabled);
+  const SeriesLite& s = doc.series.at("tst.ring");
+  EXPECT_EQV(s.kind, std::string("counter"));
+  // 7 pushes through a 4-slot ring keep exactly the newest 4, in order
+  EXPECT_EQV(s.fine.size(), size_t(4));
+  for (int i = 0; i < 4; ++i) {
+    EXPECT_EQV(s.fine[i].second, int64_t(4 + i));
+  }
+  for (int i = 1; i < 4; ++i) {
+    EXPECT_TRUE(s.fine[i].first > s.fine[i - 1].first);
+  }
+}
+
+TESTCASE(coarse_downsample_matches_naive_reference) {
+  ArmManual(/*fine_slots=*/64, /*coarse_every=*/3, /*coarse_slots=*/2);
+  Counter& c = Registry::Get()->counter("tst.ds_counter");
+  Gauge& g = Registry::Get()->gauge("tst.ds_gauge");
+  c.Reset();
+  const int64_t cadds[9] = {10, 0, 5, 7, 7, 1, 0, 2, 9};
+  const int64_t gvals[9] = {5, 9, 2, 1, 1, 8, 3, 0, 0};
+  // naive reference, computed independently of the sampler: a counter
+  // window rolls up as its end-of-window cumulative value; a gauge window
+  // as its max (spikes must survive downsampling)
+  std::vector<int64_t> want_c, want_g;
+  int64_t cum = 0;
+  for (int w = 0; w < 3; ++w) {
+    int64_t gmax = gvals[w * 3];
+    for (int i = w * 3; i < w * 3 + 3; ++i) {
+      cum += cadds[i];
+      gmax = std::max(gmax, gvals[i]);
+    }
+    want_c.push_back(cum);
+    want_g.push_back(gmax);
+  }
+  for (int i = 0; i < 9; ++i) {
+    c.Add(cadds[i]);
+    g.Set(gvals[i]);
+    Tick();
+  }
+  TimeseriesDoc doc = ParseTimeseries(TimeseriesJson());
+  const SeriesLite& sc = doc.series.at("tst.ds_counter");
+  const SeriesLite& sg = doc.series.at("tst.ds_gauge");
+  EXPECT_EQV(sg.kind, std::string("gauge"));
+  // 3 rollups through a 2-slot coarse ring keep the newest 2
+  EXPECT_EQV(sc.coarse.size(), size_t(2));
+  EXPECT_EQV(sg.coarse.size(), size_t(2));
+  for (int i = 0; i < 2; ++i) {
+    EXPECT_EQV(sc.coarse[i].second, want_c[i + 1]);
+    EXPECT_EQV(sg.coarse[i].second, want_g[i + 1]);
+  }
+}
+
+TESTCASE(windowed_rate_clamps_counter_restart) {
+  ArmManual(/*fine_slots=*/64, /*coarse_every=*/1000, /*coarse_slots=*/8);
+  Counter& c = Registry::Get()->counter("tst.rate");
+  c.Reset();
+  c.Add(100);
+  Tick();
+  c.Add(100);
+  Tick();
+  c.Reset();  // counter restart: the next inter-tick delta is -200
+  Tick();
+  c.Add(50);
+  Tick();
+  TimeseriesDoc doc = ParseTimeseries(TimeseriesJson());
+  const SeriesLite& s = doc.series.at("tst.rate");
+  EXPECT_EQV(s.fine.size(), size_t(4));
+  EXPECT_EQV(s.fine[2].second, int64_t(0));  // the restarted read landed
+  // naive reference over the SAME points the sampler served: positive
+  // deltas only (counters_delta clamp), divided by the window's span
+  int64_t sum = 0;
+  for (size_t i = 1; i < s.fine.size(); ++i) {
+    const int64_t d = s.fine[i].second - s.fine[i - 1].second;
+    if (d > 0) sum += d;
+  }
+  EXPECT_EQV(sum, int64_t(150));  // 100 + 50; the -200 clamped away
+  const int64_t span = s.fine.back().first - s.fine.front().first;
+  EXPECT_TRUE(span > 0);
+  const double want = double(sum) * 1e6 / double(span);
+  EXPECT_TRUE(s.rate_per_s >= 0.0);
+  EXPECT_TRUE(std::fabs(s.rate_per_s - want) <=
+              std::max(1e-3, want * 1e-4));  // %.6f formatting slack
+}
+
+TESTCASE(rings_stay_bounded_over_long_runs) {
+  ArmManual(/*fine_slots=*/16, /*coarse_every=*/5, /*coarse_slots=*/12);
+  Counter& c = Registry::Get()->counter("tst.bounded");
+  // a simulated multi-hour run: thousands of ticks must leave every ring
+  // at its cap, not growing — this is the bounded-memory contract
+  for (int i = 0; i < 2000; ++i) {
+    c.Add(3);
+    TimeseriesSample();  // no sleep: same-microsecond ticks are fine here
+  }
+  TimeseriesDoc doc = ParseTimeseries(TimeseriesJson());
+  for (const auto& [name, s] : doc.series) {
+    EXPECT_TRUE(s.fine.size() <= 16);
+    EXPECT_TRUE(s.coarse.size() <= 12);
+  }
+  EXPECT_TRUE(doc.series.at("tst.bounded").fine.size() == 16);
+  EXPECT_TRUE(doc.series.at("tst.bounded").coarse.size() == 12);
+  // tail view truncates the same rings further
+  TimeseriesDoc tail = ParseTimeseries(TimeseriesTailJson(4));
+  EXPECT_EQV(tail.series.at("tst.bounded").fine.size(), size_t(4));
+}
+
+TESTCASE(resource_gauges_ride_the_sampler) {
+  ArmManual(/*fine_slots=*/8, /*coarse_every=*/1000, /*coarse_slots=*/4);
+  Tick();
+  TimeseriesDoc doc = ParseTimeseries(TimeseriesJson());
+  EXPECT_TRUE(doc.series.count("resource.rss_bytes") == 1);
+  EXPECT_TRUE(doc.series.count("resource.fd_count") == 1);
+  EXPECT_TRUE(doc.series.count("timeseries.ticks") == 1);
+#ifdef __linux__
+  // a live Linux process has nonzero RSS and at least stdin/stdout/stderr
+  EXPECT_TRUE(doc.series.at("resource.rss_bytes").fine.back().second > 0);
+  EXPECT_TRUE(doc.series.at("resource.fd_count").fine.back().second >= 3);
+#endif
+}
+
+TESTCASE(flight_record_carries_timeseries_and_log_tail) {
+  ArmManual(/*fine_slots=*/8, /*coarse_every=*/1000, /*coarse_slots=*/4);
+  Registry::Get()->counter("tst.flight").Add(7);
+  Tick();
+  TLOG(Warning) << "tst flight-record tail marker";
+  const std::string rec = FlightRecordJson("test");
+  WalkJson(rec);
+  EXPECT_TRUE(rec.find("\"timeseries\":") != std::string::npos);
+  EXPECT_TRUE(rec.find("\"log_tail\":") != std::string::npos);
+  EXPECT_TRUE(rec.find("tst.flight") != std::string::npos);
+  EXPECT_TRUE(rec.find("tst flight-record tail marker") != std::string::npos);
+  // the log tail itself is well-formed JSON and ring-bounded
+  WalkJson(log::TailJson());
+}
+
+TESTCASE(trace_ring_bounds_and_counts_drops_exactly) {
+  // main() pinned DMLCTPU_TRACE_RING_EVENTS=8 before any span was pushed
+  TraceStart();
+  const uint64_t drops0 =
+      Registry::Get()->counter("trace.events_dropped").Value();
+  for (int i = 0; i < 100; ++i) {
+    RecordSpan("tst.storm", NowUs(), 1);
+  }
+  const std::string dump = TraceDumpJson();
+  WalkJson(dump);
+  // 100 spans through an 8-slot ring: exactly 8 survive (oldest-first
+  // walk), exactly 92 counted dropped
+  size_t kept = 0;
+  for (size_t pos = 0;
+       (pos = dump.find("tst.storm", pos)) != std::string::npos; ++pos) {
+    ++kept;
+  }
+  EXPECT_EQV(kept, size_t(8));
+  const uint64_t drops =
+      Registry::Get()->counter("trace.events_dropped").Value();
+  EXPECT_EQV(drops - drops0, uint64_t(92));
+  TraceStop();
+}
+
+TESTCASE(sampler_background_thread_ticks_and_stops) {
+  TimeseriesOptions o;
+  o.tick_ms = 5;
+  o.fine_slots = 32;
+  o.coarse_every = 1000;
+  o.coarse_slots = 4;
+  TimeseriesStart(o);
+  EXPECT_TRUE(TimeseriesActive());
+  for (int i = 0; i < 200; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    TimeseriesDoc doc = ParseTimeseries(TimeseriesTailJson(4));
+    if (doc.ticks >= 2) break;
+  }
+  TimeseriesDoc doc = ParseTimeseries(TimeseriesJson());
+  EXPECT_TRUE(doc.ticks >= 2);
+  TimeseriesStop();
+  EXPECT_TRUE(!TimeseriesActive());
+  const int64_t ticks_after_stop = ParseTimeseries(TimeseriesJson()).ticks;
+  std::this_thread::sleep_for(std::chrono::milliseconds(30));
+  EXPECT_EQV(ParseTimeseries(TimeseriesJson()).ticks, ticks_after_stop);
+}
+
+#else  // !DMLCTPU_TELEMETRY
+
+TESTCASE(stub_sampler_is_inert) {
+  TimeseriesOptions o;
+  o.tick_ms = 5;
+  TimeseriesStart(o);
+  EXPECT_TRUE(!TimeseriesActive());
+  TimeseriesSample();
+  const std::string doc = TimeseriesJson();
+  WalkJson(doc);
+  EXPECT_TRUE(doc.find("\"enabled\":false") != std::string::npos);
+  WalkJson(TimeseriesTailJson(8));
+  TimeseriesStop();
+}
+
+#endif  // DMLCTPU_TELEMETRY
+
+}  // namespace
+
+int main() {
+  // pinned before the first span push: the trace ring capacity is read
+  // once, so the storm test gets a deterministic 8-slot ring
+  setenv("DMLCTPU_TRACE_RING_EVENTS", "8", 1);
+  return ::testing_mini::RunAll();
+}

@@ -227,6 +227,14 @@ _LIB.DmlcTpuFlightRecordJson.argtypes = [
     ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
 _LIB.DmlcTpuWatchdogLastRecordJson.argtypes = [
     ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuTimeseriesStart.argtypes = [
+    ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+_LIB.DmlcTpuTimeseriesStop.argtypes = []
+_LIB.DmlcTpuTimeseriesActive.argtypes = [ctypes.POINTER(ctypes.c_int)]
+_LIB.DmlcTpuTimeseriesSample.argtypes = []
+_LIB.DmlcTpuTimeseriesJson.argtypes = [ctypes.POINTER(ctypes.c_char_p)]
+_LIB.DmlcTpuTimeseriesTailJson.argtypes = [
+    ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
 
 _LIB.DmlcTpuFaultCompiledIn.argtypes = [ctypes.POINTER(ctypes.c_int)]
 _LIB.DmlcTpuFaultArm.argtypes = [ctypes.c_char_p]

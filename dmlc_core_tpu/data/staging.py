@@ -36,6 +36,7 @@ Design (SURVEY.md §7 step 7):
 """
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import logging
 import queue
@@ -58,9 +59,10 @@ LOGGER = logging.getLogger("dmlc_core_tpu.staging")
 
 
 def _observability_scope():
-    """Arm the env-configured stall watchdog and start the tracker metrics
-    pusher for this process (both no-ops without their env vars — see
-    ``DMLCTPU_WATCHDOG_DEADLINE_S`` and ``DMLC_TRACKER_METRICS_PORT`` in
+    """Arm the env-configured stall watchdog, the always-on time-series
+    sampler, and the tracker metrics pusher for this process (all no-ops
+    without their env vars — see ``DMLCTPU_WATCHDOG_DEADLINE_S``,
+    ``DMLCTPU_TIMESERIES`` and ``DMLC_TRACKER_METRICS_PORT`` in
     doc/observability.md): every epoch driven through a staging iterator
     becomes job-wide observable without touching user code."""
     try:
@@ -68,7 +70,10 @@ def _observability_scope():
         _metrics.ensure_pusher()
     except Exception:  # tracker package is optional at data-plane runtime
         LOGGER.debug("tracker metrics pusher unavailable", exc_info=True)
-    return telemetry.watchdog_from_env()
+    scope = contextlib.ExitStack()
+    scope.enter_context(telemetry.timeseries_from_env())
+    scope.enter_context(telemetry.watchdog_from_env())
+    return scope
 
 
 def _staged_iter(produce, prefetch: int, depth_gauge: Optional[str] = None):

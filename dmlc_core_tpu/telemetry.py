@@ -40,6 +40,9 @@ __all__ = [
     "format_stall_table", "window", "Window", "capture_logs",
     "watchdog", "watchdog_from_env", "watchdog_running",
     "watchdog_stall_count", "flight_record", "last_flight_record",
+    "timeseries_start", "timeseries_stop", "timeseries_active",
+    "timeseries_sample", "timeseries_json", "timeseries_tail_json",
+    "timeseries", "timeseries_from_env", "resource_sample",
 ]
 
 
@@ -659,6 +662,128 @@ def last_flight_record() -> Optional[dict]:
         _native.lib().DmlcTpuWatchdogLastRecordJson(ctypes.byref(out)))
     raw = (out.value or b"").decode()
     return json.loads(raw) if raw else None
+
+
+# ---- always-on time-series sampler ------------------------------------------
+
+_timeseries_lock = threading.Lock()
+_timeseries_depth = 0
+
+
+def timeseries_start(tick_ms: int = 0, fine_slots: int = 0,
+                     coarse_every: int = 0, coarse_slots: int = 0) -> None:
+    """Start (or restart with new options) the native background sampler.
+
+    Every ``tick_ms`` the sampler snapshots each registered counter/gauge
+    into a fixed-size fine ring (newest ``fine_slots`` ticks) and, every
+    ``coarse_every`` ticks, rolls the window up into a coarse ring
+    (``coarse_slots`` slots) — bounded memory regardless of run length.
+    Args <= 0 fall back to ``DMLCTPU_TS_TICK_MS`` (1000),
+    ``DMLCTPU_TS_FINE_SLOTS`` (600), 30, and ``DMLCTPU_TS_COARSE_SLOTS``
+    (960).  Starting also installs the crash-forensics black box (fatal-log
+    hook + SIGABRT/SIGTERM flight-file dump).  No-op when telemetry is
+    compiled out."""
+    _native.check(_native.lib().DmlcTpuTimeseriesStart(
+        int(tick_ms), int(fine_slots), int(coarse_every), int(coarse_slots)))
+
+
+def timeseries_stop() -> None:
+    """Stop the sampler thread; rings are kept and still served."""
+    _native.check(_native.lib().DmlcTpuTimeseriesStop())
+
+
+def timeseries_active() -> bool:
+    out = ctypes.c_int()
+    _native.check(_native.lib().DmlcTpuTimeseriesActive(ctypes.byref(out)))
+    return bool(out.value)
+
+
+def timeseries_sample() -> None:
+    """Force one synchronous sampler tick (tests / deterministic drains)."""
+    _native.check(_native.lib().DmlcTpuTimeseriesSample())
+
+
+def timeseries_json() -> str:
+    """Raw JSON document with every series' full fine+coarse rings."""
+    out = ctypes.c_char_p()
+    _native.check(_native.lib().DmlcTpuTimeseriesJson(ctypes.byref(out)))
+    return (out.value or b"{}").decode()
+
+
+def timeseries_tail_json(points: int = 60) -> str:
+    """Raw JSON with only the newest ``points`` fine points per series —
+    the bounded tail that rides metric pushes and flight records."""
+    out = ctypes.c_char_p()
+    _native.check(_native.lib().DmlcTpuTimeseriesTailJson(
+        int(points), ctypes.byref(out)))
+    return (out.value or b"{}").decode()
+
+
+def timeseries(points: int = 0) -> dict:
+    """Parsed time-series document: ``{"enabled", "active", "tick_ms",
+    "series": {name: {"kind", "rate_per_s"?, "fine": [[t_us, v], ...],
+    "coarse": [...]}}}``.  ``points > 0`` limits each ring to the newest
+    ``points`` entries."""
+    raw = timeseries_tail_json(points) if points > 0 else timeseries_json()
+    return json.loads(raw)
+
+
+@contextlib.contextmanager
+def timeseries_from_env() -> Iterator[None]:
+    """Arm the sampler for the duration of the body when
+    ``DMLCTPU_TIMESERIES=1`` (any non-empty value other than ``0``), else a
+    no-op — how the staging iterators get always-on sampling without call-
+    site plumbing.  Tick/ring knobs come from ``DMLCTPU_TS_TICK_MS`` /
+    ``DMLCTPU_TS_FINE_SLOTS`` / ``DMLCTPU_TS_COARSE_SLOTS``.  Nesting
+    refcounts like :func:`watchdog`: the outermost entry starts, the last
+    exit stops."""
+    armed = os.environ.get("DMLCTPU_TIMESERIES", "")
+    if not armed or armed == "0":
+        yield
+        return
+    global _timeseries_depth
+    with _timeseries_lock:
+        _timeseries_depth += 1
+        if _timeseries_depth == 1:
+            timeseries_start()
+    try:
+        resource_sample()
+        yield
+    finally:
+        with _timeseries_lock:
+            _timeseries_depth -= 1
+            if _timeseries_depth == 0:
+                timeseries_stop()
+
+
+def resource_sample() -> dict:
+    """Publish device-memory gauges from jax and return what was set.
+
+    Sets ``resource.hbm_bytes_in_use`` / ``resource.hbm_bytes_limit`` from
+    the first device that reports ``memory_stats()`` (TPU/GPU backends; CPU
+    returns nothing and the gauges stay untouched).  Host-side gauges
+    (``resource.rss_bytes``, ``resource.fd_count``, ``resource.cpu_ms``)
+    are published by the native sampler itself each tick."""
+    published: Dict[str, int] = {}
+    try:
+        import jax
+        for dev in jax.devices():
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit")
+            if in_use is not None:
+                gauge_set("resource.hbm_bytes_in_use", int(in_use))
+                published["resource.hbm_bytes_in_use"] = int(in_use)
+            if limit is not None:
+                gauge_set("resource.hbm_bytes_limit", int(limit))
+                published["resource.hbm_bytes_limit"] = int(limit)
+            break
+    except Exception:  # pragma: no cover - jax backend quirks must not raise
+        pass
+    return published
 
 
 # ---- log capture ------------------------------------------------------------

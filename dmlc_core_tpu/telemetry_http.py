@@ -20,6 +20,12 @@ One small ThreadingHTTPServer per process serving:
 * ``/jobtrace`` — the tracker's merged, clock-aligned job trace
   (``MetricsAggregator.job_trace``), tracker endpoints only: a
   ``trace_provider`` must be attached.  Load in Perfetto like ``/trace``.
+* ``/timeseries`` — the always-on sampler's bounded history rings (fine ~1 s
+  ticks for the recent window, 30 s coarse rollups beyond) with windowed
+  rates per counter; ``?points=N`` limits each ring to the newest N points.
+* ``/jobtimeseries`` — the tracker's clock-aligned merge of every host's
+  pushed time-series tail (``MetricsAggregator.job_timeseries``), tracker
+  endpoints only: a ``timeseries_provider`` must be attached.
 * ``/shards`` — the tracker's shard-board dispatch state (per-epoch
   pending/started/done and steal records), tracker endpoints only: a
   ``board_provider`` must be attached (the aggregator's).
@@ -63,6 +69,10 @@ HealthGate = Callable[[], Optional[str]]
 # trace provider: () -> merged Chrome-trace dict; tracker endpoints attach
 # MetricsAggregator.job_trace to light up /jobtrace
 TraceProvider = Callable[[], dict]
+# timeseries provider: () -> merged clock-aligned time-series dict; tracker
+# endpoints attach MetricsAggregator.job_timeseries to light up
+# /jobtimeseries
+TimeseriesProvider = Callable[[], dict]
 
 
 def _sanitize(name: str) -> str:
@@ -239,6 +249,27 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send_large(200, json.dumps(tp()),
                                      "application/json")
+            elif url.path == "/timeseries":
+                points = 0
+                for part in (url.query or "").split("&"):
+                    if part.startswith("points="):
+                        try:
+                            points = int(part[len("points="):])
+                        except ValueError:
+                            points = 0
+                raw = (telemetry.timeseries_tail_json(points) if points > 0
+                       else telemetry.timeseries_json())
+                self._send_large(200, raw, "application/json")
+            elif url.path == "/jobtimeseries":
+                tsp = getattr(self.server, "timeseries_provider", None)
+                if tsp is None:
+                    self._send(404, "no job time-series merge on this "
+                               "endpoint (worker process? the tracker "
+                               "serves /jobtimeseries; per-process rings "
+                               "are at /timeseries)\n", "text/plain")
+                else:
+                    self._send_large(200, json.dumps(tsp()),
+                                     "application/json")
             elif url.path == "/flight":
                 rec = None
                 if "fresh=1" not in (url.query or ""):
@@ -265,8 +296,9 @@ class _Handler(BaseHTTPRequestHandler):
                                "application/json")
             else:
                 self._send(404, "not found: try /metrics /trace /jobtrace "
-                           "/flight /snapshot /autotune /shards "
-                           "/dataservice /healthz\n", "text/plain")
+                           "/timeseries /jobtimeseries /flight /snapshot "
+                           "/autotune /shards /dataservice /healthz\n",
+                           "text/plain")
         except Exception as exc:  # a scrape must never kill the server
             try:
                 self._send(500, f"error: {exc}\n", "text/plain")
@@ -282,7 +314,8 @@ class TelemetryServer:
                  board_provider: Optional[BoardProvider] = None,
                  score_provider: Optional[ScoreProvider] = None,
                  health_gate: Optional[HealthGate] = None,
-                 trace_provider: Optional[TraceProvider] = None):
+                 trace_provider: Optional[TraceProvider] = None,
+                 timeseries_provider: Optional[TimeseriesProvider] = None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.provider = provider or _local_provider
@@ -290,6 +323,7 @@ class TelemetryServer:
         self._httpd.score_provider = score_provider
         self._httpd.health_gate = health_gate
         self._httpd.trace_provider = trace_provider
+        self._httpd.timeseries_provider = timeseries_provider
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -318,7 +352,9 @@ def serve(port: int = 0, host: str = "127.0.0.1",
           board_provider: Optional[BoardProvider] = None,
           score_provider: Optional[ScoreProvider] = None,
           health_gate: Optional[HealthGate] = None,
-          trace_provider: Optional[TraceProvider] = None) -> TelemetryServer:
+          trace_provider: Optional[TraceProvider] = None,
+          timeseries_provider: Optional[TimeseriesProvider] = None,
+          ) -> TelemetryServer:
     """Start the endpoint on a daemon thread and return its handle.
     ``port=0`` binds an ephemeral port (read it back via ``.port``).
     ``board_provider`` (tracker endpoints) lights up ``/shards`` and
@@ -327,6 +363,9 @@ def serve(port: int = 0, host: str = "127.0.0.1",
     ``POST /score`` and the 503-on-swap contract — a ScoringServer
     passes its own (doc/serving.md).  ``trace_provider`` (tracker
     endpoints) lights up ``/jobtrace`` — pass
-    ``MetricsAggregator.job_trace``."""
+    ``MetricsAggregator.job_trace``; ``timeseries_provider`` likewise
+    lights up ``/jobtimeseries`` — pass
+    ``MetricsAggregator.job_timeseries``."""
     return TelemetryServer(host, port, provider, board_provider,
-                           score_provider, health_gate, trace_provider)
+                           score_provider, health_gate, trace_provider,
+                           timeseries_provider)

@@ -54,7 +54,7 @@ __all__ = [
     "MetricsAggregator", "MetricsPusher", "push_once", "ensure_pusher",
     "stop_pusher", "METRICS_MAGIC", "METRICS_PORT_ENV",
     "ShardBoard", "ShardClient", "shard_client_from_env",
-    "coordinated_parts",
+    "coordinated_parts", "RegressionSentinel",
 ]
 
 
@@ -383,8 +383,98 @@ class LeaseBoard:
         return {"workers": workers, "leases": leases}
 
 
+class RegressionSentinel:
+    """Rolling-baseline throughput watch over the pushed counter stream.
+
+    For each (rank, stage) the sentinel turns successive snapshot pushes
+    into windowed rates (counter delta / wall-clock dt, clamped at zero so
+    a worker restart reads as "no progress this window", the same clamp as
+    ``telemetry.counters_delta``) and tracks an EWMA baseline that only
+    absorbs *healthy* windows.  A stage is **degraded** when its rate drops
+    below ``threshold`` (default 0.35) of its established baseline for
+    ``consecutive`` windows in a row — a persistent regression, not a
+    single hiccup — and recovers the moment one window clears the bar.
+    Baselines need ``warmup`` healthy windows before they can flag anything,
+    so ramp-up never reads as regression.  Degraded (rank, stage) pairs
+    feed ``MetricsAggregator.flagged_ranks`` (their pending shards become
+    stealable) and the ``format_job_table`` flags column.
+    """
+
+    # stage -> its progress counter; mirrors the watchdog's kStages table
+    STAGES = (("split", "split.bytes"), ("parse", "parse.rows"),
+              ("shard", "shard.chunks"), ("pack", "pack.batches"),
+              ("record", "record.batches"), ("h2d", "h2d.batches"))
+
+    def __init__(self, threshold: float = 0.35, warmup: int = 3,
+                 consecutive: int = 2, ewma_alpha: float = 0.3,
+                 min_dt_s: float = 0.05):
+        self.threshold = float(threshold)
+        self.warmup = max(int(warmup), 1)
+        self.consecutive = max(int(consecutive), 1)
+        self.alpha = float(ewma_alpha)
+        self.min_dt_s = float(min_dt_s)
+        # (rank, stage) -> {"value","t","ewma","healthy","low","rate"}
+        self._tracks: Dict[Tuple[int, str], dict] = {}
+
+    def reset_rank(self, rank: int) -> None:
+        """Forget a restarted worker's history: its counters restarted from
+        zero, so old baselines would read the fresh ramp as regression."""
+        for key in [k for k in self._tracks if k[0] == rank]:
+            del self._tracks[key]
+
+    def observe(self, rank: int, snapshot: dict,
+                now: Optional[float] = None) -> None:
+        """Fold one pushed snapshot into the per-stage rate tracks."""
+        now = time.time() if now is None else float(now)
+        counters = snapshot.get("counters", {})
+        for stage, counter in self.STAGES:
+            if counter not in counters:
+                continue
+            value = int(counters[counter])
+            key = (rank, stage)
+            tr = self._tracks.get(key)
+            if tr is None:
+                self._tracks[key] = {"value": value, "t": now, "ewma": 0.0,
+                                     "healthy": 0, "low": 0, "rate": 0.0}
+                continue
+            dt = now - tr["t"]
+            if dt < self.min_dt_s:
+                continue  # duplicate push; a rate from ~0 dt is noise
+            delta = max(value - tr["value"], 0)  # counters_delta clamp
+            rate = delta / dt
+            tr["value"], tr["t"], tr["rate"] = value, now, rate
+            baselined = tr["healthy"] >= self.warmup
+            if baselined and rate < self.threshold * tr["ewma"]:
+                tr["low"] += 1
+                continue  # regression window: baseline must not absorb it
+            tr["low"] = 0
+            if delta > 0:  # idle stages neither build nor decay baselines
+                tr["ewma"] = (rate if tr["healthy"] == 0 else
+                              (1 - self.alpha) * tr["ewma"]
+                              + self.alpha * rate)
+                tr["healthy"] += 1
+
+    def degraded(self) -> Dict[int, Dict[str, dict]]:
+        """``{rank: {stage: {"rate", "baseline", "windows"}}}`` for every
+        (rank, stage) currently below threshold long enough to flag."""
+        out: Dict[int, Dict[str, dict]] = {}
+        for (rank, stage), tr in sorted(self._tracks.items()):
+            if tr["low"] >= self.consecutive:
+                out.setdefault(rank, {})[stage] = {
+                    "rate": round(tr["rate"], 3),
+                    "baseline": round(tr["ewma"], 3),
+                    "windows": tr["low"],
+                }
+        return out
+
+
 class MetricsAggregator:
     """Accepts worker snapshot pushes and merges them into a job view."""
+
+    # a clock-offset estimate older than this (the host's
+    # telemetry.clock_probe_age_s gauge) makes its trace/time-series
+    # alignment suspect; job_snapshot/job_trace/job_timeseries flag it
+    CLOCK_STALE_S = 60.0
 
     def __init__(self, host_ip: str = "127.0.0.1", port: int = 0):
         family = socket.getaddrinfo(host_ip, None)[0][0]
@@ -400,6 +490,7 @@ class MetricsAggregator:
         self._hosts: Dict[int, dict] = {}
         self.board = ShardBoard()
         self.leases = LeaseBoard()
+        self.sentinel = RegressionSentinel()
         self._closed = False
         self._thread = threading.Thread(
             target=self._serve, name="dmlctpu-metrics-aggregator", daemon=True)
@@ -448,7 +539,13 @@ class MetricsAggregator:
                 # last dump the host shipped (cumulative, like counters)
                 "trace": payload.get("trace") or (
                     prev.get("trace") if prev else None),
+                # same carry-forward for the sampler's time-series tail
+                "timeseries": payload.get("timeseries") or (
+                    prev.get("timeseries") if prev else None),
             }
+            if restarted and (prev is None or not prev["restarted"]):
+                self.sentinel.reset_rank(rank)
+            self.sentinel.observe(rank, payload["snapshot"])
         _write_int(fd, 0)
         # optional shard-board RPC: one JSON reply after the ack (absent
         # for plain pushes, so the classic protocol is untouched)
@@ -519,15 +616,17 @@ class MetricsAggregator:
     def flagged_ranks(self, stale_s: float = 30.0) -> set:
         """Ranks whose pending shards are up for grabs: persistent
         stragglers (the format_job_table median rule over lifetime
-        counters), restarted hosts, and hosts whose last push went stale."""
+        counters), hosts the regression sentinel holds degraded, restarted
+        hosts, and hosts whose last push went stale."""
         now = time.time()
         with self._lock:
             hosts = {r: dict(h) for r, h in self._hosts.items()}
+            degraded = self.sentinel.degraded()
         empty: dict = {"counters": {}}
         attrs = {r: telemetry.stall_attribution(empty, h["snapshot"])
                  for r, h in hosts.items()}
         median = _stage_medians(list(attrs.values()))
-        flagged = set()
+        flagged = set(degraded)
         for r, h in hosts.items():
             if h["restarted"] or (now - h["last_update"]) > stale_s:
                 flagged.add(r)
@@ -573,6 +672,15 @@ class MetricsAggregator:
         view["restarted"] = any(h["restarted"] for h in hosts.values())
         view["shards"] = self.board.state()
         view["dataservice"] = self.leases.state()
+        with self._lock:
+            view["degraded"] = self.sentinel.degraded()
+        # hosts whose clock-offset estimate went stale (probe age gauge over
+        # threshold): their job_trace/job_timeseries alignment is suspect.
+        # Hosts not publishing the gauge (older workers) are NOT flagged.
+        view["clock_stale"] = [
+            r for r, h in sorted(hosts.items())
+            if h["snapshot"].get("gauges", {})
+            .get("telemetry.clock_probe_age_s", 0) > self.CLOCK_STALE_S]
         return view
 
     def format_job_table(self, stale_s: float = 30.0) -> str:
@@ -596,6 +704,8 @@ class MetricsAggregator:
             st = attr["bound_stage"]
             return attr["bound"].get(st, 0.0) if st else 0.0
 
+        degraded = view.get("degraded", {})
+        clock_stale = set(view.get("clock_stale", []))
         lines = ["rank  host             bound           busy_s   flags"]
         for rank, h in sorted(hosts.items(), key=share_of, reverse=True):
             attr = h["attribution"]
@@ -608,6 +718,11 @@ class MetricsAggregator:
                 if share >= 1.5 * med and share - med >= 10.0:
                     flags.append(f"straggler ({st}-bound {share:.0f}% vs "
                                  f"fleet median {med:.0f}%)")
+            for stage, d in sorted(degraded.get(rank, {}).items()):
+                flags.append(f"degraded ({stage} {d['rate']:.0f}/s vs "
+                             f"baseline {d['baseline']:.0f}/s)")
+            if rank in clock_stale:
+                flags.append("clock-stale")
             if h["age_s"] > stale_s:
                 flags.append(f"stale {h['age_s']:.0f}s")
             if h["restarted"]:
@@ -722,7 +837,55 @@ class MetricsAggregator:
                 "offsets_us": offsets,
                 "max_abs_offset_us": max(
                     (abs(o) for o in offsets.values()), default=0),
+                # ranks whose offset estimate went stale (probe age gauge
+                # over CLOCK_STALE_S): their alignment is suspect
+                "stale_clock_ranks": self._stale_clock_ranks(hosts),
             },
+        }
+
+    def _stale_clock_ranks(self, hosts: Dict[int, dict]) -> List[int]:
+        return [r for r, h in sorted(hosts.items())
+                if h["snapshot"].get("gauges", {})
+                .get("telemetry.clock_probe_age_s", 0) > self.CLOCK_STALE_S]
+
+    def job_timeseries(self) -> dict:
+        """Merge every host's pushed time-series tail into one clock-aligned
+        fleet view (the tracker's ``/jobtimeseries`` endpoint).
+
+        Each host's series keep their fine/coarse point lists but every
+        timestamp is shifted onto the tracker's steady clock by the host's
+        NTP-style offset estimate (the ``telemetry.clock_offset_us`` gauge
+        riding its snapshot), the same alignment as :meth:`job_trace` — so
+        "rank 3's parse rate collapsed 400 ms before rank 0's h2d gauge
+        spiked" reads off one time axis.  ``hosts`` maps rank -> the host's
+        shifted document; ``offsets_us``/``stale_clock_ranks`` carry the
+        merge-health row."""
+        with self._lock:
+            hosts = {r: dict(h) for r, h in self._hosts.items()}
+        out_hosts: Dict[str, dict] = {}
+        offsets: Dict[str, int] = {}
+        for rank, h in sorted(hosts.items()):
+            doc = h.get("timeseries")
+            if not doc or not doc.get("series"):
+                continue
+            off = int(h["snapshot"].get("gauges", {})
+                      .get("telemetry.clock_offset_us", 0))
+            shifted = dict(doc)
+            shifted["series"] = {
+                name: {**s, **{ring: [[int(t) + off, v]
+                                      for t, v in s.get(ring, [])]
+                               for ring in ("fine", "coarse") if ring in s}}
+                for name, s in doc["series"].items()}
+            shifted["host"] = f"rank {rank} {h['host']}:{h['pid']}"
+            out_hosts[str(rank)] = shifted
+            offsets[str(rank)] = off
+        return {
+            "hosts": out_hosts,
+            "num_hosts": len(out_hosts),
+            "offsets_us": offsets,
+            "max_abs_offset_us": max(
+                (abs(o) for o in offsets.values()), default=0),
+            "stale_clock_ranks": self._stale_clock_ranks(hosts),
         }
 
     def close(self) -> None:
@@ -740,18 +903,21 @@ class MetricsAggregator:
 
 def push_once(tracker_uri: str, metrics_port: int, rank: int,
               restarted: bool = False, timeout: float = 10.0,
-              clock: bool = False,
-              trace: Optional[dict] = None) -> Optional[Tuple[int, int]]:
+              clock: bool = False, trace: Optional[dict] = None,
+              timeseries: Optional[dict] = None,
+              ) -> Optional[Tuple[int, int]]:
     """Push one snapshot to the tracker (raises on connection failure —
     the periodic pusher catches, a deterministic test caller should see).
 
     With ``trace`` the payload ships that trace dump for the tracker's
-    ``job_trace`` merge.  With ``clock=True`` the push piggybacks one
-    NTP-style probe after the ack — send a ping at local steady time t0,
-    read the tracker's steady time, note local t1 — and returns
-    ``(rtt_us, offset_us)`` where ``offset = t_tracker - (t0+t1)/2``, i.e.
-    local time + offset = tracker time.  The estimate's error is bounded
-    by rtt/2, which is why the pusher keeps the minimum-RTT probe."""
+    ``job_trace`` merge; with ``timeseries`` it ships the sampler's bounded
+    tail for the ``job_timeseries`` merge.  With ``clock=True`` the push
+    piggybacks one NTP-style probe after the ack — send a ping at local
+    steady time t0, read the tracker's steady time, note local t1 — and
+    returns ``(rtt_us, offset_us)`` where ``offset = t_tracker -
+    (t0+t1)/2``, i.e. local time + offset = tracker time.  The estimate's
+    error is bounded by rtt/2, which is why the pusher keeps the
+    minimum-RTT probe."""
     body = {
         "rank": int(rank),
         "host": socket.gethostname(),
@@ -761,6 +927,8 @@ def push_once(tracker_uri: str, metrics_port: int, rank: int,
     }
     if trace is not None:
         body["trace"] = trace
+    if timeseries is not None:
+        body["timeseries"] = timeseries
     if clock:
         body["clock"] = True
     payload = json.dumps(body)
@@ -924,6 +1092,11 @@ class MetricsPusher:
     # the default cadence
     CLOCK_WINDOW = 16
 
+    # how many fine points of the sampler's rings ride each push: enough
+    # tail for the tracker's merge to show the last ~half minute at the
+    # default 1 s tick while keeping the payload bounded
+    TIMESERIES_TAIL_POINTS = 30
+
     def __init__(self, tracker_uri: str, metrics_port: int, rank: int,
                  interval_s: float = 2.0):
         self.tracker_uri = tracker_uri
@@ -932,6 +1105,7 @@ class MetricsPusher:
         self.interval_s = max(float(interval_s), 0.05)
         self.pushes_dropped = 0
         self.clock_offset_us: Optional[int] = None
+        self._last_probe_wall: Optional[float] = None
         self._clock_probes: List[Tuple[int, int]] = []  # (rtt_us, offset_us)
         self._failure_streak = 0
         self._stop = threading.Event()
@@ -952,15 +1126,33 @@ class MetricsPusher:
             self.push()
 
     def push(self) -> bool:
-        """One immediate push (with clock probe + trace dump); True on
-        success."""
+        """One immediate push (with clock probe + trace dump + time-series
+        tail); True on success."""
+        # publish the probe's age BEFORE the snapshot is captured so the
+        # gauge rides THIS push — the tracker flags hosts whose offset
+        # estimate went stale (tracker unreachable, probes failing)
+        if self._last_probe_wall is not None:
+            try:
+                telemetry.gauge_set(
+                    "telemetry.clock_probe_age_s",
+                    int(time.time() - self._last_probe_wall))
+            except Exception:  # telemetry compiled out or lib torn down
+                pass
+        try:
+            ts_tail = None
+            if telemetry.timeseries_active():
+                ts_tail = telemetry.timeseries(self.TIMESERIES_TAIL_POINTS)
+        except Exception:
+            ts_tail = None
         try:
             probe = push_once(
                 self.tracker_uri, self.metrics_port, self.rank, clock=True,
                 trace=telemetry.trace_dump()
-                if telemetry.trace_armed() else None)
+                if telemetry.trace_armed() else None,
+                timeseries=ts_tail)
             if probe is not None:
                 self._clock_update(probe)
+                self._last_probe_wall = time.time()
             self._failure_streak = 0
             return True
         except (OSError, ConnectionError, ValueError):

@@ -19,6 +19,7 @@ from .common import Finding, read_text, strip_cxx_comments
 # header -> the compile-out macro whose #if/#else split it must keep in parity
 REGISTERED = {
     "cpp/include/dmlctpu/telemetry.h": "DMLCTPU_TELEMETRY",
+    "cpp/include/dmlctpu/timeseries.h": "DMLCTPU_TELEMETRY",
     "cpp/include/dmlctpu/fault.h": "DMLCTPU_FAULTS",
     "cpp/src/data/block_codec.h": "DMLCTPU_CODEC",
 }

@@ -6,7 +6,7 @@
 # since no CI runner executes .github/workflows/ci.yml in this environment.
 #
 # Two tiers (measured on this machine, idle):
-#   default      incremental ninja (~s when clean) + 6 native suites (~10s)
+#   default      incremental ninja (~s when clean) + 8 native suites (~10s)
 #                + pytest -m "not slow" (~60-90s)    -> pre-commit
 #   --full       everything incl. @pytest.mark.slow (GBDT fits, 2-process
 #                multihost, interpret-mode pallas forests; ~10 min)
@@ -41,7 +41,7 @@ ninja -C build >/dev/null
 # or mixed old/new binaries.  MUST release before pytest — _native.py's
 # loader takes a shared lock on this file from child processes, which
 # would deadlock against our held exclusive one.
-for t in test_core test_runtime test_data test_endian test_input_split test_remote_fs test_telemetry; do
+for t in test_core test_runtime test_data test_endian test_input_split test_remote_fs test_telemetry test_timeseries; do
   if ! ./build/"$t" >/tmp/dmlctpu_check_$t.log 2>&1; then
     echo "check.sh: NATIVE SUITE FAILED: $t (log: /tmp/dmlctpu_check_$t.log)" >&2
     exit 1
@@ -115,7 +115,7 @@ done
 # test_telemetry's assertions flip to the stubbed expectations, and
 # test_data passing proves the pipeline is bit-identical without telemetry.
 mkdir -p build/notelemetry
-for t in test_data test_telemetry; do
+for t in test_data test_telemetry test_timeseries; do
   nt_bin=build/notelemetry/$t
   if command -v cmake >/dev/null && command -v ninja >/dev/null; then
     cmake -S . -B build/notelemetry -G Ninja -DCMAKE_BUILD_TYPE=Release \
@@ -261,6 +261,20 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
   # (doc/observability.md "Distributed tracing").
   python scripts/jobtrace_check.py
 
+  # Timeseries tier: always-on observability end-to-end.  First the whole
+  # staging suite with the background sampler armed (fast 200 ms ticks) —
+  # every epoch then runs under live ring sampling and resource
+  # accounting, and any perturbation of what the model sees fails the
+  # staging assertions.  Then the two-process proof: a sampler-armed
+  # worker pushes its time-series tail over the 0xff98 channel for the
+  # tracker's clock-aligned /jobtimeseries merge, and a SIGABRT'd worker
+  # must leave a flight file carrying the trace-ring, time-series, and
+  # log tails, validated through the NATIVE JSONReader
+  # (doc/observability.md "Always-on operation").
+  DMLCTPU_TIMESERIES=1 DMLCTPU_TS_TICK_MS=200 \
+    python -m pytest tests/test_staging.py -x -q -m "not slow"
+  python scripts/timeseries_check.py
+
   # Mesh tier: the MeshPlan suite under the forced 8-device host platform
   # (conftest.py pins it for every pytest run, made explicit here because
   # this tier is meaningless without it) — hierarchical-vs-flat allreduce
@@ -272,5 +286,5 @@ if [[ "${DMLCTPU_CHECK_FAST:-0}" != "1" ]]; then
 fi
 
 tier=$([[ "$FULL" == "1" ]] && echo "full" || echo "fast")
-py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + jobtrace tier + sparse-pallas tier + mesh tier")
-echo "check.sh: green (contract analyzer + 7 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + nocodec tier + $py)"
+py=$([[ "${DMLCTPU_CHECK_FAST:-0}" == "1" ]] && echo "pytest skipped" || echo "pytest $tier tier + watchdog tier + faults tier + autotune tier + bincache tier + dataservice tier + serving tier + jobtrace tier + timeseries tier + sparse-pallas tier + mesh tier")
+echo "check.sh: green (contract analyzer + 8 native suites + TSan parser/staging/telemetry + ASan/UBSan parser/staging/telemetry + notelemetry tier + nofaults tier + nocodec tier + $py)"

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""check.sh timeseries tier: the always-on observability plane end-to-end.
+
+Two proofs in one script:
+
+1. **Fleet round trip** — a worker subprocess runs with the sampler armed
+   (fast ticks), pushes its snapshot + bounded time-series tail over the
+   0xff98 channel, and the parent serves ``/jobtimeseries`` from the
+   tracker's clock-aligned merge plus ``/timeseries`` from its own rings;
+   both bodies must validate through the NATIVE JSONReader
+   (``telemetry.json_validate``) and carry the host-resource series.
+
+2. **Crash-dump black box** — the same worker is then SIGABRT'd.  The
+   installed crash handler must leave a parseable flight file at
+   ``DMLCTPU_WATCHDOG_DUMP`` containing the trace-ring tail, the
+   time-series tail, and the log tail — the post-mortem a stack trace
+   alone can't give.
+
+Run from the repo root (check.sh does):  python scripts/timeseries_check.py
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dmlc_core_tpu import telemetry, telemetry_http  # noqa: E402
+from dmlc_core_tpu.tracker import metrics as tm  # noqa: E402
+
+_WORKER_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, sys.argv[1])
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.tracker import metrics as tm
+
+# the sampler arms the crash black box too (fatal hook + signal handlers);
+# fast ticks so a short run still fills the rings
+telemetry.timeseries_start(tick_ms=50, fine_slots=64, coarse_every=4,
+                           coarse_slots=16)
+telemetry.trace_start()
+t0 = telemetry.now_us()
+for i in range(200):
+    telemetry.counter_add("tscheck.work", 17)
+    telemetry.record_span("tscheck.span", telemetry.now_us(), 5)
+    time.sleep(0.005)
+telemetry.timeseries_sample()
+pusher = tm.MetricsPusher("127.0.0.1", int(sys.argv[2]), rank=0,
+                          interval_s=3600.0)
+ok = all(pusher.push() for _ in range(3))
+print("WORKER_READY" if ok else "PUSH_FAILED", flush=True)
+for line in sys.stdin:
+    if line.strip() == "abort":
+        os.abort()  # SIGABRT: the black box must leave a flight file
+    break
+"""
+
+
+def main() -> int:
+    agg = tm.MetricsAggregator()
+    srv = telemetry_http.serve(provider=agg.provider,
+                               timeseries_provider=agg.job_timeseries)
+    tmp = tempfile.TemporaryDirectory(prefix="dmlctpu-tscheck-")
+    dump_path = str(Path(tmp.name) / "crash_flight.json")
+    env = dict(os.environ)
+    env["DMLC_TRACKER_URI"] = "127.0.0.1"
+    env[tm.METRICS_PORT_ENV] = str(agg.port)
+    env["DMLCTPU_WATCHDOG_DUMP"] = dump_path
+    child = subprocess.Popen(
+        [sys.executable, "-c", _WORKER_CHILD, str(REPO), str(agg.port)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env=env, cwd=str(REPO))
+    try:
+        deadline = time.time() + 120
+        while True:
+            line = child.stdout.readline()
+            if line.startswith("WORKER_READY"):
+                break
+            assert line.strip() != "PUSH_FAILED", "worker pushes failed"
+            assert time.time() < deadline and child.poll() is None, \
+                "sampler worker never came up"
+
+        # ---- proof 1: fleet round trip --------------------------------------
+        body = urllib.request.urlopen(f"{srv.url}/jobtimeseries",
+                                      timeout=30).read().decode()
+        assert telemetry.json_validate(body), \
+            "/jobtimeseries body rejected by the native JSONReader"
+        doc = json.loads(body)
+        assert doc["num_hosts"] == 1, f"expected 1 host: {doc['num_hosts']}"
+        series = doc["hosts"]["0"]["series"]
+        for want in ("tscheck.work", "resource.rss_bytes",
+                     "timeseries.ticks"):
+            assert want in series, f"series {want!r} missing from merge"
+        work = series["tscheck.work"]
+        assert work["kind"] == "counter" and len(work["fine"]) >= 2
+        assert work["rate_per_s"] > 0, f"flat rate over a busy window: {work}"
+        # rate-vs-cumulative consistency on the merged tail: positive
+        # inter-tick deltas integrate back to the counter movement
+        fine = work["fine"]
+        deltas = sum(max(b[1] - a[1], 0) for a, b in zip(fine, fine[1:]))
+        assert 0 < deltas <= 200 * 17, f"implausible window sum: {deltas}"
+
+        # the parent's own rings serve /timeseries (sampler armed briefly)
+        telemetry.timeseries_start(tick_ms=3600_000, fine_slots=16,
+                                   coarse_every=100, coarse_slots=8)
+        telemetry.timeseries_stop()
+        telemetry.counter_add("tscheck.parent", 3)
+        telemetry.timeseries_sample()
+        own = urllib.request.urlopen(f"{srv.url}/timeseries",
+                                     timeout=30).read().decode()
+        assert telemetry.json_validate(own), \
+            "/timeseries body rejected by the native JSONReader"
+        assert "tscheck.parent" in json.loads(own)["series"]
+
+        # ---- proof 2: crash-dump black box ----------------------------------
+        child.stdin.write("abort\n")
+        child.stdin.flush()
+        child.wait(timeout=60)
+        assert child.returncode == -signal.SIGABRT, \
+            f"worker exited {child.returncode}, wanted SIGABRT"
+        assert os.path.exists(dump_path), \
+            "SIGABRT left no flight file at DMLCTPU_WATCHDOG_DUMP"
+        raw = Path(dump_path).read_text()
+        assert telemetry.json_validate(raw), \
+            "crash flight file rejected by the native JSONReader"
+        rec = json.loads(raw)
+        assert "SIGABRT" in rec["reason"], rec["reason"]
+        spans = [e for e in rec["trace"]["traceEvents"]
+                 if e.get("name") == "tscheck.span"]
+        assert spans, "trace-ring tail missing from the crash record"
+        ts = rec["timeseries"]
+        assert ts["enabled"] and "tscheck.work" in ts["series"], \
+            "time-series tail missing from the crash record"
+        assert isinstance(rec["log_tail"], list), "log tail missing"
+        print(f"TIMESERIES_CHECK_OK merged_series={len(series)} "
+              f"window_sum={deltas} crash_spans={len(spans)} "
+              f"crash_series={len(ts['series'])}")
+        return 0
+    finally:
+        if child.poll() is None:
+            child.kill()
+        srv.close()
+        agg.close()
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
